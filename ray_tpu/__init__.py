@@ -1,0 +1,36 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Tasks, actors, and an object store with an ICI-topology-aware scheduler;
+SPMD JAX/XLA training whose collectives compile over ICI; streaming data
+pipelines with host→HBM prefetch; continuously-batched LLM serving on TPU.
+
+Built to the capability surface of the Ray reference (see SURVEY.md), with a
+TPU-first architecture rather than a port.
+"""
+
+from .api import (  # noqa: F401
+    GetTimeoutError,
+    ObjectRef,
+    RayActorError,
+    RayTaskError,
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .core.task_spec import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SchedulingStrategy,
+    SpreadSchedulingStrategy,
+    TopologyRequest,
+)
+
+__version__ = "0.1.0"

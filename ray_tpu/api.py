@@ -1,0 +1,336 @@
+"""Public task/actor API.
+
+Equivalent of the reference's user-facing core API (upstream ray
+`python/ray/_private/worker.py :: init/get/put/wait/remote`,
+`python/ray/remote_function.py :: RemoteFunction`,
+`python/ray/actor.py :: ActorClass/ActorHandle/ActorMethod`).
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import inspect
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .core import core_worker as _cw
+from .core.config import config
+from .core.control_plane import ActorState
+from .core.core_worker import (
+    GetTimeoutError,
+    ObjectRef,
+    RayActorError,
+    RayTaskError,
+    Runtime,
+)
+from .core.ids import ActorID, NodeID, ObjectID, TaskID
+from .core.logging import get_logger
+from .core.task_spec import (
+    TaskKind,
+    TaskOptions,
+    TaskSpec,
+    TopologyRequest,
+)
+
+logger = get_logger("api")
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "ObjectRef",
+    "RayTaskError",
+    "RayActorError",
+    "GetTimeoutError",
+]
+
+
+def init(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    system_config: Optional[Dict[str, Any]] = None,
+    ignore_reinit_error: bool = True,
+    _existing_runtime: Optional[Runtime] = None,
+) -> Runtime:
+    """Start (or attach to) the runtime with one local node.
+
+    On a real TPU host this discovers local devices and advertises them as
+    TPU resources with topology labels (see ray_tpu.sched.topology).
+    """
+    if _cw.runtime_initialized():
+        if ignore_reinit_error:
+            return _cw.get_runtime()
+        raise RuntimeError("ray_tpu.init() called twice")
+    config.apply_overrides(system_config)
+    if _existing_runtime is not None:
+        _cw.set_runtime(_existing_runtime)
+        return _existing_runtime
+    rt = Runtime()
+    node_resources = dict(resources or {})
+    node_resources.setdefault("CPU", num_cpus if num_cpus is not None else float(os.cpu_count() or 8))
+    if num_tpus is None:
+        num_tpus = _detect_local_tpu_chips()
+    if num_tpus:
+        node_resources.setdefault("TPU", float(num_tpus))
+    rt.add_node(resources=node_resources, is_head=True)
+    _cw.set_runtime(rt)
+    atexit.register(shutdown)
+    return rt
+
+
+def _detect_local_tpu_chips() -> float:
+    """Count locally attached TPU chips without initializing a backend we
+    don't need (reference analogue: `_private/accelerators/tpu.py ::
+    TPUAcceleratorManager.get_current_node_num_accelerators`)."""
+    try:
+        import jax
+
+        return float(len([d for d in jax.devices() if d.platform not in ("cpu",)]))
+    except Exception:
+        return 0.0
+
+
+def shutdown() -> None:
+    if _cw.runtime_initialized():
+        _cw.get_runtime().shutdown()
+        _cw.set_runtime(None)
+
+
+def is_initialized() -> bool:
+    return _cw.runtime_initialized()
+
+
+def _auto_init() -> Runtime:
+    if not _cw.runtime_initialized():
+        init()
+    return _cw.get_runtime()
+
+
+# ---------------------------------------------------------------------------
+# @remote
+# ---------------------------------------------------------------------------
+
+
+def _make_options(kwargs: Dict[str, Any]) -> TaskOptions:
+    topo = kwargs.pop("topology", None)
+    if topo is not None and not isinstance(topo, TopologyRequest):
+        topo = TopologyRequest(tuple(topo))
+    opts = TaskOptions(
+        num_cpus=kwargs.pop("num_cpus", 1.0),
+        num_tpus=kwargs.pop("num_tpus", 0.0),
+        topology=topo,
+        resources=kwargs.pop("resources", {}) or {},
+        max_retries=kwargs.pop("max_retries", None),
+        retry_exceptions=kwargs.pop("retry_exceptions", False),
+        max_restarts=kwargs.pop("max_restarts", 0),
+        max_task_retries=kwargs.pop("max_task_retries", 0),
+        num_returns=kwargs.pop("num_returns", 1),
+        name=kwargs.pop("name", ""),
+        scheduling_strategy=kwargs.pop("scheduling_strategy", None) or TaskOptions().scheduling_strategy,
+        runtime_env=kwargs.pop("runtime_env", None),
+        max_concurrency=kwargs.pop("max_concurrency", 1),
+    )
+    if kwargs:
+        raise TypeError(f"unknown remote options: {sorted(kwargs)}")
+    return opts
+
+
+class RemoteFunction:
+    def __init__(self, func, options: TaskOptions):
+        self._func = func
+        self._options = options
+        functools.update_wrapper(self, func)
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        rt = _auto_init()
+        task_id = TaskID.of()
+        n = max(1, self._options.num_returns)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=rt.job_id,
+            kind=TaskKind.NORMAL,
+            func=self._func,
+            args=args,
+            kwargs=kwargs,
+            options=self._options,
+            return_ids=[ObjectID.for_task_return(task_id, i) for i in range(n)],
+            dependencies=_cw._collect_deps(args, kwargs),
+        )
+        refs = rt.submit_task(spec)
+        if self._options.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        merged = _merge_options(self._options, kwargs)
+        return RemoteFunction(self._func, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._func.__name__} cannot be called directly; "
+            f"use .remote()"
+        )
+
+
+def _merge_options(base: TaskOptions, kwargs: Dict[str, Any]) -> TaskOptions:
+    import dataclasses
+
+    fields = {f.name for f in dataclasses.fields(TaskOptions)}
+    current = dataclasses.asdict(base)
+    # asdict deep-copies; keep strategy/topology objects as-is
+    current["scheduling_strategy"] = base.scheduling_strategy
+    current["topology"] = base.topology
+    for k, v in kwargs.items():
+        if k == "topology" and v is not None and not isinstance(v, TopologyRequest):
+            v = TopologyRequest(tuple(v))
+        if k not in fields:
+            raise TypeError(f"unknown option: {k}")
+        current[k] = v
+    return TaskOptions(**current)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        rt = _auto_init()
+        opts = TaskOptions(
+            num_cpus=0.0,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+            name=f"{self._handle._class_name}.{self._name}",
+        )
+        refs = rt.submit_actor_task(self._handle._actor_id, self._name, args, kwargs, opts)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1, **kwargs):
+        if kwargs:
+            raise TypeError(f"unsupported actor-method options: {sorted(kwargs)}")
+        return ActorMethod(self._handle, self._name, num_returns)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name, self._max_task_retries))
+
+
+class ActorClass:
+    def __init__(self, cls, options: TaskOptions):
+        self._cls = cls
+        self._options = options
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = _auto_init()
+        info = rt.create_actor(self._cls, args, kwargs, self._options)
+        return ActorHandle(
+            info.actor_id, self._cls.__name__, self._options.max_task_retries
+        )
+
+    def options(self, **kwargs) -> "ActorClass":
+        return ActorClass(self._cls, _merge_options(self._options, kwargs))
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes, with options."""
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        target = args[0]
+        opts = TaskOptions()
+        if inspect.isclass(target):
+            opts.num_cpus = 1.0
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    if args:
+        raise TypeError("@remote accepts only keyword options")
+    opts = _make_options(dict(kwargs))
+
+    def decorator(target):
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    return decorator
+
+
+# ---------------------------------------------------------------------------
+# get / put / wait / kill
+# ---------------------------------------------------------------------------
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    rt = _auto_init()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout=timeout)[0]
+    return rt.get(list(refs), timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    rt = _auto_init()
+    return rt.put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    rt = _auto_init()
+    return rt.wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    rt = _auto_init()
+    rt.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str) -> ActorHandle:
+    rt = _auto_init()
+    info = rt.control_plane.get_named_actor(name)
+    if info is None or info.state is ActorState.DEAD:
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(info.actor_id, info.name or "Actor")
+
+
+def cluster_resources() -> Dict[str, float]:
+    rt = _auto_init()
+    totals: Dict[str, float] = {}
+    for node in rt.control_plane.alive_nodes():
+        for k, v in node.resources_total.items():
+            totals[k] = totals.get(k, 0.0) + v
+    return totals
+
+
+def available_resources() -> Dict[str, float]:
+    rt = _auto_init()
+    totals: Dict[str, float] = {}
+    for node in rt.control_plane.alive_nodes():
+        for k, v in node.resources_available.items():
+            totals[k] = totals.get(k, 0.0) + v
+    return totals

@@ -1,0 +1,69 @@
+"""Virtual multi-node cluster for tests.
+
+Equivalent of the reference's in-process fake cluster (upstream ray
+`python/ray/cluster_utils.py :: Cluster` used by `ray_start_cluster`
+fixtures): many node agents in one OS process sharing a control plane, so
+scheduling spread, node failure, object transfer and actor restart are
+testable on one machine. TPU version: nodes can advertise topology-labelled
+TPU resources and slice coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .core import core_worker as _cw
+from .core.core_worker import Runtime
+from .core.ids import NodeID, SliceID
+from .core.node_agent import NodeAgent
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_resources: Optional[Dict[str, float]] = None):
+        self.runtime = Runtime()
+        if initialize_head:
+            self.head = self.runtime.add_node(
+                resources=head_resources or {"CPU": 8.0}, is_head=True
+            )
+        _cw.set_runtime(self.runtime)
+
+    def add_node(
+        self,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        slice_id: Optional[SliceID] = None,
+        topology_coords: Optional[Tuple[int, ...]] = None,
+    ) -> NodeAgent:
+        return self.runtime.add_node(
+            resources=resources,
+            labels=labels,
+            slice_id=slice_id,
+            topology_coords=topology_coords,
+        )
+
+    def add_slice(
+        self,
+        num_hosts: int,
+        chips_per_host: int = 4,
+        extra_resources: Optional[Dict[str, float]] = None,
+    ) -> SliceID:
+        """Register a fake TPU slice: num_hosts nodes sharing one SliceID."""
+        slice_id = SliceID.generate()
+        for h in range(num_hosts):
+            resources = {"CPU": 8.0, "TPU": float(chips_per_host)}
+            resources.update(extra_resources or {})
+            self.add_node(
+                resources=resources,
+                labels={"slice": slice_id.hex(), "host_index": str(h)},
+                slice_id=slice_id,
+                topology_coords=(h,),
+            )
+        return slice_id
+
+    def remove_node(self, agent: NodeAgent) -> None:
+        self.runtime.remove_node(agent.node_id)
+
+    def shutdown(self) -> None:
+        self.runtime.shutdown()
+        if _cw.runtime_initialized() and _cw.get_runtime() is self.runtime:
+            _cw.set_runtime(None)

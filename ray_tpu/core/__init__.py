@@ -1,0 +1,13 @@
+"""Core runtime: ids, config, control plane, scheduler, object store, workers."""
+
+from .config import config  # noqa: F401
+from .ids import (  # noqa: F401
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    SliceID,
+    TaskID,
+    WorkerID,
+)

@@ -1,0 +1,134 @@
+"""Central flag registry.
+
+Mirrors the reference's single-source-of-truth flag system (upstream ray
+`src/ray/common/ray_config_def.h :: RAY_CONFIG` X-macro list): every runtime
+knob is declared once here with a type, default and doc; values resolve with
+precedence  init(system_config=...)  >  env RAY_TPU_<NAME>  >  default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["Config", "config", "declare", "describe_flags"]
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off"}
+
+
+def _parse_bool(s: str) -> bool:
+    low = s.strip().lower()
+    if low in _TRUE:
+        return True
+    if low in _FALSE:
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class _Field:
+    name: str
+    default: Any
+    doc: str
+    parser: Callable[[str], Any]
+
+
+_REGISTRY: Dict[str, _Field] = {}
+
+
+def declare(name: str, default: Any, doc: str = "") -> None:
+    """Declare a config flag. Types are inferred from the default."""
+    if name in _REGISTRY:
+        raise ValueError(f"duplicate config flag: {name}")
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    _REGISTRY[name] = _Field(name, default, doc, parser)
+
+
+# ---------------------------------------------------------------------------
+# Flag declarations (the ray_config_def.h equivalent — keep in one place).
+# ---------------------------------------------------------------------------
+
+# Core / scheduling
+declare("worker_pool_size", 0, "Worker processes per node agent; 0 = cpu count.")
+declare("task_max_retries", 3, "Default retries for tasks on worker/node death.")
+declare("actor_max_restarts", 0, "Default actor restarts on failure.")
+declare("lease_timeout_ms", 10_000, "Worker lease grant timeout.")
+declare("scheduler_top_k_fraction", 0.2, "Top-k fraction for hybrid scheduling.")
+declare("scheduler_spread_threshold", 0.5, "Utilization below which local wins.")
+declare("health_check_period_ms", 1_000, "Control-plane health check interval.")
+declare("health_check_timeout_ms", 10_000, "Misses before a node is declared dead.")
+
+# Object store
+declare("object_store_memory_bytes", 0, "Host shm store capacity; 0 = 30% of RAM.")
+declare("object_store_fallback_dir", "/tmp/ray_tpu_spill", "Spill directory.")
+declare("object_inline_max_bytes", 100 * 1024, "Small objects travel inline.")
+declare("object_transfer_chunk_bytes", 1024 * 1024, "Inter-node chunk size.")
+
+# Gang / TPU
+declare("gang_barrier_timeout_ms", 60_000, "SPMD gang entry barrier timeout.")
+declare("slice_restart_max", 3, "Max gang restarts before failing the job.")
+declare("device_prefetch_depth", 2, "Host->HBM double buffering depth.")
+
+# Observability
+declare("log_to_driver", True, "Tail worker logs back to the driver process.")
+declare("metrics_export_port", 0, "Prometheus port; 0 = disabled.")
+declare("event_log_dir", "", "Structured event-log directory; empty = session dir.")
+declare("task_events_max_buffer", 10_000, "Ring-buffer size for task events.")
+
+
+class Config:
+    """Resolved configuration view. Thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+
+    def apply_overrides(self, system_config: Optional[Dict[str, Any]]) -> None:
+        if not system_config:
+            return
+        with self._lock:
+            for key, value in system_config.items():
+                if key not in _REGISTRY:
+                    raise KeyError(f"unknown config flag: {key}")
+                self._overrides[key] = value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._overrides.clear()
+
+    def get(self, name: str) -> Any:
+        field = _REGISTRY.get(name)
+        if field is None:
+            raise KeyError(f"unknown config flag: {name}")
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        env = os.environ.get(f"RAY_TPU_{name.upper()}")
+        if env is not None:
+            return field.parser(env)
+        return field.default
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+
+def describe_flags() -> Dict[str, Dict[str, Any]]:
+    return {
+        f.name: {"default": f.default, "doc": f.doc, "value": config.get(f.name)}
+        for f in _REGISTRY.values()
+    }
+
+
+config = Config()

@@ -1,0 +1,271 @@
+"""Cluster control plane (GCS equivalent).
+
+Equivalent of the reference's Global Control Service (upstream ray
+`src/ray/gcs/gcs_server/gcs_server.cc :: GcsServer` with its node / actor /
+job / placement-group managers, `InternalKVInterface`, pubsub and health
+checks): the single authority for cluster membership, the actor directory,
+cluster-wide KV, and resource views. In-process for a single host; the same
+object is served over gRPC-style RPC for multi-host (see
+``ray_tpu.core.rpc``). State mutations publish to channels so node agents and
+drivers react to membership/actor changes without polling.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .ids import ActorID, JobID, NodeID, PlacementGroupID, SliceID
+from .logging import get_logger
+from .metrics import Gauge
+
+logger = get_logger("control_plane")
+
+_nodes_gauge = Gauge("ray_tpu_nodes", "Cluster nodes by state")
+_actors_gauge = Gauge("ray_tpu_actors", "Actors by state")
+
+
+class NodeState(enum.Enum):
+    ALIVE = "ALIVE"
+    DEAD = "DEAD"
+
+
+class ActorState(enum.Enum):
+    PENDING = "PENDING"
+    STARTING = "STARTING"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str
+    resources_total: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    slice_id: Optional[SliceID] = None
+    topology_coords: Optional[Tuple[int, ...]] = None  # host position in slice torus
+    state: NodeState = NodeState.ALIVE
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    # eventually-consistent load view, updated by the resource syncer
+    resources_available: Dict[str, float] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.resources_available:
+            self.resources_available = dict(self.resources_total)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: str
+    state: ActorState = ActorState.PENDING
+    node_id: Optional[NodeID] = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+
+
+class Pubsub:
+    """In-process pub/sub (reference: `src/ray/pubsub/ :: Publisher/Subscriber`)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs.setdefault(channel, []).append(callback)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                try:
+                    self._subs.get(channel, []).remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            callbacks = list(self._subs.get(channel, []))
+        for cb in callbacks:
+            try:
+                cb(message)
+            except Exception:  # subscriber errors must not poison the bus
+                logger.exception("pubsub subscriber error on channel %s", channel)
+
+
+class ControlPlane:
+    """Single-authority cluster state. All methods are thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.pubsub = Pubsub()
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+        self._jobs: Dict[JobID, Dict[str, Any]] = {}
+        self._kv: Dict[str, bytes] = {}
+        self._placement_groups: Dict[PlacementGroupID, Any] = {}
+        self._dead = False
+
+    # -- node table ---------------------------------------------------------
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self._nodes[info.node_id] = info
+        _nodes_gauge.add(1, {"state": "ALIVE"})
+        self.pubsub.publish("node", ("ALIVE", info))
+
+    def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or info.state is NodeState.DEAD:
+                return
+            info.state = NodeState.DEAD
+        _nodes_gauge.add(-1, {"state": "ALIVE"})
+        _nodes_gauge.add(1, {"state": "DEAD"})
+        logger.warning("node %s marked DEAD: %s", node_id, reason)
+        self.pubsub.publish("node", ("DEAD", info))
+
+    def heartbeat(self, node_id: NodeID, resources_available: Optional[Dict[str, float]] = None) -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None:
+                return
+            info.last_heartbeat = time.monotonic()
+            if resources_available is not None:
+                info.resources_available = dict(resources_available)
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.state is NodeState.ALIVE]
+
+    def get_node(self, node_id: NodeID) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def all_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    # -- actor directory ----------------------------------------------------
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            self._actors[info.actor_id] = info
+            if info.name:
+                if info.name in self._named_actors:
+                    raise ValueError(f"actor name already taken: {info.name}")
+                self._named_actors[info.name] = info.actor_id
+        self.pubsub.publish("actor", (info.state, info))
+
+    def update_actor(self, actor_id: ActorID, state: ActorState, node_id: Optional[NodeID] = None,
+                     death_cause: str = "") -> None:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = state
+            if node_id is not None:
+                info.node_id = node_id
+            if death_cause:
+                info.death_cause = death_cause
+            if state is ActorState.RESTARTING:
+                info.num_restarts += 1
+            if state is ActorState.DEAD and info.name:
+                self._named_actors.pop(info.name, None)
+        self.pubsub.publish("actor", (state, info))
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str) -> Optional[ActorInfo]:
+        with self._lock:
+            actor_id = self._named_actors.get(name)
+            return self._actors.get(actor_id) if actor_id else None
+
+    def list_actors(self) -> List[ActorInfo]:
+        with self._lock:
+            return list(self._actors.values())
+
+    # -- job table ----------------------------------------------------------
+    def register_job(self, job_id: JobID, metadata: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            self._jobs[job_id] = {"state": "RUNNING", "start_time": time.time(),
+                                  **(metadata or {})}
+
+    def finish_job(self, job_id: JobID, state: str = "SUCCEEDED") -> None:
+        with self._lock:
+            if job_id in self._jobs:
+                self._jobs[job_id]["state"] = state
+                self._jobs[job_id]["end_time"] = time.time()
+
+    def list_jobs(self) -> Dict[JobID, Dict[str, Any]]:
+        with self._lock:
+            return dict(self._jobs)
+
+    # -- internal KV (function table, serve config, checkpoints metadata) ---
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        with self._lock:
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> bool:
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # -- health checking ----------------------------------------------------
+    def check_health(self, timeout_s: float) -> List[NodeID]:
+        """Mark nodes dead whose heartbeat is older than timeout. Returns them."""
+        now = time.monotonic()
+        stale: List[NodeID] = []
+        with self._lock:
+            for node_id, info in self._nodes.items():
+                if info.state is NodeState.ALIVE and now - info.last_heartbeat > timeout_s:
+                    stale.append(node_id)
+        for node_id in stale:
+            self.mark_node_dead(node_id, reason=f"no heartbeat for {timeout_s}s")
+        return stale
+
+    def snapshot(self) -> Dict[str, Any]:
+        """State-API view of the whole cluster (reference: `ray list ...`)."""
+        with self._lock:
+            return {
+                "nodes": [
+                    {
+                        "node_id": n.node_id.hex(),
+                        "state": n.state.value,
+                        "address": n.address,
+                        "resources_total": dict(n.resources_total),
+                        "resources_available": dict(n.resources_available),
+                        "labels": dict(n.labels),
+                    }
+                    for n in self._nodes.values()
+                ],
+                "actors": [
+                    {
+                        "actor_id": a.actor_id.hex(),
+                        "name": a.name,
+                        "state": a.state.value,
+                        "node_id": a.node_id.hex() if a.node_id else None,
+                        "num_restarts": a.num_restarts,
+                    }
+                    for a in self._actors.values()
+                ],
+                "jobs": {j.hex(): dict(v) for j, v in self._jobs.items()},
+            }

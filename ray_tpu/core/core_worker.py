@@ -1,0 +1,666 @@
+"""Owner-side runtime: ObjectRefs, task manager (retries + lineage), actors.
+
+Equivalent of the reference's CoreWorker (upstream ray
+`src/ray/core_worker/core_worker.cc :: CoreWorker`, `task_manager.cc ::
+TaskManager` for retries/lineage, `reference_count.cc :: ReferenceCounter`,
+`object_recovery_manager.cc`): the driver (and each worker) owns the objects
+and tasks it creates; retries on worker/node death are resubmitted from the
+stored spec; lost objects are reconstructed from lineage.
+
+The ``Runtime`` singleton composes the whole single-controller deployment:
+control plane + object directory + cluster scheduler + node agents. Virtual
+multi-node clusters (tests) add several agents; a real deployment runs one
+agent per TPU host with the same code.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .config import config
+from .control_plane import ActorInfo, ActorState, ControlPlane, NodeInfo
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from .logging import get_logger
+from .node_agent import (
+    NodeAgent,
+    ObjectDirectory,
+    TaskResult,
+    WorkerCrashedError,
+)
+from .object_store import ObjectLostError
+from .scheduler import ClusterScheduler
+from .task_spec import TaskKind, TaskOptions, TaskSpec
+
+logger = get_logger("core_worker")
+
+
+class RayTaskError(Exception):
+    """Wraps an application exception raised inside a task; re-raised on get."""
+
+    def __init__(self, task_name: str, cause: BaseException):
+        super().__init__(f"task {task_name} failed: {cause!r}")
+        self.task_name = task_name
+        self.cause = cause
+
+
+class RayActorError(Exception):
+    pass
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+class ObjectRef:
+    """Handle to a (future) object. Comparable/hashable by ObjectID."""
+
+    __slots__ = ("object_id", "_runtime", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, runtime: "Runtime | None" = None):
+        self.object_id = object_id
+        self._runtime = runtime
+        if runtime is not None:
+            runtime.reference_counter.add_ref(object_id)
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def __reduce__(self):
+        # Crossing into a task: the receiving side resolves by id. Ownership
+        # transfer bookkeeping is handled at submission time (deps list).
+        return (_deserialize_ref, (self.object_id.binary(),))
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __hash__(self):
+        return hash(self.object_id)
+
+    def __repr__(self):
+        return f"ObjectRef({self.object_id.hex()[:16]})"
+
+    def __del__(self):
+        runtime = self._runtime
+        if runtime is not None:
+            try:
+                runtime.reference_counter.remove_ref(self.object_id)
+            except Exception:
+                pass
+
+
+def _deserialize_ref(binary: bytes) -> "ObjectRef":
+    from . import core_worker as _self
+
+    rt = _global_runtime
+    return ObjectRef(ObjectID(binary), rt)
+
+
+class ReferenceCounter:
+    """Driver-side distributed refcount (simplified single-owner model)."""
+
+    def __init__(self, runtime: "Runtime"):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        self._counts: Dict[ObjectID, int] = {}
+        self.gc_enabled = True
+
+    def add_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            n = self._counts.get(object_id, 0) - 1
+            if n > 0:
+                self._counts[object_id] = n
+                return
+            self._counts.pop(object_id, None)
+            should_free = self.gc_enabled
+        if should_free and not self._runtime.is_shutdown:
+            self._runtime.free_object(object_id)
+
+    def count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)
+
+
+@dataclass
+class _PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    retry_exceptions: bool
+    submitted_at: float = field(default_factory=time.monotonic)
+    target_node: Optional[NodeID] = None
+
+
+class _Future:
+    __slots__ = ("event", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+class Runtime:
+    """The composed deployment. One per process (see init/shutdown in api)."""
+
+    def __init__(self, job_id: Optional[JobID] = None):
+        self.job_id = job_id or JobID.next()
+        self.control_plane = ControlPlane()
+        self.directory = ObjectDirectory()
+        self.scheduler = ClusterScheduler(
+            self.control_plane, config.scheduler_spread_threshold
+        )
+        self.reference_counter = ReferenceCounter(self)
+        self.agents: Dict[NodeID, NodeAgent] = {}
+        self.head_node_id: Optional[NodeID] = None
+        self.is_shutdown = False
+        self._lock = threading.RLock()
+        self._futures: Dict[ObjectID, _Future] = {}
+        self._task_table: Dict[TaskID, Dict[str, Any]] = {}
+        self._pending: List[_PendingTask] = []
+        self._pending_cv = threading.Condition()
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._actor_specs: Dict[ActorID, TaskSpec] = {}
+        self._put_index = 0
+        self._driver_task_id = TaskID.of()
+        self._sched_thread = threading.Thread(
+            target=self._scheduling_loop, daemon=True, name="cluster-scheduler"
+        )
+        self._sched_thread.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="health-monitor"
+        )
+        self._monitor_thread.start()
+        self.control_plane.register_job(self.job_id)
+        # placement group table: (pg_id, bundle_index) -> NodeID
+        self.pg_table: Dict[Tuple, NodeID] = {}
+
+    # ------------------------------------------------------------- topology
+    def add_node(
+        self,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        is_head: bool = False,
+        **node_kwargs,
+    ) -> NodeAgent:
+        resources = dict(resources or {"CPU": 8.0})
+        info = NodeInfo(
+            node_id=NodeID.generate(),
+            address=f"local:{len(self.agents)}",
+            resources_total=resources,
+            labels=labels or {},
+            **node_kwargs,
+        )
+        agent = NodeAgent(info, self.control_plane, self.directory)
+        self.directory.register_agent(agent)
+        self.control_plane.register_node(info)
+        with self._lock:
+            self.agents[info.node_id] = agent
+            if is_head or self.head_node_id is None:
+                self.head_node_id = info.node_id
+        self._kick_scheduler()
+        return agent
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulate node failure (tests/chaos): tasks crash, objects are lost."""
+        with self._lock:
+            agent = self.agents.pop(node_id, None)
+            if agent is not None and self.head_node_id == node_id:
+                # re-home the driver to any surviving node
+                self.head_node_id = next(iter(self.agents), None)
+        if agent is None:
+            return
+        self.control_plane.mark_node_dead(node_id, "removed")
+        self.directory.unregister_agent(node_id)
+        agent.stop()
+        # actors on that node die; restart-eligible ones are rescheduled
+        for actor in self.control_plane.list_actors():
+            if actor.node_id == node_id and actor.state is ActorState.ALIVE:
+                self._on_actor_death(actor, WorkerCrashedError("node died"))
+        self._kick_scheduler()
+
+    @property
+    def driver_agent(self) -> NodeAgent:
+        with self._lock:
+            if self.head_node_id is None or self.head_node_id not in self.agents:
+                raise RuntimeError("no alive node to host driver objects")
+            return self.agents[self.head_node_id]
+
+    # ------------------------------------------------------------ submission
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, self) for oid in spec.return_ids]
+        retries = (
+            spec.options.max_retries
+            if spec.options.max_retries is not None
+            else config.task_max_retries
+        )
+        with self._lock:
+            for oid in spec.return_ids:
+                self._futures[oid] = _Future()
+                self._lineage[oid] = spec
+            self._task_table[spec.task_id] = {
+                "name": spec.name,
+                "state": "PENDING",
+                "kind": spec.kind.value,
+                "attempt": spec.attempt,
+            }
+        pending = _PendingTask(
+            spec, retries_left=retries, retry_exceptions=spec.options.retry_exceptions
+        )
+        self._enqueue_pending(pending)
+        return refs
+
+    def create_actor(self, cls, args, kwargs, options: TaskOptions) -> "ActorInfo":
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.of(actor_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            kind=TaskKind.ACTOR_CREATION,
+            func=cls,
+            args=args,
+            kwargs=kwargs,
+            options=options,
+            return_ids=[ObjectID.for_task_return(task_id, 0)],
+            actor_id=actor_id,
+            dependencies=_collect_deps(args, kwargs),
+        )
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=options.name,
+            max_restarts=options.max_restarts,
+        )
+        self.control_plane.register_actor(info)
+        with self._lock:
+            self._actor_specs[actor_id] = spec
+            self._futures[spec.return_ids[0]] = _Future()
+            self._task_table[task_id] = {
+                "name": f"{getattr(cls, '__name__', 'Actor')}.__init__",
+                "state": "PENDING",
+                "kind": spec.kind.value,
+                "attempt": 0,
+            }
+        self._enqueue_pending(_PendingTask(spec, retries_left=0, retry_exceptions=False))
+        return info
+
+    def submit_actor_task(
+        self, actor_id: ActorID, method_name: str, args, kwargs, options: TaskOptions
+    ) -> List[ObjectRef]:
+        task_id = TaskID.of(actor_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            kind=TaskKind.ACTOR_TASK,
+            func=None,
+            args=args,
+            kwargs=kwargs,
+            options=options,
+            return_ids=[
+                ObjectID.for_task_return(task_id, i)
+                for i in range(max(1, options.num_returns))
+            ],
+            actor_id=actor_id,
+            method_name=method_name,
+            dependencies=_collect_deps(args, kwargs),
+        )
+        refs = [ObjectRef(oid, self) for oid in spec.return_ids]
+        with self._lock:
+            for oid in spec.return_ids:
+                self._futures[oid] = _Future()
+                self._lineage[oid] = spec
+            self._task_table[spec.task_id] = {
+                "name": f"{method_name}",
+                "state": "PENDING",
+                "kind": spec.kind.value,
+                "attempt": 0,
+            }
+        retries = options.max_task_retries
+        self._enqueue_pending(_PendingTask(spec, retries_left=retries, retry_exceptions=False))
+        return refs
+
+    # -------------------------------------------------------------- get/put
+    def put(self, value: Any) -> ObjectRef:
+        with self._lock:
+            self._put_index += 1
+            oid = ObjectID.for_put(self._driver_task_id, self._put_index)
+        agent = self.driver_agent
+        agent.store.put(oid, value)
+        self.directory.add_location(oid, agent.node_id)
+        fut = _Future()
+        fut.event.set()
+        with self._lock:
+            self._futures[oid] = fut
+        return ObjectRef(oid, self)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[Any] = []
+        for ref in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(ref, remaining))
+        return out
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        fut = self._future_for(ref.object_id)
+        if not fut.event.wait(timeout):
+            raise GetTimeoutError(f"get() timed out on {ref}")
+        if fut.error is not None:
+            raise fut.error
+        holder = self.directory.locate(ref.object_id)
+        if holder is None:
+            # object lost (e.g. node died) — attempt lineage reconstruction
+            if self._try_reconstruct(ref.object_id):
+                return self._get_one(ref, timeout)
+            raise ObjectLostError(ref.object_id)
+        try:
+            return holder.store.get(ref.object_id, timeout=10.0)
+        except TimeoutError:
+            raise ObjectLostError(ref.object_id)
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            progressed = False
+            for ref in list(pending):
+                fut = self._future_for(ref.object_id)
+                if fut.event.is_set():
+                    ready.append(ref)
+                    pending.remove(ref)
+                    progressed = True
+                    if len(ready) >= num_returns:
+                        break
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not progressed:
+                time.sleep(0.001)
+        return ready, pending
+
+    def _future_for(self, oid: ObjectID) -> _Future:
+        with self._lock:
+            fut = self._futures.get(oid)
+            if fut is None:
+                # ref arrived from another process / was reconstructed
+                fut = _Future()
+                if self.directory.locations(oid):
+                    fut.event.set()
+                else:
+                    self.directory.subscribe_once(oid, fut.event.set)
+                self._futures[oid] = fut
+            return fut
+
+    def free_object(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._futures.pop(object_id, None)
+            self._lineage.pop(object_id, None)
+        self.directory.drop_everywhere(object_id)
+
+    # ---------------------------------------------------------- health check
+    def _monitor_loop(self) -> None:
+        """Pump agent heartbeats and reap nodes whose heartbeat went stale
+        (reference: `gcs_health_check_manager.cc` periodic ping)."""
+        period = config.health_check_period_ms / 1000.0
+        timeout = config.health_check_timeout_ms / 1000.0
+        while not self.is_shutdown:
+            time.sleep(period)
+            with self._lock:
+                agents = list(self.agents.values())
+            for agent in agents:
+                if not agent._stopped.is_set():
+                    agent._sync_load()
+            for node_id in self.control_plane.check_health(timeout):
+                logger.warning("health check: reaping node %s", node_id.hex()[:8])
+                self.remove_node(node_id)
+
+    # ------------------------------------------------------------ scheduling
+    def _enqueue_pending(self, pending: _PendingTask) -> None:
+        with self._pending_cv:
+            self._pending.append(pending)
+            self._pending_cv.notify_all()
+
+    def _kick_scheduler(self) -> None:
+        with self._pending_cv:
+            self._pending_cv.notify_all()
+
+    def _scheduling_loop(self) -> None:
+        while not self.is_shutdown:
+            with self._pending_cv:
+                if not self._pending:
+                    self._pending_cv.wait(timeout=0.05)
+                batch = list(self._pending)
+                self._pending.clear()
+            leftover: List[_PendingTask] = []
+            for item in batch:
+                if not self._try_place(item):
+                    leftover.append(item)
+            if leftover:
+                with self._pending_cv:
+                    self._pending.extend(leftover)
+                time.sleep(0.002)
+
+    def _try_place(self, item: _PendingTask) -> bool:
+        spec = item.spec
+        if spec.kind is TaskKind.ACTOR_TASK:
+            actor = self.control_plane.get_actor(spec.actor_id)
+            if actor is None or actor.state is ActorState.DEAD:
+                self._fail_task(item, RayActorError(
+                    f"actor {spec.actor_id.hex()[:8]} is dead: "
+                    f"{actor.death_cause if actor else 'unknown'}"))
+                return True
+            if actor.state is not ActorState.ALIVE or actor.node_id is None:
+                return False  # wait for (re)start
+            agent = self.agents.get(actor.node_id)
+            if agent is None:
+                return False
+            self._mark_task(spec.task_id, "RUNNING")
+            agent.submit(spec, lambda result: self._on_task_done(item, result))
+            return True
+
+        try:
+            node_id = self.scheduler.select_node(
+                spec, preferred_node=self.head_node_id, pg_table=self.pg_table
+            )
+        except ValueError as e:
+            self._fail_task(item, e)
+            return True
+        if node_id is None:
+            return False
+        agent = self.agents.get(node_id)
+        if agent is None:
+            return False
+        item.target_node = node_id
+        if spec.kind is TaskKind.ACTOR_CREATION:
+            self.control_plane.update_actor(spec.actor_id, ActorState.STARTING, node_id)
+        self._mark_task(spec.task_id, "RUNNING")
+        agent.submit(spec, lambda result: self._on_task_done(item, result))
+        return True
+
+    # ------------------------------------------------------------ completion
+    def _on_task_done(self, item: _PendingTask, result: TaskResult) -> None:
+        spec = item.spec
+        if result.ok:
+            self._mark_task(spec.task_id, "FINISHED")
+            if spec.kind is TaskKind.ACTOR_CREATION:
+                self.control_plane.update_actor(
+                    spec.actor_id, ActorState.ALIVE, item.target_node
+                )
+                self._kick_scheduler()  # pending method calls can now route
+            with self._lock:
+                futures = [self._futures.get(oid) for oid in spec.return_ids]
+            for fut in futures:
+                if fut is not None:
+                    fut.event.set()
+            return
+
+        retriable = not result.is_application_error or item.retry_exceptions
+        if retriable and item.retries_left > 0:
+            item.retries_left -= 1
+            spec.attempt += 1
+            self._mark_task(spec.task_id, "RETRYING")
+            logger.info(
+                "retrying task %s (attempt %d) after: %r",
+                spec.name, spec.attempt, result.error,
+            )
+            self._enqueue_pending(item)
+            return
+
+        if spec.kind is TaskKind.ACTOR_CREATION:
+            actor = self.control_plane.get_actor(spec.actor_id)
+            if (
+                not result.is_application_error
+                and actor is not None
+                and actor.num_restarts < actor.max_restarts
+            ):
+                # creation crashed with the node — reschedule like a death
+                self._on_actor_death(actor, result.error)
+                return
+            self.control_plane.update_actor(
+                spec.actor_id, ActorState.DEAD,
+                death_cause=repr(result.error),
+            )
+        error: BaseException
+        if result.is_application_error:
+            error = RayTaskError(spec.name, result.error)  # type: ignore[arg-type]
+        elif spec.kind is TaskKind.ACTOR_TASK:
+            error = RayActorError(f"actor task {spec.name} failed: {result.error!r}")
+        else:
+            error = RayTaskError(spec.name, result.error)  # type: ignore[arg-type]
+        self._fail_task(item, error)
+
+        # actor death detection from a crashed actor task
+        if spec.kind is TaskKind.ACTOR_TASK and not result.is_application_error:
+            actor = self.control_plane.get_actor(spec.actor_id)
+            if actor is not None and actor.state is ActorState.ALIVE:
+                self._on_actor_death(actor, result.error)
+
+    def _on_actor_death(self, actor: ActorInfo, cause: Optional[BaseException]) -> None:
+        if actor.num_restarts < actor.max_restarts:
+            self.control_plane.update_actor(actor.actor_id, ActorState.RESTARTING)
+            with self._lock:
+                spec = self._actor_specs.get(actor.actor_id)
+            if spec is not None:
+                spec.attempt += 1
+                logger.info("restarting actor %s (restart %d)",
+                            actor.actor_id.hex()[:8], actor.num_restarts)
+                self._enqueue_pending(_PendingTask(spec, retries_left=0, retry_exceptions=False))
+        else:
+            self.control_plane.update_actor(
+                actor.actor_id, ActorState.DEAD, death_cause=repr(cause)
+            )
+            self._kick_scheduler()
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        actor = self.control_plane.get_actor(actor_id)
+        if actor is None:
+            return
+        if actor.node_id is not None:
+            agent = self.agents.get(actor.node_id)
+            if agent is not None:
+                agent.kill_actor(actor_id)
+        if no_restart:
+            self.control_plane.update_actor(actor_id, ActorState.DEAD, death_cause="ray_tpu.kill")
+        else:
+            self._on_actor_death(actor, WorkerCrashedError("killed"))
+
+    def _fail_task(self, item: _PendingTask, error: BaseException) -> None:
+        self._mark_task(item.spec.task_id, "FAILED")
+        with self._lock:
+            futures = [self._futures.get(oid) for oid in item.spec.return_ids]
+        for fut in futures:
+            if fut is not None:
+                fut.error = error
+                fut.event.set()
+
+    def _mark_task(self, task_id: TaskID, state: str) -> None:
+        with self._lock:
+            if task_id in self._task_table:
+                self._task_table[task_id]["state"] = state
+
+    # --------------------------------------------------------- reconstruction
+    def _try_reconstruct(self, object_id: ObjectID) -> bool:
+        """Lineage-based recovery: re-run the task that produced the object."""
+        with self._lock:
+            spec = self._lineage.get(object_id)
+        if spec is None or spec.kind is not TaskKind.NORMAL:
+            return False
+        logger.info("reconstructing %s by re-executing %s", object_id, spec.name)
+        done = threading.Event()
+        outcome: Dict[str, Any] = {}
+
+        def on_done(result: TaskResult) -> None:
+            outcome["ok"] = result.ok
+            done.set()
+
+        spec.attempt += 1
+        item = _PendingTask(spec, retries_left=1, retry_exceptions=False)
+        # bypass futures (they are already set): place directly
+        placed = False
+        for _ in range(200):
+            try:
+                node_id = self.scheduler.select_node(spec, preferred_node=self.head_node_id)
+            except ValueError:
+                return False
+            if node_id is not None and node_id in self.agents:
+                self.agents[node_id].submit(spec, on_done)
+                placed = True
+                break
+            time.sleep(0.01)
+        if not placed:
+            return False
+        done.wait(timeout=60.0)
+        return bool(outcome.get("ok"))
+
+    # ------------------------------------------------------------- state API
+    def task_table(self) -> Dict[TaskID, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._task_table.items()}
+
+    # -------------------------------------------------------------- shutdown
+    def shutdown(self) -> None:
+        self.is_shutdown = True
+        self._kick_scheduler()
+        self.control_plane.finish_job(self.job_id)
+        with self._lock:
+            agents = list(self.agents.values())
+        for agent in agents:
+            agent.stop()
+
+
+_global_runtime: Optional[Runtime] = None
+
+
+def get_runtime() -> Runtime:
+    if _global_runtime is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init() first")
+    return _global_runtime
+
+
+def set_runtime(rt: Optional[Runtime]) -> None:
+    global _global_runtime
+    _global_runtime = rt
+
+
+def runtime_initialized() -> bool:
+    return _global_runtime is not None
+
+
+def _collect_deps(args: tuple, kwargs: dict) -> List[ObjectID]:
+    deps: List[ObjectID] = []
+    for v in list(args) + list(kwargs.values()):
+        if isinstance(v, ObjectRef):
+            deps.append(v.object_id)
+    return deps

@@ -1,0 +1,64 @@
+"""Structured process logging.
+
+Equivalent of the reference's RAY_LOG/spdlog setup plus the per-session log
+directory convention (upstream ray `src/ray/util/logging.h :: RayLog`,
+`/tmp/ray/session_latest/logs/`): each process logs to stderr and to a
+per-process file under the session log dir, with component and worker context
+prefixed so a tail-aggregator can attribute lines.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+_SESSION_DIR: Optional[str] = None
+_FMT = "[%(asctime)s %(levelname).1s %(process)d %(name)s] %(message)s"
+
+
+def session_dir() -> str:
+    """Session directory (/tmp/ray_tpu/session_<ts> with a `latest` symlink)."""
+    global _SESSION_DIR
+    if _SESSION_DIR is None:
+        base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+        stamp = time.strftime("session_%Y%m%d_%H%M%S") + f"_{os.getpid()}"
+        path = os.path.join(base, stamp)
+        os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+        latest = os.path.join(base, "session_latest")
+        try:
+            if os.path.islink(latest) or os.path.exists(latest):
+                os.remove(latest)
+            os.symlink(path, latest)
+        except OSError:
+            pass
+        _SESSION_DIR = path
+    return _SESSION_DIR
+
+
+def log_dir() -> str:
+    return os.path.join(session_dir(), "logs")
+
+
+def get_logger(component: str, to_file: bool = True) -> logging.Logger:
+    logger = logging.getLogger(f"ray_tpu.{component}")
+    if getattr(logger, "_ray_tpu_configured", False):
+        return logger
+    logger.setLevel(os.environ.get("RAY_TPU_LOG_LEVEL", "INFO").upper())
+    formatter = logging.Formatter(_FMT)
+    stream = logging.StreamHandler(sys.stderr)
+    stream.setFormatter(formatter)
+    logger.addHandler(stream)
+    if to_file:
+        try:
+            path = os.path.join(log_dir(), f"{component}_{os.getpid()}.log")
+            fh = logging.FileHandler(path)
+            fh.setFormatter(formatter)
+            logger.addHandler(fh)
+        except OSError:
+            pass
+    logger.propagate = False
+    logger._ray_tpu_configured = True  # type: ignore[attr-defined]
+    return logger

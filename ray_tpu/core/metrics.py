@@ -1,0 +1,170 @@
+"""Tagged metrics with Prometheus text exposition.
+
+Equivalent of the reference's metric pipeline (upstream ray
+`src/ray/stats/metric.h :: stats::Metric`, `metric_defs.cc`, and the Python
+`ray/util/metrics.py :: Counter/Gauge/Histogram`): one registry per process,
+metrics carry tag sets, and the whole registry renders to the Prometheus text
+format for scraping by the node agent.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry"]
+
+TagMap = Tuple[Tuple[str, str], ...]
+
+
+def _tags(tags: Optional[Dict[str, str]]) -> TagMap:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "", registry_: "MetricsRegistry | None" = None):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        (registry_ or registry).register(self)
+
+    def samples(self) -> Iterable[Tuple[str, TagMap, float]]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", registry_=None):
+        self._values: Dict[TagMap, float] = {}
+        super().__init__(name, description, registry_)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(_tags(tags), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", registry_=None):
+        self._values: Dict[TagMap, float] = {}
+        super().__init__(name, description, registry_)
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_tags(tags)] = float(value)
+
+    def add(self, delta: float, tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def get(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._values.get(_tags(tags), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 300)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", buckets: Sequence[float] = _DEFAULT_BUCKETS, registry_=None):
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[TagMap, List[int]] = {}
+        self._sums: Dict[TagMap, float] = {}
+        self._totals: Dict[TagMap, int] = {}
+        super().__init__(name, description, registry_)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags(tags)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect.bisect_left(self.buckets, value)
+            if idx < len(counts):
+                counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, tags: Optional[Dict[str, str]] = None) -> int:
+        with self._lock:
+            return self._totals.get(_tags(tags), 0)
+
+    def sum(self, tags: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._sums.get(_tags(tags), 0.0)
+
+    def samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                cumulative = 0
+                for bound, c in zip(self.buckets, counts):
+                    cumulative += c
+                    out.append(
+                        (f"{self.name}_bucket", key + (("le", repr(bound)),), float(cumulative))
+                    )
+                out.append((f"{self.name}_bucket", key + (("le", "+Inf"),), float(self._totals[key])))
+                out.append((f"{self.name}_sum", key, self._sums[key]))
+                out.append((f"{self.name}_count", key, float(self._totals[key])))
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> None:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and existing is not metric:
+                raise ValueError(f"metric already registered: {metric.name}")
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def render_prometheus(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda m: m.name):
+            if m.description:
+                lines.append(f"# HELP {m.name} {m.description}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, tags, value in m.samples():
+                if tags:
+                    tag_str = ",".join(f'{k}="{v}"' for k, v in tags)
+                    lines.append(f"{name}{{{tag_str}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+registry = MetricsRegistry()

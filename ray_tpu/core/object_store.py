@@ -1,0 +1,222 @@
+"""Per-node object store: immutable create/seal/get semantics.
+
+TPU-native equivalent of the reference's Plasma store + LocalObjectManager
+(upstream ray `src/ray/object_manager/plasma/store.cc :: ObjectStore`,
+`object_lifecycle_manager.cc`, spilling in `raylet/local_object_manager.cc`):
+objects are sealed-once-then-immutable, pinned while referenced, LRU-evicted
+to a disk spill directory under memory pressure, and restored on demand.
+
+Two backends share one interface:
+  * ``MemoryObjectStore`` — python-heap store used by in-process nodes (the
+    common case for thread-pool workers; JAX arrays stay as device buffers
+    and are NOT copied through the store — see ``ray_tpu.core.serialization``).
+  * The C++ shared-memory store (``ray_tpu/core/_shm``) — mmap'd host shm for
+    cross-process zero-copy, bound via ctypes (see shm_store.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from .config import config
+from .ids import ObjectID
+from .logging import get_logger
+
+logger = get_logger("object_store")
+
+
+class ObjectStoreFullError(RuntimeError):
+    pass
+
+
+class ObjectLostError(RuntimeError):
+    def __init__(self, object_id: ObjectID, reason: str = "object lost"):
+        super().__init__(f"{reason}: {object_id}")
+        self.object_id = object_id
+
+
+@dataclass
+class _Entry:
+    value: Any
+    nbytes: int
+    sealed: bool = True
+    pin_count: int = 0
+    spilling: bool = False  # disk write in flight (value still readable)
+    spilled_path: Optional[str] = None
+    created_at: float = field(default_factory=time.monotonic)
+
+
+class MemoryObjectStore:
+    """Single-node store with pinning, LRU eviction and disk spill."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None, spill_dir: Optional[str] = None):
+        if capacity_bytes is None:
+            capacity_bytes = config.object_store_memory_bytes or 2 * 1024**3
+        self.capacity = capacity_bytes
+        self.spill_dir = spill_dir or config.object_store_fallback_dir
+        self._lock = threading.Condition()
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._used = 0
+        self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
+
+    # -- size accounting ----------------------------------------------------
+    @staticmethod
+    def sizeof(value: Any) -> int:
+        try:
+            import numpy as np
+
+            if isinstance(value, np.ndarray):
+                return int(value.nbytes)
+        except ImportError:
+            pass
+        nbytes = getattr(value, "nbytes", None)
+        if isinstance(nbytes, int):
+            return nbytes
+        try:
+            return len(pickle.dumps(value, protocol=5))
+        except Exception:
+            return 1024  # unpicklable (actor handles etc.) — nominal size
+
+    # -- primary API --------------------------------------------------------
+    def put(self, object_id: ObjectID, value: Any, nbytes: Optional[int] = None) -> None:
+        size = nbytes if nbytes is not None else self.sizeof(value)
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity {self.capacity}"
+            )
+        while True:
+            victim_id = None
+            with self._lock:
+                if object_id in self._entries:
+                    return  # idempotent seal (retries)
+                if self._used + size <= self.capacity:
+                    self._entries[object_id] = _Entry(value=value, nbytes=size)
+                    self._used += size
+                    callbacks = self._waiters.pop(object_id, [])
+                    self._lock.notify_all()
+                    break
+                for oid, entry in self._entries.items():  # oldest first
+                    if (entry.pin_count == 0 and not entry.spilling
+                            and entry.spilled_path is None):
+                        victim_id = oid
+                        entry.spilling = True
+                        victim_value = entry.value
+                        break
+                if victim_id is None:
+                    raise ObjectStoreFullError(
+                        f"store full ({self._used}B used, {size}B requested) and "
+                        "all objects are pinned or spilling"
+                    )
+            # disk write happens OUTSIDE the lock: gets/puts proceed meanwhile
+            path = self._write_spill_file(victim_id, victim_value)
+            with self._lock:
+                entry = self._entries.get(victim_id)
+                if entry is not None and entry.spilling:
+                    entry.spilling = False
+                    entry.spilled_path = path
+                    entry.value = None
+                    self._used -= entry.nbytes
+                    logger.debug("spilled %s (%d bytes) to %s", victim_id, entry.nbytes, path)
+                else:  # deleted concurrently — discard the file
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        for cb in callbacks:
+            cb()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while object_id not in self._entries:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"timed out waiting for {object_id}")
+                self._lock.wait(timeout=remaining if remaining is None else min(remaining, 0.1))
+            entry = self._entries[object_id]
+            self._entries.move_to_end(object_id)  # LRU touch
+            value = entry.value
+            path = entry.spilled_path
+        if value is not None or path is None:
+            return value
+        # restore from disk OUTSIDE the lock
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def on_available(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
+        """Invoke callback once the object is sealed (immediately if already)."""
+        with self._lock:
+            if object_id in self._entries:
+                ready = True
+            else:
+                ready = False
+                self._waiters.setdefault(object_id, []).append(callback)
+        if ready:
+            callback()
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._entries:
+                self._entries[object_id].pin_count += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry.pin_count > 0:
+                entry.pin_count -= 1
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            path = None
+            if entry is not None:
+                # spilled entries already gave their bytes back at spill time
+                if entry.spilled_path is None:
+                    self._used -= entry.nbytes
+                entry.spilling = False  # in-flight spill finalizer will no-op
+                path = entry.spilled_path
+        if path:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def object_ids(self) -> Set[ObjectID]:
+        with self._lock:
+            return set(self._entries.keys())
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            spilled = sum(1 for e in self._entries.values() if e.spilled_path)
+            return {
+                "num_objects": len(self._entries),
+                "used_bytes": self._used,
+                "capacity_bytes": self.capacity,
+                "num_spilled": spilled,
+            }
+
+    # -- eviction / spill ---------------------------------------------------
+    def _write_spill_file(self, object_id: ObjectID, value: Any) -> str:
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            pickle.dump(value, f, protocol=5)
+        return path
+
+    def notify_all(self) -> None:
+        with self._lock:
+            self._lock.notify_all()

@@ -1,0 +1,116 @@
+"""Task specifications and scheduling strategies.
+
+Equivalent of the reference's TaskSpec (upstream ray
+`src/ray/common/task/task_spec.h :: TaskSpecification`,
+`python/ray/util/scheduling_strategies.py`): the unit handed from a submitting
+worker to the scheduler. TPU-native addition: resource shapes may carry an ICI
+topology request (``TopologyRequest``) instead of a scalar chip count.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskKind(enum.Enum):
+    NORMAL = "normal"
+    ACTOR_CREATION = "actor_creation"
+    ACTOR_TASK = "actor_task"
+
+
+@dataclass(frozen=True)
+class TopologyRequest:
+    """A TPU sub-slice request with an ICI topology shape, e.g. (2, 2, 4).
+
+    The scheduler packs these onto the torus without fragmenting it — the
+    TPU-native replacement for the reference's scalar ``num_gpus``.
+    """
+
+    shape: Tuple[int, ...]
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+
+@dataclass(frozen=True)
+class SchedulingStrategy:
+    """Base: DEFAULT hybrid policy."""
+
+
+@dataclass(frozen=True)
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass(frozen=True)
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: NodeID = None  # type: ignore[assignment]
+    soft: bool = False
+
+
+@dataclass(frozen=True)
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group_id: PlacementGroupID = None  # type: ignore[assignment]
+    bundle_index: int = -1
+
+
+@dataclass
+class TaskOptions:
+    """User-settable knobs from ``@remote(...)`` / ``.options(...)``."""
+
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    topology: Optional[TopologyRequest] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: Optional[int] = None
+    retry_exceptions: bool = False
+    max_restarts: int = 0  # actors only
+    max_task_retries: int = 0  # actors only
+    num_returns: int = 1
+    name: str = ""
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: Optional[Dict[str, Any]] = None
+    max_concurrency: int = 1  # actors only
+
+    def resource_demand(self) -> Dict[str, float]:
+        demand = dict(self.resources)
+        if self.num_cpus:
+            demand["CPU"] = demand.get("CPU", 0.0) + self.num_cpus
+        if self.num_tpus:
+            demand["TPU"] = demand.get("TPU", 0.0) + self.num_tpus
+        if self.topology is not None:
+            demand["TPU"] = demand.get("TPU", 0.0) + self.topology.num_chips
+        return demand
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    kind: TaskKind
+    func: Optional[Callable[..., Any]]  # None for cross-process (pickled) specs
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    options: TaskOptions
+    return_ids: List[ObjectID]
+    actor_id: ActorID = field(default_factory=ActorID.nil)
+    method_name: str = ""
+    # ObjectIDs this task depends on (plasma-stored args), for the resolver.
+    dependencies: List[ObjectID] = field(default_factory=list)
+    attempt: int = 0
+
+    @property
+    def name(self) -> str:
+        if self.options.name:
+            return self.options.name
+        if self.func is not None:
+            return getattr(self.func, "__qualname__", repr(self.func))
+        return self.method_name or "task"

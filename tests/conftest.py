@@ -1,0 +1,45 @@
+"""Test fixtures.
+
+SPMD tests run against a virtual 8-device CPU mesh (the reference's
+fake-cluster testing pattern adapted to TPU: SURVEY.md §4.3) — env must be
+set before jax initializes its backends.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True)
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest should provide 8 virtual devices"
+    return devices[:8]

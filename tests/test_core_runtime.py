@@ -1,0 +1,346 @@
+"""Tests for the task/actor runtime: the reference's core API surface
+(SURVEY.md §3.2/§3.3 call stacks) exercised through ray_tpu."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.control_plane import ActorState
+
+
+class TestTasks:
+    def test_task_round_trip(self, ray_start_regular):
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(1, 2)) == 3
+
+    def test_task_chaining_refs_as_args(self, ray_start_regular):
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        ref = square.remote(3)
+        ref2 = square.remote(ref)  # dependency resolution
+        assert ray_tpu.get(ref2) == 81
+
+    def test_parallel_tasks(self, ray_start_regular):
+        @ray_tpu.remote
+        def slow(i):
+            time.sleep(0.05)
+            return i
+
+        start = time.monotonic()
+        refs = [slow.remote(i) for i in range(8)]
+        assert ray_tpu.get(refs) == list(range(8))
+        # 8 x 50ms tasks on 8 CPUs should overlap
+        assert time.monotonic() - start < 0.4
+
+    def test_num_returns(self, ray_start_regular):
+        @ray_tpu.remote(num_returns=2)
+        def two():
+            return 1, 2
+
+        r1, r2 = two.remote()
+        assert ray_tpu.get(r1) == 1
+        assert ray_tpu.get(r2) == 2
+
+    def test_application_error_raises_on_get(self, ray_start_regular):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("bad")
+
+        with pytest.raises(ray_tpu.RayTaskError) as e:
+            ray_tpu.get(boom.remote())
+        assert isinstance(e.value.cause, ValueError)
+
+    def test_retry_exceptions(self, ray_start_regular):
+        state = {"n": 0}
+
+        @ray_tpu.remote(retry_exceptions=True, max_retries=3)
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        assert ray_tpu.get(flaky.remote()) == "ok"
+        assert state["n"] == 3
+
+    def test_put_get(self, ray_start_regular):
+        arr = np.arange(100)
+        ref = ray_tpu.put(arr)
+        np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+
+    def test_put_ref_as_task_arg(self, ray_start_regular):
+        ref = ray_tpu.put(10)
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        assert ray_tpu.get(double.remote(ref)) == 20
+
+    def test_wait(self, ray_start_regular):
+        @ray_tpu.remote
+        def fast():
+            return 1
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(1.0)
+            return 2
+
+        f, s = fast.remote(), slow.remote()
+        ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=5)
+        assert ready == [f]
+        assert pending == [s]
+
+    def test_get_timeout(self, ray_start_regular):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(5)
+
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            ray_tpu.get(slow.remote(), timeout=0.1)
+
+    def test_calling_remote_fn_directly_fails(self, ray_start_regular):
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        with pytest.raises(TypeError):
+            f()
+
+    def test_infeasible_task_fails_fast(self, ray_start_regular):
+        @ray_tpu.remote(num_cpus=10_000)
+        def huge():
+            return 1
+
+        with pytest.raises(Exception):
+            ray_tpu.get(huge.remote(), timeout=5)
+
+
+class TestActors:
+    def test_actor_round_trip(self, ray_start_regular):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start=0):
+                self.n = start
+
+            def inc(self, k=1):
+                self.n += k
+                return self.n
+
+        c = Counter.remote(10)
+        assert ray_tpu.get(c.inc.remote()) == 11
+        assert ray_tpu.get(c.inc.remote(5)) == 16
+
+    def test_actor_ordering(self, ray_start_regular):
+        @ray_tpu.remote
+        class Appender:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+
+            def get(self):
+                return self.items
+
+        a = Appender.remote()
+        for i in range(20):
+            a.add.remote(i)
+        assert ray_tpu.get(a.get.remote()) == list(range(20))
+
+    def test_named_actor(self, ray_start_regular):
+        @ray_tpu.remote
+        class Store:
+            def ping(self):
+                return "pong"
+
+        Store.options(name="kv").remote()
+        handle = ray_tpu.get_actor("kv")
+        assert ray_tpu.get(handle.ping.remote()) == "pong"
+
+    def test_duplicate_name_rejected(self, ray_start_regular):
+        @ray_tpu.remote
+        class A:
+            pass
+
+        A.options(name="dup").remote()
+        with pytest.raises(ValueError):
+            A.options(name="dup").remote()
+
+    def test_actor_init_failure(self, ray_start_regular):
+        @ray_tpu.remote
+        class Bad:
+            def __init__(self):
+                raise RuntimeError("init failed")
+
+            def m(self):
+                return 1
+
+        b = Bad.remote()
+        with pytest.raises(Exception):
+            ray_tpu.get(b.m.remote(), timeout=10)
+
+    def test_kill_actor(self, ray_start_regular):
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        ray_tpu.kill(a)
+        with pytest.raises(Exception):
+            ray_tpu.get(a.ping.remote(), timeout=10)
+
+    def test_actor_handle_passed_to_task(self, ray_start_regular):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        @ray_tpu.remote
+        def use(handle):
+            return ray_tpu.get(handle.inc.remote())
+
+        c = Counter.remote()
+        assert ray_tpu.get(use.remote(c)) == 1
+
+    def test_max_concurrency(self, ray_start_regular):
+        @ray_tpu.remote(max_concurrency=4)
+        class Par:
+            def slow(self):
+                time.sleep(0.1)
+                return 1
+
+        p = Par.remote()
+        start = time.monotonic()
+        refs = [p.slow.remote() for _ in range(4)]
+        assert sum(ray_tpu.get(refs)) == 4
+        assert time.monotonic() - start < 0.35
+
+
+class TestClusterAndFaults:
+    def test_spread_across_nodes(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        for _ in range(3):
+            cluster.add_node(resources={"CPU": 4.0})
+
+        @ray_tpu.remote(scheduling_strategy=ray_tpu.SpreadSchedulingStrategy(), num_cpus=1)
+        def where():
+            import threading
+
+            return threading.get_ident()
+
+        refs = [where.remote() for _ in range(16)]
+        assert len(ray_tpu.get(refs)) == 16
+
+    def test_custom_resource_scheduling(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_node(resources={"CPU": 4.0, "special": 1.0})
+
+        @ray_tpu.remote(resources={"special": 1.0})
+        def task():
+            return "ran"
+
+        assert ray_tpu.get(task.remote(), timeout=10) == "ran"
+
+    def test_tpu_resource_on_fake_slice(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_slice(num_hosts=2, chips_per_host=4)
+        assert ray_tpu.cluster_resources().get("TPU", 0) == 8.0
+
+        @ray_tpu.remote(num_tpus=4)
+        def tpu_task():
+            return "on-slice"
+
+        assert ray_tpu.get(tpu_task.remote(), timeout=10) == "on-slice"
+
+    def test_task_retry_on_node_death(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        victim = cluster.add_node(resources={"CPU": 4.0, "victim": 1.0})
+
+        @ray_tpu.remote(resources={"victim": 1.0}, num_cpus=0, max_retries=0)
+        def waits():
+            time.sleep(0.3)
+            return "done"
+
+        ref = waits.remote()
+        time.sleep(0.1)
+        cluster.remove_node(victim)  # crash mid-run; no retries -> error
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=10)
+
+    def test_object_survives_on_other_node(self, ray_start_cluster):
+        cluster = ray_start_cluster
+
+        @ray_tpu.remote
+        def produce():
+            return np.ones(10)
+
+        ref = produce.remote()
+        np.testing.assert_array_equal(ray_tpu.get(ref, timeout=10), np.ones(10))
+
+    def test_lineage_reconstruction(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        victim = cluster.add_node(resources={"CPU": 4.0, "victim": 1.0})
+
+        @ray_tpu.remote(resources={"victim": 0.5}, num_cpus=0)
+        def produce():
+            return "precious"
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref, timeout=10) == "precious"
+        # replace capacity so reconstruction has somewhere to run
+        cluster.add_node(resources={"CPU": 4.0, "victim": 1.0})
+        cluster.remove_node(victim)  # object lost with the node
+        assert ray_tpu.get(ref, timeout=30) == "precious"
+
+    def test_actor_restart_on_node_death(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        victim = cluster.add_node(resources={"CPU": 4.0, "actorhome": 1.0})
+        cluster.add_node(resources={"CPU": 4.0, "actorhome": 1.0})
+
+        @ray_tpu.remote(resources={"actorhome": 0.5}, num_cpus=0, max_restarts=2)
+        class Phoenix:
+            def ping(self):
+                return "alive"
+
+        p = Phoenix.remote()
+        assert ray_tpu.get(p.ping.remote(), timeout=10) == "alive"
+        cluster.remove_node(victim)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                assert ray_tpu.get(p.ping.remote(), timeout=5) == "alive"
+                break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            pytest.fail("actor did not restart")
+
+
+class TestStateAPI:
+    def test_task_table_and_snapshot(self, ray_start_regular):
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote())
+        rt = ray_start_regular
+        table = rt.task_table()
+        assert any(v["state"] == "FINISHED" for v in table.values())
+        snap = rt.control_plane.snapshot()
+        assert len(snap["nodes"]) == 1
+        assert snap["nodes"][0]["state"] == "ALIVE"

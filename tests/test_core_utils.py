@@ -1,0 +1,174 @@
+"""Tests for ids, config, metrics, object store."""
+
+import os
+
+import pytest
+
+from ray_tpu.core.config import Config, config, describe_flags
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.metrics import Counter, Gauge, Histogram, MetricsRegistry
+from ray_tpu.core.object_store import (
+    MemoryObjectStore,
+    ObjectStoreFullError,
+)
+
+
+class TestIDs:
+    def test_sizes_and_uniqueness(self):
+        ids = {TaskID.of() for _ in range(100)}
+        assert len(ids) == 100
+        assert all(len(t.binary()) == TaskID.SIZE for t in ids)
+
+    def test_ownership_embedding(self):
+        job = JobID.from_int(7)
+        actor = ActorID.of(job)
+        assert actor.job_id() == job
+        task = TaskID.of(actor)
+        assert task.actor_id() == actor
+        assert task.is_actor_task()
+        normal = TaskID.of()
+        assert not normal.is_actor_task()
+
+    def test_object_id_round_trip(self):
+        task = TaskID.of()
+        oid = ObjectID.for_task_return(task, 3)
+        assert oid.task_id() == task
+        assert oid.index() == 3
+        assert not oid.is_put()
+        put = ObjectID.for_put(task, 9)
+        assert put.is_put()
+        assert put.index() == 9
+
+    def test_hex_round_trip(self):
+        t = TaskID.of()
+        assert TaskID.from_hex(t.hex()) == t
+
+    def test_nil(self):
+        assert ActorID.nil().is_nil()
+        assert not ActorID.of(JobID.from_int(1)).is_nil()
+
+
+class TestConfig:
+    def test_defaults_and_env_precedence(self, monkeypatch):
+        assert config.task_max_retries == 3
+        monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "7")
+        assert config.task_max_retries == 7
+
+    def test_override_precedence(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "7")
+        config.apply_overrides({"task_max_retries": 11})
+        try:
+            assert config.task_max_retries == 11
+        finally:
+            config.reset()
+
+    def test_unknown_flag_rejected(self):
+        with pytest.raises(KeyError):
+            config.apply_overrides({"not_a_flag": 1})
+        with pytest.raises(KeyError):
+            config.get("nope")
+
+    def test_bool_parsing(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_LOG_TO_DRIVER", "false")
+        assert config.log_to_driver is False
+
+    def test_describe(self):
+        flags = describe_flags()
+        assert "task_max_retries" in flags
+        assert flags["worker_pool_size"]["doc"]
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = Counter("t_count", "d", registry_=reg)
+        c.inc(2, {"k": "a"})
+        c.inc(3, {"k": "a"})
+        assert c.get({"k": "a"}) == 5
+        g = Gauge("t_gauge", registry_=reg)
+        g.set(1.5)
+        g.add(0.5)
+        assert g.get() == 2.0
+        h = Histogram("t_hist", buckets=[0.1, 1, 10], registry_=reg)
+        h.observe(0.05)
+        h.observe(5)
+        assert h.count() == 2
+        assert h.sum() == pytest.approx(5.05)
+        text = reg.render_prometheus()
+        assert "t_count" in text and 't_hist_bucket' in text and "# TYPE t_gauge gauge" in text
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        c = Counter("neg", registry_=reg)
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+
+class TestObjectStore:
+    def _oid(self):
+        return ObjectID.for_task_return(TaskID.of(), 0)
+
+    def test_put_get(self):
+        store = MemoryObjectStore(capacity_bytes=1 << 20)
+        oid = self._oid()
+        store.put(oid, {"x": 1})
+        assert store.get(oid) == {"x": 1}
+        assert store.contains(oid)
+
+    def test_get_blocks_until_put(self):
+        import threading
+
+        store = MemoryObjectStore(capacity_bytes=1 << 20)
+        oid = self._oid()
+        result = {}
+
+        def getter():
+            result["v"] = store.get(oid, timeout=5)
+
+        t = threading.Thread(target=getter)
+        t.start()
+        store.put(oid, 42)
+        t.join(timeout=5)
+        assert result["v"] == 42
+
+    def test_get_timeout(self):
+        store = MemoryObjectStore(capacity_bytes=1 << 20)
+        with pytest.raises(TimeoutError):
+            store.get(self._oid(), timeout=0.05)
+
+    def test_spill_and_restore(self, tmp_path):
+        import numpy as np
+
+        store = MemoryObjectStore(capacity_bytes=4096, spill_dir=str(tmp_path))
+        a, b = self._oid(), self._oid()
+        arr1 = np.arange(512, dtype=np.int32)  # 2KB
+        arr2 = np.arange(768, dtype=np.int32)  # 3KB -> forces spill of arr1
+        store.put(a, arr1)
+        store.put(b, arr2)
+        assert (store.get(a) == arr1).all()  # restored from disk
+        assert store.stats()["num_spilled"] == 1
+
+    def test_pinned_objects_not_spilled(self, tmp_path):
+        import numpy as np
+
+        store = MemoryObjectStore(capacity_bytes=4096, spill_dir=str(tmp_path))
+        a = self._oid()
+        store.put(a, np.zeros(768, dtype=np.int32))
+        store.pin(a)
+        with pytest.raises(ObjectStoreFullError):
+            store.put(self._oid(), np.zeros(768, dtype=np.int32))
+        store.unpin(a)
+
+    def test_oversized_object_rejected(self):
+        store = MemoryObjectStore(capacity_bytes=128)
+        with pytest.raises(ObjectStoreFullError):
+            store.put(self._oid(), b"x" * 1024)
+
+    def test_delete_frees_memory(self):
+        store = MemoryObjectStore(capacity_bytes=1 << 20)
+        oid = self._oid()
+        store.put(oid, b"x" * 1000)
+        used = store.used_bytes()
+        store.delete(oid)
+        assert store.used_bytes() < used
+        assert not store.contains(oid)
