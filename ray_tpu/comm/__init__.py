@@ -1,0 +1,12 @@
+"""Communication: device meshes (XLA collectives over ICI) + host collectives."""
+
+from .bootstrap import init_distributed  # noqa: F401
+from .host_collectives import CollectiveGroup  # noqa: F401
+from .mesh import (  # noqa: F401
+    AXIS_ORDER,
+    MeshSpec,
+    build_mesh,
+    get_mesh,
+    registry,
+    set_mesh,
+)

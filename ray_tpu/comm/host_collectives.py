@@ -1,0 +1,101 @@
+"""Host-side (control-plane) collectives: barrier, broadcast, allgather.
+
+Equivalent of the reference's GLOO/CPU side of ray.util.collective (upstream
+ray `python/ray/util/collective/collective_group/gloo_collective_group.py`):
+device tensors use XLA collectives compiled into programs; *host* coordination
+(gang barriers, config broadcast, rendezvous of per-host metadata) uses these
+actor-backed primitives over the task runtime instead of a gloo ring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import api as _api
+from ..core.logging import get_logger
+
+logger = get_logger("host_collectives")
+
+
+class _RendezvousState:
+    """Actor state for one named collective group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.barrier_gen = 0
+        self.barrier_count = 0
+        self.slots: Dict[int, Dict[int, Any]] = {}  # round -> rank -> payload
+        self.round = 0
+
+    def arrive(self) -> int:
+        self.barrier_count += 1
+        if self.barrier_count == self.world_size:
+            self.barrier_count = 0
+            self.barrier_gen += 1
+        return self.barrier_gen
+
+    def generation(self) -> int:
+        return self.barrier_gen
+
+    def put(self, round_id: int, rank: int, payload: Any) -> None:
+        self.slots.setdefault(round_id, {})[rank] = payload
+
+    def gathered(self, round_id: int) -> Optional[List[Any]]:
+        slot = self.slots.get(round_id, {})
+        if len(slot) == self.world_size:
+            return [slot[r] for r in sorted(slot)]
+        return None
+
+
+class CollectiveGroup:
+    """Client handle: each participant constructs one with its rank."""
+
+    def __init__(self, name: str, world_size: int, rank: int):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._actor = self._get_or_create(name, world_size)
+        self._round = 0
+
+    @staticmethod
+    def _get_or_create(name: str, world_size: int):
+        actor_name = f"_collective_{name}"
+        try:
+            return _api.get_actor(actor_name)
+        except ValueError:
+            try:
+                return _api.remote(_RendezvousState).options(
+                    name=actor_name, num_cpus=0
+                ).remote(world_size)
+            except ValueError:
+                return _api.get_actor(actor_name)  # lost the creation race
+
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        target = _api.get(self._actor.generation.remote()) + 1
+        _api.get(self._actor.arrive.remote())
+        deadline = time.monotonic() + timeout_s
+        while _api.get(self._actor.generation.remote()) < target:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"barrier timeout in group {self.name!r} (rank {self.rank})"
+                )
+            time.sleep(0.002)
+
+    def allgather(self, payload: Any, timeout_s: float = 60.0) -> List[Any]:
+        round_id = self._round
+        self._round += 1
+        _api.get(self._actor.put.remote(round_id, self.rank, payload))
+        deadline = time.monotonic() + timeout_s
+        while True:
+            out = _api.get(self._actor.gathered.remote(round_id))
+            if out is not None:
+                return out
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"allgather timeout in group {self.name!r}")
+            time.sleep(0.002)
+
+    def broadcast(self, payload: Any = None, root: int = 0, timeout_s: float = 60.0) -> Any:
+        gathered = self.allgather(payload if self.rank == root else None, timeout_s)
+        return gathered[root]

@@ -32,7 +32,12 @@ from .node_agent import (
 )
 from .object_store import ObjectLostError
 from .scheduler import ClusterScheduler
-from .task_spec import TaskKind, TaskOptions, TaskSpec
+from .task_spec import (
+    PlacementGroupSchedulingStrategy,
+    TaskKind,
+    TaskOptions,
+    TaskSpec,
+)
 
 logger = get_logger("core_worker")
 
@@ -134,6 +139,7 @@ class _PendingTask:
     retry_exceptions: bool
     submitted_at: float = field(default_factory=time.monotonic)
     target_node: Optional[NodeID] = None
+    pg_lease: Optional[Tuple[Any, int, Dict[str, float]]] = None
 
 
 class _Future:
@@ -178,6 +184,10 @@ class Runtime:
         self.control_plane.register_job(self.job_id)
         # placement group table: (pg_id, bundle_index) -> NodeID
         self.pg_table: Dict[Tuple, NodeID] = {}
+        from ..sched.placement_group import PlacementGroupManager  # lazy: cycle
+
+        self.pg_manager = PlacementGroupManager(self)
+        self._actor_pg: Dict[ActorID, Tuple[Any, int, Dict[str, float]]] = {}
 
     # ------------------------------------------------------------- topology
     def add_node(
@@ -454,6 +464,11 @@ class Runtime:
 
     def _try_place(self, item: _PendingTask) -> bool:
         spec = item.spec
+        strategy = spec.options.scheduling_strategy
+        if spec.kind is not TaskKind.ACTOR_TASK and isinstance(
+            strategy, PlacementGroupSchedulingStrategy
+        ):
+            return self._try_place_in_pg(item, strategy)
         if spec.kind is TaskKind.ACTOR_TASK:
             actor = self.control_plane.get_actor(spec.actor_id)
             if actor is None or actor.state is ActorState.DEAD:
@@ -489,15 +504,81 @@ class Runtime:
         agent.submit(spec, lambda result: self._on_task_done(item, result))
         return True
 
+    def _try_place_in_pg(self, item: _PendingTask, strategy) -> bool:
+        """Place a task into a placement-group bundle: consume bundle capacity
+        (not node capacity) and run on the bundle's reserved node."""
+        spec = item.spec
+        pg = self.pg_manager.get(strategy.placement_group_id)
+        if pg is None or not pg.created:
+            return False  # group still materializing
+        demand = spec.options.resource_demand()
+        indices = (
+            [strategy.bundle_index]
+            if strategy.bundle_index >= 0
+            else list(range(len(pg.bundles)))
+        )
+        # fail fast if no eligible bundle could EVER satisfy the demand
+        # (e.g. num_cpus=1 into a TPU-only bundle) instead of queueing forever
+        if not any(
+            all(pg.bundles[i].get(k, 0.0) >= v - 1e-9 for k, v in demand.items())
+            for i in indices
+            if 0 <= i < len(pg.bundles)
+        ):
+            self._fail_task(item, ValueError(
+                f"task {spec.name} demand {demand} exceeds placement-group "
+                f"bundle capacity {[pg.bundles[i] for i in indices if 0 <= i < len(pg.bundles)]}; "
+                "request only resources reserved by the bundle (hint: num_cpus=0 "
+                "for TPU-bundle tasks)"
+            ))
+            return True
+        for idx in indices:
+            if not pg.try_acquire(idx, demand):
+                continue
+            node_id = pg.bundle_node(idx)
+            agent = self.agents.get(node_id)
+            if agent is None:
+                pg.release(idx, demand)
+                continue
+            spec.skip_node_resources = True
+            item.target_node = node_id
+            item.pg_lease = (pg, idx, demand)
+            if spec.kind is TaskKind.ACTOR_CREATION:
+                self.control_plane.update_actor(spec.actor_id, ActorState.STARTING, node_id)
+            self._mark_task(spec.task_id, "RUNNING")
+            agent.submit(spec, lambda result: self._on_task_done(item, result))
+            return True
+        return False
+
     # ------------------------------------------------------------ completion
     def _on_task_done(self, item: _PendingTask, result: TaskResult) -> None:
         spec = item.spec
+        # was the actor killed while its __init__ was still running?
+        killed_during_init = False
+        if spec.kind is TaskKind.ACTOR_CREATION and result.ok:
+            actor = self.control_plane.get_actor(spec.actor_id)
+            killed_during_init = actor is None or actor.state is ActorState.DEAD
+        if item.pg_lease is not None:
+            pg, idx, demand = item.pg_lease
+            if spec.kind is TaskKind.ACTOR_CREATION and result.ok and not killed_during_init:
+                # actor keeps its bundle share until death
+                with self._lock:
+                    self._actor_pg[spec.actor_id] = item.pg_lease
+            else:
+                pg.release(idx, demand)
+            item.pg_lease = None
+            spec.skip_node_resources = False
         if result.ok:
             self._mark_task(spec.task_id, "FINISHED")
             if spec.kind is TaskKind.ACTOR_CREATION:
-                self.control_plane.update_actor(
-                    spec.actor_id, ActorState.ALIVE, item.target_node
-                )
+                if killed_during_init:
+                    # tear the fresh runner back down; DEAD stays DEAD
+                    agent = self.agents.get(item.target_node) if item.target_node else None
+                    if agent is not None:
+                        agent.kill_actor(spec.actor_id, cause="killed during creation")
+                else:
+                    self.control_plane.update_actor(
+                        spec.actor_id, ActorState.ALIVE, item.target_node
+                    )
                 self._kick_scheduler()  # pending method calls can now route
             with self._lock:
                 futures = [self._futures.get(oid) for oid in spec.return_ids]
@@ -548,6 +629,11 @@ class Runtime:
                 self._on_actor_death(actor, result.error)
 
     def _on_actor_death(self, actor: ActorInfo, cause: Optional[BaseException]) -> None:
+        with self._lock:
+            lease = self._actor_pg.pop(actor.actor_id, None)
+        if lease is not None:
+            pg, idx, demand = lease
+            pg.release(idx, demand)
         if actor.num_restarts < actor.max_restarts:
             self.control_plane.update_actor(actor.actor_id, ActorState.RESTARTING)
             with self._lock:
@@ -572,12 +658,24 @@ class Runtime:
             if agent is not None:
                 agent.kill_actor(actor_id)
         if no_restart:
+            with self._lock:
+                lease = self._actor_pg.pop(actor_id, None)
+            if lease is not None:
+                pg, idx, demand = lease
+                pg.release(idx, demand)
             self.control_plane.update_actor(actor_id, ActorState.DEAD, death_cause="ray_tpu.kill")
         else:
             self._on_actor_death(actor, WorkerCrashedError("killed"))
 
     def _fail_task(self, item: _PendingTask, error: BaseException) -> None:
         self._mark_task(item.spec.task_id, "FAILED")
+        if item.spec.kind is TaskKind.ACTOR_CREATION:
+            # a failed creation must kill the actor record, or pending method
+            # calls wait forever for a start that will never come
+            self.control_plane.update_actor(
+                item.spec.actor_id, ActorState.DEAD, death_cause=repr(error)
+            )
+            self._kick_scheduler()
         with self._lock:
             futures = [self._futures.get(oid) for oid in item.spec.return_ids]
         for fut in futures:

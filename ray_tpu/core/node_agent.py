@@ -197,7 +197,7 @@ class NodeAgent:
             if item is None:
                 return
             spec, done = item
-            demand = spec.options.resource_demand()
+            demand = {} if spec.skip_node_resources else spec.options.resource_demand()
             # Block-wait for resources on this worker lane; the cluster
             # scheduler already sized placement to the node's view.
             while not self.resources.try_acquire(demand):

@@ -106,6 +106,9 @@ class TaskSpec:
     # ObjectIDs this task depends on (plasma-stored args), for the resolver.
     dependencies: List[ObjectID] = field(default_factory=list)
     attempt: int = 0
+    # True when a placement-group bundle already holds the resources: the
+    # node agent must not double-acquire from the node ledger.
+    skip_node_resources: bool = False
 
     @property
     def name(self) -> str:

@@ -1,0 +1,13 @@
+"""Parallelism strategies: logical-axis sharding (DP/FSDP/TP), ring attention
+(SP), expert parallelism (EP), pipeline parallelism (PP)."""
+
+from .moe import aux_load_balance_loss, moe_layer_local, top_k_gating  # noqa: F401
+from .ring import ring_attention, ring_attention_local  # noqa: F401
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    constrain,
+    shard_tree,
+    sharding_for,
+    spec_for,
+    tree_shardings,
+)

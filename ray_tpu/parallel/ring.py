@@ -1,0 +1,113 @@
+"""Ring attention: sequence-parallel exact attention over the ICI ring.
+
+Net-new relative to the reference (SURVEY.md §5.7: Ray has no
+sequence/context parallelism; long context was delegated to vLLM /
+user code). Here it is first-class: the sequence axis is a mesh axis
+("sp"), each rank holds a sequence block, and KV blocks rotate around the
+ring via ``ppermute`` while a flash-style online softmax accumulates exact
+attention — memory per chip stays O(T/n), comms ride single-hop ICI links,
+and XLA overlaps the permute with the block matmuls.
+
+The blockwise compute maps onto the MXU as plain batched matmuls; a fused
+Pallas kernel for the per-block inner loop lives in ray_tpu.ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One KV block's contribution: returns (scores_max, exp_scores, pv).
+
+    q: [B, Tq, H, D]  k/v: [B, Tk, H, D]  mask: [Tq, Tk] bool (True = keep)
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [B,H,Tq]
+    p = jnp.exp(scores - m[..., None])
+    # fully-masked rows: m == _NEG_INF -> p rows are exp(0)=1; zero them
+    valid = m > _NEG_INF / 2
+    p = p * valid[..., None]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m, p.sum(axis=-1), pv
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-rank body — call inside ``shard_map`` with sequence split on
+    ``axis_name``. Shapes: q,k,v [B, T_local, H, D] → out [B, T_local, H, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+    q_pos = my_idx * Tq + jnp.arange(Tq)
+
+    def step(carry, s):
+        o, m, l, k_blk, v_blk = carry
+        src = (my_idx + s) % n  # which sequence block we currently hold
+        k_pos = src * Tk + jnp.arange(Tk)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((Tq, Tk), dtype=bool)
+        blk_m, blk_l, blk_pv = _block_attend(q, k_blk, v_blk, scale, mask)
+        m_new = jnp.maximum(m, blk_m)
+        # guard: both -inf (nothing seen yet AND fully-masked block)
+        alpha = jnp.exp(jnp.where(m > _NEG_INF / 2, m - m_new, _NEG_INF))
+        beta = jnp.exp(jnp.where(blk_m > _NEG_INF / 2, blk_m - m_new, _NEG_INF))
+        l_new = l * alpha + blk_l * beta
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + blk_pv * beta.transpose(0, 2, 1)[..., None]
+        # rotate KV to the next rank (ring over ICI neighbours)
+        perm = [(i, (i - 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros_like(q)
+    # derive init carries from q so they inherit its device-varying axes
+    # (scan requires carry in/out vma types to agree under shard_map)
+    zero_bhq = q[:, :, :, 0].transpose(0, 2, 1) * 0.0
+    m0 = zero_bhq + _NEG_INF
+    l0 = zero_bhq
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    l = jnp.maximum(l, 1e-20)  # rows with no visible keys (shouldn't happen causally)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Global-view entry: q,k,v [B, T, H, D] with T sharded over axis_name.
+
+    Wraps ring_attention_local in shard_map; batch follows the data axes if
+    present in the mesh.
+    """
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    batch_part = data_axes if data_axes else None
+    spec = PartitionSpec(batch_part, axis_name, None, None)
+    body = functools.partial(ring_attention_local, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -1,0 +1,116 @@
+"""Logical-axis sharding rules → GSPMD NamedShardings.
+
+The TPU-native replacement for everything the reference delegates to
+DDP/FSDP/DeepSpeed wrappers (upstream ray `python/ray/train/torch/
+train_loop_utils.py :: prepare_model` and the strategy plumbing in
+`torch_trainer.py`): parallelism is expressed once, as a mapping from
+*logical* array axes ("batch", "embed", "mlp", …) to *mesh* axes
+("dp", "fsdp", "tp", …), and XLA inserts the collectives. Changing
+DP → FSDP → TP → 3D is a rules change, not a code change (the
+weight-update-sharding design of arxiv 2004.13336).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+Rules = Dict[str, MeshAxes]
+
+# Default transformer rules (scaling-book conventions):
+#   batch over all data axes; params sharded over fsdp (ZeRO-3) and tp;
+#   sequence over sp for long-context; experts over ep.
+DEFAULT_RULES: Rules = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "expert_mlp": "tp",
+    "stage": "pp",
+    "norm": None,
+}
+
+
+def spec_for(axes: Sequence[Optional[str]], rules: Optional[Rules] = None) -> PartitionSpec:
+    """Logical axes of one array → PartitionSpec. None = replicated dim."""
+    rules = DEFAULT_RULES if rules is None else rules
+    parts = []
+    for ax in axes:
+        if ax is None:
+            parts.append(None)
+            continue
+        if ax not in rules:
+            raise KeyError(f"no sharding rule for logical axis {ax!r}")
+        parts.append(rules[ax])
+    return PartitionSpec(*parts)
+
+
+def _filter_spec_for_mesh(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Drop mesh axes the mesh doesn't have (size-1 semantics): lets one
+    rule set serve dp-only, fsdp+tp, full 3D meshes unchanged."""
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append(None)
+        elif isinstance(entry, str):
+            parts.append(entry if entry in mesh.axis_names else None)
+        else:
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            parts.append(kept if kept else None)
+    return PartitionSpec(*parts)
+
+
+def sharding_for(
+    axes: Sequence[Optional[str]],
+    mesh: Mesh,
+    rules: Optional[Rules] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, _filter_spec_for_mesh(spec_for(axes, rules), mesh))
+
+
+def tree_shardings(
+    axes_tree: Any, mesh: Mesh, rules: Optional[Rules] = None
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: sharding_for(axes, mesh, rules),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def constrain(x: jax.Array, axes: Sequence[Optional[str]], rules: Optional[Rules] = None) -> jax.Array:
+    """In-jit sharding constraint by logical axes (activation annotations)."""
+    mesh = _current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, sharding_for(axes, mesh, rules))
+
+
+def _current_mesh() -> Optional[Mesh]:
+    try:
+        env = jax._src.mesh.thread_resources.env  # set by `with mesh:`
+        if env.physical_mesh.devices.size > 0:
+            return env.physical_mesh
+    except Exception:
+        pass
+    from ..comm.mesh import registry
+
+    try:
+        return registry.get("default")
+    except Exception:
+        return None
+
+
+def shard_tree(params: Any, axes_tree: Any, mesh: Mesh, rules: Optional[Rules] = None) -> Any:
+    """Device-put a pytree of host arrays to its sharded layout."""
+    shardings = tree_shardings(axes_tree, mesh, rules)
+    return jax.tree.map(lambda p, s: jax.device_put(p, s), params, shardings)

@@ -1,0 +1,15 @@
+"""Topology-aware scheduling: ICI torus model, sub-slice packing, gangs."""
+
+from .placement_group import (  # noqa: F401
+    PlacementGroup,
+    PlacementGroupError,
+    placement_group,
+    remove_placement_group,
+)
+from .topology import (  # noqa: F401
+    GENERATIONS,
+    Allocation,
+    SliceTopology,
+    SubSlicePacker,
+    TpuGeneration,
+)

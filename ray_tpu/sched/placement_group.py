@@ -1,0 +1,222 @@
+"""Placement groups: gang resource reservation across nodes.
+
+Equivalent of the reference's placement groups (upstream ray
+`python/ray/util/placement_group.py :: placement_group()`, GCS-side
+`gcs_placement_group_manager.cc` / `gcs_placement_group_scheduler.cc` with
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD bundle policies): bundles of resources
+are reserved atomically on chosen nodes; tasks/actors scheduled with a
+``PlacementGroupSchedulingStrategy`` consume from the bundle, not the node.
+
+TPU-native addition: a bundle may be a ``TopologyRequest`` — the group then
+reserves a contiguous ICI sub-slice via ``SubSlicePacker`` so the gang's
+collectives stay on torus-adjacent links.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from ..core import core_worker as _cw
+from ..core.control_plane import NodeState
+from ..core.ids import NodeID, PlacementGroupID
+from ..core.logging import get_logger
+from ..core.node_agent import ResourceTracker
+from ..core.task_spec import TopologyRequest
+
+logger = get_logger("placement_group")
+
+Bundle = Union[Dict[str, float], TopologyRequest]
+
+
+class PlacementGroupError(RuntimeError):
+    pass
+
+
+@dataclass
+class PlacementGroup:
+    id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str
+    bundle_nodes: List[NodeID] = field(default_factory=list)
+    created: bool = False
+    # per-bundle usage trackers (tasks consume bundle capacity, not node)
+    _bundle_trackers: List[ResourceTracker] = field(default_factory=list)
+
+    def ready(self, timeout: Optional[float] = 30.0) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.created:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    def bundle_node(self, index: int) -> NodeID:
+        return self.bundle_nodes[index]
+
+    def try_acquire(self, bundle_index: int, demand: Dict[str, float]) -> bool:
+        if not self.created:
+            return False
+        return self._bundle_trackers[bundle_index].try_acquire(demand)
+
+    def release(self, bundle_index: int, demand: Dict[str, float]) -> None:
+        if 0 <= bundle_index < len(self._bundle_trackers):
+            self._bundle_trackers[bundle_index].release(demand)
+
+
+def _normalize_bundle(b: Bundle) -> Dict[str, float]:
+    if isinstance(b, TopologyRequest):
+        return {"TPU": float(b.num_chips)}
+    return dict(b)
+
+
+class PlacementGroupManager:
+    """Reserves bundles on nodes and keeps the (pg, bundle) -> node table the
+    cluster scheduler consults. Lives beside the Runtime (GCS role)."""
+
+    def __init__(self, runtime) -> None:
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._groups: Dict[PlacementGroupID, PlacementGroup] = {}
+
+    def create(self, bundles: Sequence[Bundle], strategy: str = "PACK") -> PlacementGroup:
+        if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+            raise ValueError(f"unknown placement strategy: {strategy}")
+        if not bundles:
+            raise ValueError("placement group needs at least one bundle")
+        norm = [_normalize_bundle(b) for b in bundles]
+        pg = PlacementGroup(PlacementGroupID.generate(), norm, strategy)
+        placement = self._place_bundles(norm, strategy)
+        if placement is None:
+            raise PlacementGroupError(
+                f"cannot place {len(norm)} bundles with strategy {strategy}: "
+                "insufficient cluster resources"
+            )
+        # acquire atomically: roll back on partial failure
+        acquired: List[Tuple[NodeID, Dict[str, float]]] = []
+        ok = True
+        for bundle, node_id in zip(norm, placement):
+            agent = self._rt.agents.get(node_id)
+            if agent is None or not agent.resources.try_acquire(bundle):
+                ok = False
+                break
+            acquired.append((node_id, bundle))
+        if not ok:
+            for node_id, bundle in acquired:
+                agent = self._rt.agents.get(node_id)
+                if agent is not None:
+                    agent.resources.release(bundle)
+            raise PlacementGroupError("bundle reservation raced; retry")
+        pg.bundle_nodes = list(placement)
+        pg._bundle_trackers = [ResourceTracker(b) for b in norm]
+        pg.created = True
+        with self._lock:
+            self._groups[pg.id] = pg
+        for i, node_id in enumerate(placement):
+            self._rt.pg_table[(pg.id, i)] = node_id
+        self._rt._kick_scheduler()
+        logger.info("placement group %s created: %s bundles via %s",
+                    pg.id.hex()[:8], len(norm), strategy)
+        return pg
+
+    def remove(self, pg: PlacementGroup) -> None:
+        with self._lock:
+            stored = self._groups.pop(pg.id, None)
+        if stored is None:
+            return
+        for bundle, node_id in zip(stored.bundles, stored.bundle_nodes):
+            agent = self._rt.agents.get(node_id)
+            if agent is not None:
+                agent.resources.release(bundle)
+        for i in range(len(stored.bundles)):
+            self._rt.pg_table.pop((pg.id, i), None)
+        stored.created = False
+
+    def get(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:
+        with self._lock:
+            return self._groups.get(pg_id)
+
+    # -- placement ----------------------------------------------------------
+    def _place_bundles(
+        self, bundles: List[Dict[str, float]], strategy: str
+    ) -> Optional[List[NodeID]]:
+        nodes = [n for n in self._rt.control_plane.alive_nodes()]
+        if not nodes:
+            return None
+        # work over a copy of each node's available view for what-if packing
+        avail: Dict[NodeID, Dict[str, float]] = {}
+        for n in nodes:
+            agent = self._rt.agents.get(n.node_id)
+            avail[n.node_id] = agent.resources.available() if agent else dict(n.resources_available)
+
+        def fits(node_id: NodeID, bundle: Dict[str, float]) -> bool:
+            a = avail[node_id]
+            return all(a.get(k, 0.0) >= v - 1e-9 for k, v in bundle.items())
+
+        def take(node_id: NodeID, bundle: Dict[str, float]) -> None:
+            a = avail[node_id]
+            for k, v in bundle.items():
+                a[k] = a.get(k, 0.0) - v
+
+        order = [n.node_id for n in nodes]
+        placement: List[NodeID] = []
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            if strategy == "STRICT_PACK":
+                for node_id in order:
+                    trial = dict(avail[node_id])
+                    ok = True
+                    for b in bundles:
+                        if not all(trial.get(k, 0.0) >= v - 1e-9 for k, v in b.items()):
+                            ok = False
+                            break
+                        for k, v in b.items():
+                            trial[k] = trial.get(k, 0.0) - v
+                    if ok:
+                        return [node_id] * len(bundles)
+                return None
+            for b in bundles:
+                chosen = None
+                # prefer nodes already used by this group (packing)
+                for node_id in list(dict.fromkeys(placement)) + order:
+                    if fits(node_id, b):
+                        chosen = node_id
+                        break
+                if chosen is None:
+                    return None
+                take(chosen, b)
+                placement.append(chosen)
+            return placement
+
+        # SPREAD / STRICT_SPREAD
+        for b in bundles:
+            chosen = None
+            unused = [n for n in order if n not in placement]
+            for node_id in unused + ([] if strategy == "STRICT_SPREAD" else order):
+                if fits(node_id, b):
+                    chosen = node_id
+                    break
+            if chosen is None:
+                return None
+            take(chosen, b)
+            placement.append(chosen)
+        return placement
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def placement_group(
+    bundles: Sequence[Bundle], strategy: str = "PACK"
+) -> PlacementGroup:
+    rt = _cw.get_runtime()
+    return rt.pg_manager.create(bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    rt = _cw.get_runtime()
+    rt.pg_manager.remove(pg)

@@ -17,6 +17,11 @@ os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
 
 import pytest  # noqa: E402
 
+import jax  # noqa: E402
+
+# exact f32 matmuls so numerical tests compare real math, not rounding modes
+jax.config.update("jax_default_matmul_precision", "highest")
+
 
 @pytest.fixture
 def ray_start_regular():

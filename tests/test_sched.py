@@ -1,0 +1,164 @@
+"""Tests for topology model, sub-slice packing, and placement groups."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy, TopologyRequest
+from ray_tpu.sched import (
+    PlacementGroupError,
+    SliceTopology,
+    SubSlicePacker,
+    placement_group,
+    remove_placement_group,
+)
+
+
+class TestSliceTopology:
+    def test_from_name(self):
+        t = SliceTopology.from_name("v5p-16")  # 8 chips
+        assert t.num_chips == 8
+        assert t.generation == "v5p"
+        assert len(t.shape) == 3
+
+    def test_hosts(self):
+        t = SliceTopology("v5p", (4, 4, 4))
+        assert t.num_chips == 64
+        assert t.num_hosts == 16
+        hosts = {t.host_of(c) for c in t.all_coords()}
+        assert len(hosts) == 16  # 2x2x1 blocks over 4x4x4
+
+    def test_2d_generation(self):
+        t = SliceTopology("v5e", (8, 8))
+        assert t.num_chips == 64
+        assert t.num_hosts == 16
+
+
+class TestSubSlicePacker:
+    def test_allocate_and_release(self):
+        packer = SubSlicePacker(SliceTopology("v5p", (4, 4, 4)))
+        out = packer.try_allocate((2, 2, 2))
+        assert out is not None
+        aid, alloc = out
+        assert alloc.num_chips == 8
+        assert packer.free_chips() == 56
+        packer.release(aid)
+        assert packer.free_chips() == 64
+
+    def test_packs_whole_torus_without_fragmentation(self):
+        packer = SubSlicePacker(SliceTopology("v5p", (4, 4, 4)))
+        ids = []
+        for _ in range(8):  # 8 x (2,2,2) = 64 chips exactly
+            out = packer.try_allocate((2, 2, 2))
+            assert out is not None
+            ids.append(out[0])
+        assert packer.free_chips() == 0
+        assert packer.try_allocate((1, 1, 1)) is None
+        packer.release(ids[0])
+        assert packer.try_allocate((2, 2, 2)) is not None
+
+    def test_permutes_request_to_fit(self):
+        packer = SubSlicePacker(SliceTopology("v5p", (2, 2, 8)))
+        # (8, 1, 1) only fits along z
+        out = packer.try_allocate((8, 1, 1))
+        assert out is not None
+        assert sorted(out[1].shape) == [1, 1, 8]
+
+    def test_rank_padding(self):
+        packer = SubSlicePacker(SliceTopology("v5p", (2, 2, 4)))
+        out = packer.try_allocate((4,))  # padded to (4,1,1) and permuted
+        assert out is not None
+        assert out[1].num_chips == 4
+
+    def test_infeasible_shape(self):
+        packer = SubSlicePacker(SliceTopology("v5p", (2, 2, 2)))
+        assert packer.try_allocate((4, 2, 2)) is None
+
+    def test_hosts_for_allocation(self):
+        topo = SliceTopology("v5p", (4, 4, 4))
+        packer = SubSlicePacker(topo)
+        _, alloc = packer.try_allocate((2, 2, 1))
+        hosts = packer.hosts_for(alloc)
+        assert len(hosts) == 1  # a 2x2x1 box is exactly one host's chips
+
+
+class TestPlacementGroups:
+    def test_pack_and_consume(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        for _ in range(2):
+            cluster.add_node(resources={"CPU": 4.0})
+        pg = placement_group([{"CPU": 2.0}, {"CPU": 2.0}], strategy="PACK")
+        assert pg.ready(timeout=10)
+        assert len(pg.bundle_nodes) == 2
+
+        @ray_tpu.remote(
+            num_cpus=1,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group_id=pg.id, bundle_index=0
+            ),
+        )
+        def inside():
+            return "in-pg"
+
+        assert ray_tpu.get(inside.remote(), timeout=10) == "in-pg"
+        remove_placement_group(pg)
+
+    def test_strict_spread_requires_distinct_nodes(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_node(resources={"CPU": 4.0})
+        # head + 1 node = 2 nodes; 3 strict-spread bundles must fail
+        with pytest.raises(PlacementGroupError):
+            placement_group([{"CPU": 1.0}] * 3, strategy="STRICT_SPREAD")
+        pg = placement_group([{"CPU": 1.0}] * 2, strategy="STRICT_SPREAD")
+        assert len(set(pg.bundle_nodes)) == 2
+        remove_placement_group(pg)
+
+    def test_strict_pack_single_node(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_node(resources={"CPU": 16.0})
+        pg = placement_group([{"CPU": 6.0}, {"CPU": 6.0}], strategy="STRICT_PACK")
+        assert len(set(pg.bundle_nodes)) == 1
+        remove_placement_group(pg)
+
+    def test_infeasible_pg_raises(self, ray_start_cluster):
+        with pytest.raises(PlacementGroupError):
+            placement_group([{"CPU": 10_000.0}])
+
+    def test_bundle_capacity_enforced(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_node(resources={"CPU": 8.0})
+        pg = placement_group([{"CPU": 1.0}])
+        assert pg.ready(timeout=10)
+        # bundle holds 1 CPU: two 1-CPU tasks must serialize through it
+        import time
+
+        @ray_tpu.remote(
+            num_cpus=1,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group_id=pg.id, bundle_index=0
+            ),
+        )
+        def hold():
+            time.sleep(0.2)
+            return time.monotonic()
+
+        t0 = time.monotonic()
+        a, b = hold.remote(), hold.remote()
+        ray_tpu.get([a, b], timeout=15)
+        assert time.monotonic() - t0 >= 0.4  # serialized, not parallel
+        remove_placement_group(pg)
+
+    def test_topology_bundle(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_slice(num_hosts=2, chips_per_host=4)
+        pg = placement_group([TopologyRequest((2, 2, 1))])
+        assert pg.ready(timeout=10)
+        assert pg.bundles[0] == {"TPU": 4.0}
+        remove_placement_group(pg)
+
+    def test_resources_released_on_remove(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        node = cluster.add_node(resources={"CPU": 4.0, "gpu_like": 2.0})
+        pg = placement_group([{"gpu_like": 2.0}])
+        assert node.resources.available()["gpu_like"] == 0.0
+        remove_placement_group(pg)
+        assert node.resources.available()["gpu_like"] == 2.0
