@@ -1,0 +1,98 @@
+"""Driver benchmark: flagship LM training throughput on the local TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: llama-600m (Llama-3 family, head_dim 128 so the Pallas flash
+path is exercised) full train step (fwd+bwd+adamw, bf16 compute / f32
+state) on one chip. vs_baseline is measured tokens/s over the recorded
+baseline in BASELINE.json ("bench_anchor") — the round-1 measurement
+anchors it; later rounds must beat it.
+
+Env knobs: RAY_TPU_BENCH_MODEL, RAY_TPU_BENCH_BATCH, RAY_TPU_BENCH_SEQ,
+RAY_TPU_BENCH_STEPS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _load_anchor() -> float:
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            data = json.load(f)
+        return float(data.get("bench_anchor", {}).get("value", 0.0))
+    except Exception:
+        return 0.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.comm.mesh import MeshSpec, build_mesh, set_mesh
+    from ray_tpu.models import get_config
+    from ray_tpu.train.lm import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
+    batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
+    seq = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
+    steps = int(os.environ.get("RAY_TPU_BENCH_STEPS", "20"))
+
+    cfg = get_config(model)
+    n_dev = len(jax.devices())
+    mesh = build_mesh(MeshSpec.create(dp=-1), devices=jax.devices())
+    set_mesh(mesh)
+    opt = make_optimizer(total_steps=steps + 10)
+    state, _ = init_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+    step_fn = jax.jit(make_train_step(cfg, opt), donate_argnums=0)
+    data = synthetic_batch(cfg, batch, seq)
+
+    with mesh:
+        # warmup: compile + 2 steps. NOTE: sync via scalar readback, not
+        # block_until_ready — remote/tunneled PJRT backends can ack
+        # block_until_ready before execution completes; a device->host
+        # readback of a value data-dependent on the whole step cannot lie.
+        for _ in range(2):
+            state, metrics = step_fn(state, data)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, data)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = cfg.param_count()
+    # 6ND model flops + exact causal attention flops (fwd+bwd = 3x fwd's 2x)
+    attn_flops = 12 * cfg.n_layers * cfg.hdim * cfg.n_heads * seq  # per token
+    flops_per_token = 6 * n_params + attn_flops
+    peak = 197e12 if jax.default_backend() == "tpu" else 1e12  # v5e bf16 peak
+    mfu = tokens_per_sec * flops_per_token / (n_dev * peak)
+    print(
+        f"# model={model} params={n_params/1e6:.0f}M devices={n_dev} "
+        f"batch={batch} seq={seq} steps={steps} dt={dt:.2f}s "
+        f"loss={float(metrics['loss']):.3f} mfu={mfu:.2%}",
+        file=sys.stderr,
+    )
+
+    anchor = _load_anchor()
+    vs = tokens_per_sec / anchor if anchor > 0 else 1.0
+    print(json.dumps({
+        "metric": f"train_tokens_per_sec_{model.replace('-', '_')}",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

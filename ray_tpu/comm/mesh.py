@@ -115,6 +115,11 @@ class MeshRegistry:
         with self._lock:
             self._meshes[name] = mesh
 
+    def peek(self, name: str = "default") -> Optional[Mesh]:
+        """Like get(), but never auto-builds: None when nothing registered."""
+        with self._lock:
+            return self._meshes.get(name)
+
     def get(self, name: str = "default") -> Mesh:
         with self._lock:
             mesh = self._meshes.get(name)

@@ -1,0 +1,21 @@
+"""ray_tpu.data — lazy streaming distributed datasets (reference: Ray Data).
+
+Blocks flow through fused stages as remote tasks with bounded in-flight
+windows; `iter_device_batches` double-buffers host→HBM transfers so TPU
+steps never stall on input.
+"""
+
+from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from .dataset import Dataset  # noqa: F401
+from .iterator import DataIterator  # noqa: F401
+from .read_api import (  # noqa: F401
+    from_items,
+    from_numpy,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)

@@ -1,0 +1,182 @@
+"""Dataset: the lazy, streaming distributed dataset facade.
+
+Reference: `python/ray/data/dataset.py :: Dataset` — same surface
+(map_batches / random_shuffle / iter_batches / streaming_split / ...),
+executed via the streaming executor over remote tasks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .. import api
+from .block import BlockAccessor, BlockMetadata
+from .executor import StreamingExecutor
+from .iterator import DataIterator
+from .logical import (
+    Filter,
+    FlatMap,
+    InputData,
+    Limit,
+    LogicalPlan,
+    MapBatches,
+    MapRows,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+)
+
+
+class Dataset:
+    def __init__(self, plan: LogicalPlan):
+        self._plan = plan
+
+    # -- transforms (lazy) ---------------------------------------------------
+
+    def map_batches(
+        self,
+        fn: Callable[[Any], Any],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        fn_kwargs: Optional[dict] = None,
+        **_ignored,
+    ) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBatches("map_batches", fn, batch_size, batch_format, fn_kwargs or {})
+        ))
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return Dataset(self._plan.with_op(MapRows("map", fn)))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return Dataset(self._plan.with_op(Filter("filter", fn)))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        return Dataset(self._plan.with_op(FlatMap("flat_map", fn)))
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(self._plan.with_op(Limit("limit", n)))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(self._plan.with_op(RandomShuffle("random_shuffle", seed)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(self._plan.with_op(Repartition("repartition", num_blocks)))
+
+    def sort(self, key: Optional[str] = None, descending: bool = False) -> "Dataset":
+        return Dataset(self._plan.with_op(Sort("sort", key, descending)))
+
+    # -- execution -----------------------------------------------------------
+
+    def _stream_refs(self) -> Iterator[Any]:
+        return StreamingExecutor(self._plan).execute()
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._stream_refs)
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self.iterator().iter_rows()
+
+    def iter_device_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_device_batches(**kw)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        # metadata travels to the driver, blocks stay put
+        from .executor import _block_meta
+
+        refs = [_block_meta.remote(r) for r in self._stream_refs()]
+        return sum(m[0] for m in api.get(refs))
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        from .executor import _block_meta
+
+        for ref in self._stream_refs():
+            return api.get(_block_meta.remote(ref))[2]
+        return None
+
+    def materialize(self) -> "Dataset":
+        refs = list(self._stream_refs())
+        return Dataset(LogicalPlan([InputData("input", list(refs))]))
+
+    def stats(self) -> Dict[str, Any]:
+        from .executor import _block_meta
+
+        metas = api.get([_block_meta.remote(r) for r in self._stream_refs()])
+        return {
+            "num_blocks": len(metas),
+            "num_rows": sum(m[0] for m in metas),
+            "size_bytes": sum(m[1] for m in metas),
+        }
+
+    # -- splitting (training ingest) ----------------------------------------
+
+    def streaming_split(self, n: int, *, equal: bool = False) -> List[DataIterator]:
+        """N iterators over disjoint block shards (round-robin).
+
+        equal=True row-balances first (repartition to n row-equal blocks) so
+        every SPMD rank sees the same batch count — required for gang
+        training, where an uneven iterator desyncs collectives.
+        """
+        src = self.repartition(n) if equal else self
+        materialized = src.materialize()
+
+        def make_factory(i: int):
+            def factory():
+                refs = list(materialized._stream_refs())
+                return iter(refs[i::n])
+            return factory
+
+        return [DataIterator(make_factory(i)) for i in range(n)]
+
+    def split(self, n: int) -> List["Dataset"]:
+        refs = list(self._stream_refs())
+        return [
+            Dataset(LogicalPlan([InputData("input", refs[i::n])])) for i in range(n)
+        ]
+
+    # -- writes --------------------------------------------------------------
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            block = api.get(ref)
+            table = BlockAccessor.batch_of(block, "pyarrow")
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        import pandas as pd  # noqa: F401
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            df = BlockAccessor.batch_of(api.get(ref), "pandas")
+            df.to_csv(os.path.join(path, f"part-{i:05d}.csv"), index=False)
+
+    def __repr__(self):
+        ops = " -> ".join(op.name for op in self._plan.operators)
+        return f"Dataset({ops})"

@@ -1,0 +1,201 @@
+"""Streaming executor: pipelined, backpressured block flow over remote tasks.
+
+Reference: `python/ray/data/_internal/execution/streaming_executor.py` +
+`operators/`. Scaled to the architecture that matters: each fused stage
+runs as remote tasks (one per block) with a bounded in-flight window —
+downstream consumption pulls blocks through, so memory stays bounded and
+CPU preprocessing overlaps device compute (the input-pipeline property the
+TPU cares about).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterator, List, Optional
+
+import numpy as np
+
+from .. import api
+from ..core.logging import get_logger
+from .block import Block, BlockAccessor
+from .logical import (
+    InputData,
+    Limit,
+    LogicalPlan,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    fuse,
+)
+
+logger = get_logger("data.executor")
+
+DEFAULT_MAX_IN_FLIGHT = 16
+
+
+@api.remote
+def _run_read(task: Callable[[], Block]) -> Block:
+    return task()
+
+
+@api.remote
+def _run_stage(stage: Callable[[Block], Block], block: Block) -> Block:
+    return stage(block)
+
+
+@api.remote
+def _concat_blocks(*blocks: Block) -> Block:
+    return BlockAccessor.concat(list(blocks))
+
+
+@api.remote
+def _split_block(block: Block, n: int):
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    cuts = [rows * i // n for i in range(n + 1)]
+    return tuple(acc.slice(cuts[i], cuts[i + 1]) for i in range(n))
+
+
+@api.remote
+def _sort_block(block: Block, key: Optional[str], descending: bool) -> Block:
+    acc = BlockAccessor(block)
+    if acc.is_tabular:
+        if key is None:
+            key = next(iter(block))  # default: first column
+        order = np.argsort(np.asarray(block[key]), kind="stable")
+        if descending:
+            order = order[::-1]
+        return {k: np.asarray(v)[order] for k, v in block.items()}
+    items = sorted(block, reverse=descending)
+    return items
+
+
+@api.remote
+def _block_meta(block: Block):
+    m = BlockAccessor(block).metadata()
+    return (m.num_rows, m.size_bytes, m.schema)
+
+
+def _windowed(submit_fns: List[Callable[[], Any]], max_in_flight: int) -> Iterator[Any]:
+    """Submit lazily with a bounded window; yield refs in order."""
+    pending: List[Any] = []
+    idx = 0
+    while idx < len(submit_fns) or pending:
+        while idx < len(submit_fns) and len(pending) < max_in_flight:
+            pending.append(submit_fns[idx]())
+            idx += 1
+        yield pending.pop(0)
+
+
+class StreamingExecutor:
+    """Executes a LogicalPlan, yielding block ObjectRefs."""
+
+    def __init__(self, plan: LogicalPlan, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+        self.plan = plan
+        self.max_in_flight = max_in_flight
+
+    def execute(self) -> Iterator[Any]:
+        segments = fuse(self.plan)
+        source = segments[0]
+
+        if isinstance(source, Read):
+            def gen():
+                for ref in _windowed(
+                    [lambda t=t: _run_read.remote(t) for t in source.read_tasks],
+                    self.max_in_flight,
+                ):
+                    yield ref
+            stream: Iterator[Any] = gen()
+        elif isinstance(source, InputData):
+            stream = iter(list(source.blocks))
+        else:
+            raise TypeError(f"bad source {source}")
+
+        for seg in segments[1:]:
+            if callable(seg):
+                stream = self._map_stream(stream, seg)
+            elif isinstance(seg, RandomShuffle):
+                stream = self._shuffle(stream, seg.seed)
+            elif isinstance(seg, Repartition):
+                stream = self._repartition(stream, seg.num_blocks)
+            elif isinstance(seg, Sort):
+                stream = self._sort(stream, seg)
+            else:
+                raise TypeError(f"bad segment {seg}")
+        return stream
+
+    # -- pipelined 1:1 stage ------------------------------------------------
+
+    def _map_stream(self, upstream: Iterator[Any], stage) -> Iterator[Any]:
+        def gen():
+            pending: List[Any] = []
+            exhausted = False
+            it = iter(upstream)
+            while not exhausted or pending:
+                while not exhausted and len(pending) < self.max_in_flight:
+                    try:
+                        ref = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(_run_stage.remote(stage, ref))
+                if pending:
+                    yield pending.pop(0)
+        return gen()
+
+    # -- all-to-all barriers -------------------------------------------------
+
+    def _shuffle(self, upstream: Iterator[Any], seed: Optional[int]) -> Iterator[Any]:
+        """Two-phase push shuffle: split each block n-ways, re-concat."""
+        refs = list(upstream)
+        n = len(refs)
+        rng = random.Random(seed)
+        if n <= 1:
+            out = refs
+        else:
+            split_refs = [
+                _split_block.options(num_returns=n).remote(r, n) for r in refs
+            ]
+            out = []
+            for j in range(n):
+                shard = [split_refs[i][j] for i in range(n)]
+                rng.shuffle(shard)
+                out.append(_concat_blocks.remote(*shard))
+            rng.shuffle(out)
+
+        def gen():
+            # local row-permute each output block, seeded deterministically
+            for i, ref in enumerate(out):
+                s = None if seed is None else seed + i
+                yield _run_stage.remote(_permute_rows(s), ref)
+        return gen()
+
+    def _repartition(self, upstream: Iterator[Any], num_blocks: int) -> Iterator[Any]:
+        refs = list(upstream)
+        if num_blocks <= 0:
+            num_blocks = max(len(refs), 1)
+        merged = _concat_blocks.remote(*refs)
+        if num_blocks == 1:
+            return iter([merged])
+        parts = _split_block.options(num_returns=num_blocks).remote(merged, num_blocks)
+        return iter(list(parts))
+
+    def _sort(self, upstream: Iterator[Any], op: Sort) -> Iterator[Any]:
+        refs = list(upstream)
+        merged = _concat_blocks.remote(*refs)
+        return iter([_sort_block.remote(merged, op.key, op.descending)])
+
+
+def _permute_rows(seed: Optional[int]):
+    def permute(block: Block) -> Block:
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n)
+        if acc.is_tabular:
+            return {k: np.asarray(v)[order] for k, v in block.items()}
+        return [block[i] for i in order]
+
+    permute.__name__ = "permute_rows"
+    return permute

@@ -1,0 +1,163 @@
+"""Read API (reference: `python/ray/data/read_api.py` + `datasource/`)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import BlockAccessor
+from .dataset import Dataset
+from .logical import LogicalPlan, Read
+
+DEFAULT_ROWS_PER_BLOCK = 4096
+
+
+def _make(read_tasks, name, num_rows=None) -> Dataset:
+    return Dataset(LogicalPlan([Read(name, tuple(read_tasks), num_rows)]))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    import builtins
+
+    if parallelism <= 0:
+        parallelism = max(1, min(64, n // DEFAULT_ROWS_PER_BLOCK or 1))
+    cuts = [n * i // parallelism for i in builtins.range(parallelism + 1)]
+
+    def make_task(lo, hi):
+        def task():
+            return {"id": np.arange(lo, hi)}
+        return task
+
+    tasks = [make_task(cuts[i], cuts[i + 1]) for i in builtins.range(parallelism)]
+    return _make(tasks, "read_range", n)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    import builtins
+
+    n = len(items)
+    if parallelism <= 0:
+        parallelism = max(1, min(16, n))
+    cuts = [n * i // parallelism for i in builtins.range(parallelism + 1)]
+
+    def make_task(lo, hi):
+        def task():
+            return BlockAccessor.from_rows(items[lo:hi])
+        return task
+
+    tasks = [make_task(cuts[i], cuts[i + 1]) for i in builtins.range(parallelism)]
+    return _make(tasks, "from_items", n)
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = 1) -> Dataset:
+    def task():
+        return {k: np.asarray(v) for k, v in arrays.items()}
+
+    return _make([task], "from_numpy")
+
+
+def _expand_paths(paths, suffix) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def make_task(f):
+        def task():
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(f, columns=columns)
+            return {
+                c: table.column(c).to_numpy(zero_copy_only=False)
+                for c in table.column_names
+            }
+        return task
+
+    return _make([make_task(f) for f in files], "read_parquet")
+
+
+def read_csv(paths) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def make_task(f):
+        def task():
+            import pandas as pd
+
+            df = pd.read_csv(f)
+            return {c: df[c].to_numpy() for c in df.columns}
+        return task
+
+    return _make([make_task(f) for f in files], "read_csv")
+
+
+def read_json(paths) -> Dataset:
+    files = _expand_paths(paths, ".json")
+
+    def make_task(f):
+        def task():
+            import json
+
+            with open(f) as fh:
+                text = fh.read()
+            if text.lstrip().startswith("["):
+                rows = json.loads(text)
+            else:  # jsonl
+                rows = [json.loads(line) for line in text.splitlines() if line.strip()]
+            return BlockAccessor.from_rows(rows)
+        return task
+
+    return _make([make_task(f) for f in files], "read_json")
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths, ".txt")
+
+    def make_task(f):
+        def task():
+            with open(f) as fh:
+                lines = [l.rstrip("\n") for l in fh]
+            return {"text": np.asarray(lines, dtype=object)}
+        return task
+
+    return _make([make_task(f) for f in files], "read_text")
+
+
+def read_numpy(paths) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    def make_task(f):
+        def task():
+            return {"data": np.load(f)}
+        return task
+
+    return _make([make_task(f) for f in files], "read_numpy")
+
+
+def read_binary_files(paths, *, suffix: str = "") -> Dataset:
+    files = _expand_paths(paths, suffix)
+
+    def make_task(f):
+        def task():
+            with open(f, "rb") as fh:
+                data = fh.read()
+            return [{"path": f, "bytes": data}]
+        return task
+
+    return _make([make_task(f) for f in files], "read_binary_files")

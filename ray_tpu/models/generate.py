@@ -1,0 +1,66 @@
+"""Simple batched generation over the contiguous KV cache.
+
+This is the standalone/offline path (tests, bench, data-pipeline batch
+inference). Online serving uses serve/engine.py's continuously-batched
+paged-cache engine instead.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+from .transformer import decode_step, prefill
+
+
+def sample_token(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """logits [B, V] -> tokens [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -2e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+)
+def generate(
+    params,
+    cfg: ModelConfig,
+    prompt: jax.Array,
+    key: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """prompt [B, T] -> generated tokens [B, max_new_tokens].
+
+    Whole loop is one jit: prefill, then `lax.scan` over decode steps —
+    no host round-trips between tokens.
+    """
+    B, T = prompt.shape
+    max_len = T + max_new_tokens
+    logits, cache = prefill(params, cfg, prompt, max_len)
+
+    def step(carry, k_step):
+        logits, cache, pos = carry
+        tok = sample_token(logits, k_step, temperature, top_k)
+        new_logits, cache = decode_step(params, cfg, cache, tok, pos)
+        return (new_logits, cache, pos + 1), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    pos0 = jnp.full((B,), T, jnp.int32)
+    (_, _, _), toks = jax.lax.scan(step, (logits, cache, pos0), keys)
+    return toks.T  # [B, max_new_tokens]

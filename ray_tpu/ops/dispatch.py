@@ -1,0 +1,24 @@
+"""Kernel dispatch policy: Pallas on TPU, XLA everywhere else."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    """True when the Pallas TPU path should be taken.
+
+    RAY_TPU_FORCE_PALLAS=1 forces Pallas (interpret mode off-TPU — used by
+    kernel correctness tests), =0 forces the XLA fallback everywhere.
+    """
+    forced = os.environ.get("RAY_TPU_FORCE_PALLAS")
+    if forced is not None:
+        return forced not in ("0", "false", "")
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode: on whenever we're not on a real TPU."""
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,189 @@
+"""Paged attention for continuous-batching decode.
+
+The serving engine stores KV cache in fixed-size pages in HBM (the vLLM
+idea, rebuilt TPU-style): the decode step attends one query token per
+sequence against that sequence's pages. The Pallas kernel scalar-prefetches
+the page table, then double-buffers page DMAs (HBM→VMEM) behind the MXU
+dot products — decode is bandwidth-bound, so overlapping the page fetch is
+the whole game. XLA fallback gathers pages (simple, memory-hungry) for CPU
+tests and odd shapes.
+
+Cache layout: k_pages / v_pages are [KVH, num_pages, page_size, D] — head
+major, so one (head, page) slab is a contiguous [page_size, D] DMA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .dispatch import interpret_mode, use_pallas
+
+_NEG_INF = -2.0e30
+_LANES = 128
+
+
+def _paged_reference(q, k_pages, v_pages, page_table, lengths, scale):
+    """Gather-based fallback. q [B,H,D] -> o [B,H,D]."""
+    B, H, D = q.shape
+    KVH, _, page_size, _ = k_pages.shape
+    g = H // KVH
+    pages_per_seq = page_table.shape[1]
+    ctx = pages_per_seq * page_size
+    # [KVH, B, pages, ps, D] -> [B, KVH, ctx, D]
+    kg = jnp.moveaxis(k_pages[:, page_table], 1, 0).reshape(B, KVH, ctx, D)
+    vg = jnp.moveaxis(v_pages[:, page_table], 1, 0).reshape(B, KVH, ctx, D)
+    qf = q.reshape(B, KVH, g, D).astype(jnp.float32)
+    s = jnp.einsum("bcgd,bctd->bcgt", qf, kg.astype(jnp.float32)) * scale
+    mask = jnp.arange(ctx)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bcgt,bctd->bcgd", p, vg.astype(jnp.float32))
+    return o.reshape(B, H, D).astype(q.dtype)
+
+
+def _paged_kernel(
+    # scalar prefetch
+    pt_ref, len_ref,
+    # inputs
+    q_ref, k_hbm, v_hbm,
+    # outputs
+    o_ref,
+    # scratch
+    k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+    *, page_size, pages_per_seq, scale,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    g, D = q_ref.shape[2], q_ref.shape[3]
+    length = len_ref[b]
+    n_pages = jax.lax.div(length + page_size - 1, page_size)
+
+    def page_dma(slot, i):
+        page = pt_ref[b * pages_per_seq + i]
+        kcp = pltpu.make_async_copy(k_hbm.at[c, page], k_buf.at[slot], sem_ref.at[slot, 0])
+        vcp = pltpu.make_async_copy(v_hbm.at[c, page], v_buf.at[slot], sem_ref.at[slot, 1])
+        return kcp, vcp
+
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(n_pages > 0)
+    def _run():
+        kcp, vcp = page_dma(0, 0)
+        kcp.start()
+        vcp.start()
+
+        def body(i, _):
+            slot = jax.lax.rem(i, 2)
+            nslot = jax.lax.rem(i + 1, 2)
+
+            @pl.when(i + 1 < n_pages)
+            def _prefetch():
+                kn, vn = page_dma(nslot, i + 1)
+                kn.start()
+                vn.start()
+
+            kw, vw = page_dma(slot, i)
+            kw.wait()
+            vw.wait()
+
+            q = q_ref[0, 0].astype(jnp.float32)  # [g, D]
+            k = k_buf[slot].astype(jnp.float32)  # [ps, D]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # [g, ps]
+            pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (g, page_size), 1)
+            s = jnp.where(pos < length, s, _NEG_INF)
+
+            m_prev, l_prev = m_ref[...], l_ref[...]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_next = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_next)
+            p = jnp.exp(s - m_next[:, :1])
+            p = jnp.where(m_next[:, :1] > _NEG_INF / 2, p, 0.0)
+            l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            m_ref[...] = m_next
+            pv = jax.lax.dot_general(
+                p, v_buf[slot].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+            return 0
+
+        jax.lax.fori_loop(0, n_pages, body, 0)
+
+    l = l_ref[...][:, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pages, v_pages, page_table, lengths, scale):
+    B, H, D = q.shape
+    KVH, _, page_size, _ = k_pages.shape
+    g = H // KVH
+    pages_per_seq = page_table.shape[1]
+    q4 = q.reshape(B, KVH, g, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, D), lambda b, c, *_: (b, c, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, D), lambda b, c, *_: (b, c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, D), k_pages.dtype),
+            pltpu.VMEM((2, page_size, D), v_pages.dtype),
+            pltpu.VMEM((g, D), jnp.float32),
+            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.VMEM((g, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, page_size=page_size, pages_per_seq=pages_per_seq, scale=scale
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, g, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode(),
+    )(page_table.reshape(-1), lengths, q4, k_pages, v_pages)
+    return out.reshape(B, H, D)
+
+
+def paged_attention_decode(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    scale: float | None = None,
+) -> jax.Array:
+    """One decode step of attention over a paged KV cache.
+
+    Args:
+      q: [B, H, D] — current token's query per sequence.
+      k_pages/v_pages: [KVH, num_pages, page_size, D].
+      page_table: [B, pages_per_seq] int32 page ids (unused tail arbitrary).
+      lengths: [B] int32 valid context length per sequence.
+    Returns [B, H, D].
+    """
+    D = q.shape[-1]
+    if scale is None:
+        scale = D**-0.5
+    if use_pallas() and D % _LANES == 0 and q.shape[1] % k_pages.shape[0] == 0:
+        return _paged_pallas(q, k_pages, v_pages, page_table, lengths, scale)
+    return _paged_reference(q, k_pages, v_pages, page_table, lengths, scale)

@@ -1,0 +1,50 @@
+"""Rotary position embeddings (RoPE).
+
+Pure XLA: elementwise, so the compiler fuses it into the surrounding
+projections; a Pallas kernel would add nothing. Implements the
+half-rotation (Llama/NeoX) convention with optional NTK/linear scaling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_len: int,
+    theta: float = 10000.0,
+    scaling: Optional[float] = None,
+    dtype=jnp.float32,
+):
+    """Precompute (cos, sin) tables: each [max_len, head_dim // 2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    if scaling is not None:
+        pos = pos / scaling
+    ang = jnp.outer(pos, inv_freq)
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Rotate x [B, T, H, D] by the tables; positions [B, T] selects rows
+    (defaults to arange(T) — pass real positions for decode/packed batches)."""
+    B, T, H, D = x.shape
+    if positions is None:
+        c = jax.lax.dynamic_slice_in_dim(cos, 0, T)[None, :, None, :]
+        s = jax.lax.dynamic_slice_in_dim(sin, 0, T)[None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate([xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1)
+    return out.astype(x.dtype)

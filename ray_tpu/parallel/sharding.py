@@ -54,16 +54,28 @@ def spec_for(axes: Sequence[Optional[str]], rules: Optional[Rules] = None) -> Pa
 
 def _filter_spec_for_mesh(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
     """Drop mesh axes the mesh doesn't have (size-1 semantics): lets one
-    rule set serve dp-only, fsdp+tp, full 3D meshes unchanged."""
+    rule set serve dp-only, fsdp+tp, full 3D meshes unchanged.
+
+    Also drops repeated mesh axes (first dimension wins): one rule set
+    serves params AND activations — e.g. "batch"→(dp, fsdp) plus
+    "embed"→fsdp on the same activation resolves to batch taking fsdp and
+    embed replicating, which is exactly ZeRO semantics (weights sharded
+    over fsdp at rest, activations batch-sharded in flight)."""
     parts = []
+    used: set = set()
     for entry in spec:
         if entry is None:
             parts.append(None)
+            continue
+        cand = (entry,) if isinstance(entry, str) else tuple(entry)
+        kept = tuple(a for a in cand if a in mesh.axis_names and a not in used)
+        used.update(kept)
+        if not kept:
+            parts.append(None)
         elif isinstance(entry, str):
-            parts.append(entry if entry in mesh.axis_names else None)
+            parts.append(kept[0] if kept else None)
         else:
-            kept = tuple(a for a in entry if a in mesh.axis_names)
-            parts.append(kept if kept else None)
+            parts.append(kept)
     return PartitionSpec(*parts)
 
 
@@ -98,16 +110,16 @@ def constrain(x: jax.Array, axes: Sequence[Optional[str]], rules: Optional[Rules
 def _current_mesh() -> Optional[Mesh]:
     try:
         env = jax._src.mesh.thread_resources.env  # set by `with mesh:`
-        if env.physical_mesh.devices.size > 0:
-            return env.physical_mesh
+        pm = env.physical_mesh
+        if not pm.empty:
+            return pm
     except Exception:
         pass
     from ..comm.mesh import registry
 
-    try:
-        return registry.get("default")
-    except Exception:
-        return None
+    # No auto-build: without an active or registered mesh, constrain() is a
+    # no-op rather than pinning eager intermediates to a fabricated mesh.
+    return registry.peek("default")
 
 
 def shard_tree(params: Any, axes_tree: Any, mesh: Mesh, rules: Optional[Rules] = None) -> Any:

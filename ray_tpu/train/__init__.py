@@ -1,0 +1,30 @@
+"""ray_tpu.train — gang-scheduled SPMD training (reference: Ray Train A1).
+
+Usage inside train_loop_per_worker:
+
+    from ray_tpu import train
+
+    def train_func(config):
+        ctx = train.get_context()
+        mesh = build_mesh(**config["mesh"])      # gang-wide GSPMD mesh
+        ckpt = train.get_checkpoint()            # set after gang restart
+        ...
+        train.report({"loss": loss}, checkpoint=train.Checkpoint(path))
+"""
+
+from .checkpoint import (  # noqa: F401
+    AsyncCheckpointWriter,
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .result import Result  # noqa: F401
+from .session import TrainContext, get_checkpoint, get_context, report  # noqa: F401
+from .trainer import JaxTrainer, TrainingFailedError  # noqa: F401

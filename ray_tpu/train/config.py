@@ -1,0 +1,65 @@
+"""Train/run config dataclasses.
+
+Reference analogue: upstream ray `python/ray/air/config.py ::
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig`. TPU-specific
+additions: a mesh shape (named axis sizes) and a slice topology request —
+on TPU a "worker" is a *host of a gang*, and the gang's devices form one
+jax mesh, so parallelism config belongs here rather than in user code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """Shape of the training gang.
+
+    num_workers: processes in the gang (1 per TPU host; tests use local
+    actors sharing the virtual CPU mesh).
+    mesh_shape: named mesh axis sizes for the gang's devices, e.g.
+    {"fsdp": 8, "tp": 4}; -1 on one axis absorbs remaining devices.
+    topology: optional ICI sub-slice shape request, e.g. (2, 2, 4).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    mesh_shape: Optional[Dict[str, int]] = None
+    topology: Optional[Tuple[int, ...]] = None
+    # True only when each worker is its own OS process on its own host
+    # (real multi-host pods): wires jax.distributed via the control-plane
+    # rendezvous. Local/test gangs share one process and one jax runtime.
+    distributed_bootstrap: bool = False
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        return {"CPU": 1.0, "TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: gang restarts to attempt (-1 = unlimited)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # max | min
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    callbacks: List[Any] = dataclasses.field(default_factory=list)
+    verbose: int = 1

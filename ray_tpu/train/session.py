@@ -1,0 +1,103 @@
+"""Per-worker train session: report()/get_context()/get_checkpoint().
+
+Reference analogue: `python/ray/train/_internal/session.py ::
+_TrainSession, report, get_context`. The session rides a thread-local so
+report() works from anywhere inside the user's train_func, while the
+worker actor's poll thread drains the buffer concurrently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from .checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    experiment_name: str = "default"
+    storage_path: str = ""
+    trial_dir: str = ""
+    gang_name: str = ""
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+@dataclasses.dataclass
+class _Report:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    rank: int
+
+
+class _TrainSession:
+    def __init__(
+        self,
+        context: TrainContext,
+        resume_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.context = context
+        self.resume_checkpoint = resume_checkpoint
+        self._reports: "queue.Queue[_Report]" = queue.Queue()
+        self.finished = False
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        self._reports.put(_Report(dict(metrics), checkpoint, self.context.world_rank))
+
+    def drain(self) -> List[_Report]:
+        out = []
+        while True:
+            try:
+                out.append(self._reports.get_nowait())
+            except queue.Empty:
+                return out
+
+
+def _set_session(session: Optional[_TrainSession]) -> None:
+    _local.session = session
+
+
+def _get_session() -> Optional[_TrainSession]:
+    return getattr(_local, "session", None)
+
+
+# --- public API (ray_tpu.train.report / get_context / get_checkpoint) ------
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from inside train_func."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a train session")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        return TrainContext()  # degenerate single-process context
+    return s.context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set after a gang restart)."""
+    s = _get_session()
+    return s.resume_checkpoint if s is not None else None

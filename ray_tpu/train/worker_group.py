@@ -1,0 +1,144 @@
+"""The training gang: a placement-group-backed group of worker actors.
+
+Reference analogue: `python/ray/train/_internal/worker_group.py ::
+WorkerGroup` + `backend_executor.py :: BackendExecutor`. TPU deltas:
+- the gang is placed as ONE topology-aware bundle set (slice/sub-slice),
+  because ICI collectives require all hosts of a slice (SURVEY.md §7.4.1);
+- setup wires jax.distributed via the control-plane KV rendezvous
+  (comm/bootstrap.py) instead of a torch process group.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core.logging import get_logger
+from .checkpoint import Checkpoint
+from .config import ScalingConfig
+from .session import TrainContext, _TrainSession, _get_session, _set_session
+
+logger = get_logger("train.worker_group")
+
+
+@api.remote
+class TrainWorker:
+    """One gang member. Runs the user train_func on its runner thread while
+    poll() (second concurrency slot) streams reports back to the trainer."""
+
+    def __init__(self, rank: int, world_size: int, gang_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.gang_name = gang_name
+        self.session: Optional[_TrainSession] = None
+
+    def setup_distributed(self, num_processes: int) -> bool:
+        from ..comm.bootstrap import init_distributed
+
+        init_distributed(self.gang_name, num_processes, self.rank)
+        return True
+
+    def run(
+        self,
+        train_func: Callable[[Dict[str, Any]], Any],
+        config: Dict[str, Any],
+        context: TrainContext,
+        resume_checkpoint: Optional[Checkpoint],
+    ) -> Any:
+        self.session = _TrainSession(context, resume_checkpoint)
+        _set_session(self.session)
+        try:
+            return train_func(config)
+        finally:
+            self.session.finished = True
+            _set_session(None)
+
+    def poll(self) -> List[Any]:
+        if self.session is None:
+            return []
+        return self.session.drain()
+
+    def is_finished(self) -> bool:
+        return self.session is not None and self.session.finished
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        scaling: ScalingConfig,
+        gang_name: str,
+        experiment_name: str,
+        storage_path: str,
+    ):
+        self.scaling = scaling
+        self.gang_name = gang_name
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.workers: List[Any] = []
+        self.pg = None
+        self._start()
+
+    def _start(self) -> None:
+        n = self.scaling.num_workers
+        res = self.scaling.worker_resources()
+        rt = api._auto_init()
+        bundles = [dict(res) for _ in range(n)]
+        try:
+            self.pg = rt.pg_manager.create(
+                bundles, strategy=self.scaling.placement_strategy
+            )
+            self.pg.ready(timeout=30.0)
+        except Exception as e:
+            logger.warning("gang %s: no placement group (%s); best-effort placement", self.gang_name, e)
+            self.pg = None
+        opts = dict(max_concurrency=2, num_cpus=res.get("CPU", 1.0), num_tpus=res.get("TPU", 0.0))
+        self.workers = [
+            TrainWorker.options(**opts).remote(rank, n, self.gang_name)
+            for rank in range(n)
+        ]
+        if self.scaling.distributed_bootstrap:
+            api.get([w.setup_distributed.remote(n) for w in self.workers])
+
+    def run(
+        self,
+        train_func: Callable,
+        config: Dict[str, Any],
+        resume_checkpoint: Optional[Checkpoint],
+        datasets_per_rank: Optional[Dict[str, List[Any]]] = None,
+    ) -> List[Any]:
+        refs = []
+        for rank, w in enumerate(self.workers):
+            cfg = dict(config)
+            if datasets_per_rank is not None:
+                cfg["datasets"] = {
+                    name: shards[rank] for name, shards in datasets_per_rank.items()
+                }
+            ctx = TrainContext(
+                world_rank=rank,
+                world_size=self.scaling.num_workers,
+                local_rank=rank,  # 1 worker per host in the TPU model
+                experiment_name=self.experiment_name,
+                storage_path=self.storage_path,
+                trial_dir=self.storage_path,
+                gang_name=self.gang_name,
+            )
+            refs.append(w.run.remote(train_func, cfg, ctx, resume_checkpoint))
+        return refs
+
+    def poll(self) -> List[Any]:
+        reports = []
+        for w in self.workers:
+            try:
+                reports.extend(api.get(w.poll.remote(), timeout=30.0))
+            except Exception:
+                logger.debug("poll failed:\n%s", traceback.format_exc())
+        return reports
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,176 @@
+"""Data library tests: plan fusion, streaming execution, shuffles,
+iteration, splits, file IO, and device prefetch."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data
+from ray_tpu.data.logical import fuse
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+class TestBasics:
+    def test_range_count_take(self):
+        ds = data.range(1000, parallelism=8)
+        assert ds.count() == 1000
+        rows = ds.take(3)
+        assert [int(r["id"]) for r in rows] == [0, 1, 2]
+
+    def test_map_batches(self):
+        ds = data.range(100, parallelism=4).map_batches(
+            lambda b: {"id": b["id"] * 2}
+        )
+        got = sorted(int(r["id"]) for r in ds.take_all())
+        assert got == [2 * i for i in range(100)]
+
+    def test_map_filter_flatmap(self):
+        ds = (
+            data.from_items([{"x": i} for i in range(20)], parallelism=3)
+            .map(lambda r: {"x": r["x"] + 1})
+            .filter(lambda r: r["x"] % 2 == 0)
+            .flat_map(lambda r: [r, r])
+        )
+        rows = [int(r["x"]) for r in ds.take_all()]
+        assert sorted(rows) == sorted([x for x in range(2, 21, 2) for _ in (0, 1)])
+
+    def test_fusion_collapses_chain(self):
+        ds = (
+            data.range(10)
+            .map_batches(lambda b: b)
+            .filter(lambda r: True)
+            .random_shuffle()
+            .map_batches(lambda b: b)
+        )
+        segments = fuse(ds._plan)
+        # read, fused(map+filter), shuffle, fused(map)
+        assert len(segments) == 4
+
+    def test_schema_and_stats(self):
+        ds = data.range(100, parallelism=4)
+        assert ds.schema() == {"id": "int64"}
+        st = ds.stats()
+        assert st["num_rows"] == 100
+        assert st["num_blocks"] == 4
+
+    def test_limit_and_sort(self):
+        ds = data.from_items([{"v": i} for i in [5, 3, 8, 1]], parallelism=2)
+        got = [int(r["v"]) for r in ds.sort("v").take_all()]
+        assert got == [1, 3, 5, 8]
+        got = [int(r["v"]) for r in ds.sort("v", descending=True).take_all()]
+        assert got == [8, 5, 3, 1]
+        # default key sorts by first column
+        got = [int(r["v"]) for r in ds.sort().take_all()]
+        assert got == [1, 3, 5, 8]
+
+
+class TestShuffleSplit:
+    def test_random_shuffle_preserves_multiset(self):
+        ds = data.range(500, parallelism=5).random_shuffle(seed=7)
+        got = sorted(int(r["id"]) for r in ds.take_all())
+        assert got == list(range(500))
+        first = [int(r["id"]) for r in ds.take(10)]
+        assert first != list(range(10))  # actually shuffled
+
+    def test_repartition(self):
+        ds = data.range(100, parallelism=10).repartition(3)
+        assert ds.stats()["num_blocks"] == 3
+        assert ds.count() == 100
+
+    def test_streaming_split_covers_all(self):
+        ds = data.range(90, parallelism=6)
+        its = ds.streaming_split(3)
+        seen = []
+        for it in its:
+            for row in it.iter_rows():
+                seen.append(int(row["id"]))
+        assert sorted(seen) == list(range(90))
+
+    def test_split_datasets(self):
+        parts = data.range(40, parallelism=4).split(2)
+        assert sum(p.count() for p in parts) == 40
+
+
+class TestIteration:
+    def test_iter_batches_exact_sizes(self):
+        ds = data.range(100, parallelism=7)
+        sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+        assert sizes == [32, 32, 32, 4]
+        sizes = [
+            len(b["id"])
+            for b in ds.iter_batches(batch_size=32, drop_last=True)
+        ]
+        assert sizes == [32, 32, 32]
+
+    def test_iter_batches_formats(self):
+        ds = data.range(10, parallelism=1)
+        b = next(iter(ds.iter_batches(batch_size=10, batch_format="pandas")))
+        assert list(b.columns) == ["id"]
+
+    def test_local_shuffle(self):
+        ds = data.range(64, parallelism=2)
+        batches = list(
+            ds.iter_batches(
+                batch_size=16, local_shuffle_buffer_size=64, local_shuffle_seed=0
+            )
+        )
+        all_ids = sorted(int(i) for b in batches for i in b["id"])
+        assert all_ids == list(range(64))
+
+    def test_local_shuffle_small_data_still_shuffles(self):
+        # regression: buffer larger than the dataset must still permute
+        ds = data.range(64, parallelism=2)
+        batches = list(
+            ds.iter_batches(
+                batch_size=64, local_shuffle_buffer_size=10_000, local_shuffle_seed=0
+            )
+        )
+        ids = [int(i) for b in batches for i in b["id"]]
+        assert sorted(ids) == list(range(64))
+        assert ids != list(range(64))
+
+    def test_streaming_split_equal(self):
+        # 7 uneven blocks, equal=True must row-balance across 2 ranks
+        ds = data.range(70, parallelism=7)
+        its = ds.streaming_split(2, equal=True)
+        counts = [sum(1 for _ in it.iter_rows()) for it in its]
+        assert counts == [35, 35]
+
+    def test_iter_device_batches(self):
+        import jax
+
+        ds = data.range(64, parallelism=4)
+        batches = list(ds.iter_device_batches(batch_size=16, prefetch=2))
+        assert len(batches) == 4
+        assert all(isinstance(b["id"], jax.Array) for b in batches)
+        got = sorted(int(x) for b in batches for x in np.asarray(b["id"]))
+        assert got == list(range(64))
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, tmp_path):
+        ds = data.range(50, parallelism=2).map_batches(
+            lambda b: {"id": b["id"], "sq": b["id"] ** 2}
+        )
+        ds.write_parquet(str(tmp_path / "pq"))
+        back = data.read_parquet(str(tmp_path / "pq"))
+        assert back.count() == 50
+        rows = sorted(back.take_all(), key=lambda r: int(r["id"]))
+        assert int(rows[7]["sq"]) == 49
+
+    def test_csv_roundtrip(self, tmp_path):
+        data.range(20, parallelism=1).write_csv(str(tmp_path / "csv"))
+        back = data.read_csv(str(tmp_path / "csv"))
+        assert back.count() == 20
+
+    def test_read_json(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text('{"a": 1}\n{"a": 2}\n')
+        assert data.read_json(str(p)).count() == 2
+
+    def test_from_numpy(self):
+        ds = data.from_numpy({"x": np.arange(10)})
+        assert ds.count() == 10

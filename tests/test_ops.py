@@ -1,0 +1,207 @@
+"""Kernel correctness: Pallas (interpret mode on CPU) and XLA fallbacks vs
+O(T^2) references, plus gradient checks for the custom VJPs."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    layer_norm,
+    mha_reference,
+    paged_attention_decode,
+    rms_norm,
+    rms_norm_reference,
+    rope_frequencies,
+)
+from ray_tpu.ops.attention import _fwd_xla_blockwise
+from ray_tpu.ops.paged_attention import _paged_reference
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+@pytest.fixture(params=["xla", "pallas"])
+def kernel_mode(request, monkeypatch):
+    monkeypatch.setenv(
+        "RAY_TPU_FORCE_PALLAS", "1" if request.param == "pallas" else "0"
+    )
+    return request.param
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("kvh", [4, 1])
+    def test_matches_reference(self, kernel_mode, causal, kvh):
+        B, T, H, D = 2, 256, 4, 128
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, T, kvh, D))
+        v = _rand(ks[2], (B, T, kvh, D))
+        out = flash_attention(q, k, v, causal=causal)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_xla_blockwise_lse(self):
+        B, H, T, D = 1, 2, 256, 64
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = _rand(ks[0], (B, H, T, D))
+        k = _rand(ks[1], (B, H, T, D))
+        v = _rand(ks[2], (B, H, T, D))
+        o, lse = _fwd_xla_blockwise(q, k, v, causal=True, scale=D**-0.5, block_k=128)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * D**-0.5
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(mask, s, -2e30)
+        ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+        np.testing.assert_allclose(lse, ref_lse, atol=1e-4, rtol=1e-4)
+
+    def test_grads_match_reference(self, kernel_mode):
+        B, T, H, D = 1, 256, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, T, H, D))
+        v = _rand(ks[2], (B, T, H, D))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
+    @pytest.mark.parametrize("t", [200, 129])
+    def test_non_multiple_seq_len(self, kernel_mode, t):
+        # regression: XLA fallback must handle T in (128, 256) not divisible
+        # by the kv block (kv is padded + masked internally)
+        B, H, D = 1, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = _rand(ks[0], (B, t, H, D))
+        k = _rand(ks[1], (B, t, H, D))
+        v = _rand(ks[2], (B, t, H, D))
+        out = flash_attention(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+        g = jax.grad(lambda a, b, c: jnp.sum(flash_attention(a, b, c) ** 2), 1)(q, k, v)
+        g_ref = jax.grad(lambda a, b, c: jnp.sum(mha_reference(a, b, c) ** 2), 1)(q, k, v)
+        np.testing.assert_allclose(g, g_ref, atol=5e-3, rtol=5e-3)
+
+    def test_uneven_blocks_fall_back(self, kernel_mode):
+        # T not divisible by block, D not multiple of 128 -> XLA path.
+        B, T, H, D = 1, 96, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, T, H, D))
+        v = _rand(ks[2], (B, T, H, D))
+        out = flash_attention(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+class TestNorms:
+    def test_rms_norm(self, kernel_mode):
+        x = _rand(jax.random.PRNGKey(0), (4, 256, 256))
+        w = _rand(jax.random.PRNGKey(1), (256,)) * 0.1 + 1.0
+        np.testing.assert_allclose(
+            rms_norm(x, w), rms_norm_reference(x, w), atol=1e-5, rtol=1e-5
+        )
+
+    def test_rms_norm_grad(self, kernel_mode):
+        x = _rand(jax.random.PRNGKey(0), (8, 256))
+        w = jnp.ones((256,))
+
+        def f(x, w):
+            return jnp.sum(rms_norm(x, w) ** 2)
+
+        def f_ref(x, w):
+            return jnp.sum(rms_norm_reference(x, w) ** 2)
+
+        gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+        gx_r, gw_r = jax.grad(f_ref, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(gx, gx_r, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(gw, gw_r, atol=1e-4, rtol=1e-4)
+
+    def test_layer_norm(self):
+        x = _rand(jax.random.PRNGKey(0), (4, 32))
+        w, b = jnp.ones((32,)), jnp.zeros((32,))
+        y = layer_norm(x, w, b)
+        np.testing.assert_allclose(jnp.mean(y, -1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(jnp.std(y, -1), 1.0, atol=1e-2)
+
+
+class TestRope:
+    def test_norm_preserved(self):
+        cos, sin = rope_frequencies(64, 128)
+        x = _rand(jax.random.PRNGKey(0), (2, 100, 4, 64))
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(y, axis=-1), jnp.linalg.norm(x, axis=-1), rtol=1e-5
+        )
+
+    def test_position_zero_identity(self):
+        cos, sin = rope_frequencies(64, 128)
+        x = _rand(jax.random.PRNGKey(0), (1, 1, 2, 64))
+        y = apply_rope(x, cos, sin, positions=jnp.zeros((1, 1), jnp.int32))
+        np.testing.assert_allclose(y, x, atol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n.
+        cos, sin = rope_frequencies(64, 256)
+        q = _rand(jax.random.PRNGKey(0), (1, 1, 1, 64))
+        k = _rand(jax.random.PRNGKey(1), (1, 1, 1, 64))
+
+        def score(m, n):
+            qm = apply_rope(q, cos, sin, positions=jnp.full((1, 1), m, jnp.int32))
+            kn = apply_rope(k, cos, sin, positions=jnp.full((1, 1), n, jnp.int32))
+            return jnp.sum(qm * kn)
+
+        np.testing.assert_allclose(score(5, 3), score(102, 100), atol=1e-4)
+
+
+class TestPagedAttention:
+    def _setup(self, B=3, H=4, KVH=2, D=128, page_size=16, pages_per_seq=8):
+        total_pages = B * pages_per_seq + 1
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = _rand(ks[0], (B, H, D))
+        k_pages = _rand(ks[1], (KVH, total_pages, page_size, D))
+        v_pages = _rand(ks[2], (KVH, total_pages, page_size, D))
+        # Page 0 reserved; each seq uses disjoint pages.
+        page_table = (
+            1 + jnp.arange(B * pages_per_seq, dtype=jnp.int32)
+        ).reshape(B, pages_per_seq)
+        lengths = jnp.array([37, 128, 1], dtype=jnp.int32)
+        return q, k_pages, v_pages, page_table, lengths
+
+    def test_matches_dense(self, kernel_mode):
+        q, kp, vp, pt, lens = self._setup()
+        out = paged_attention_decode(q, kp, vp, pt, lens)
+        ref = _paged_reference(q, kp, vp, pt, lens, q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_against_flash(self, kernel_mode):
+        # Build a contiguous cache, run dense attention on the prefix, and
+        # compare with the paged view of the same data.
+        B, H, KVH, D, ps, pps = 2, 4, 4, 128, 16, 4
+        q, kp, vp, pt, _ = self._setup(B, H, KVH, D, ps, pps)
+        lens = jnp.array([64, 33], dtype=jnp.int32)
+        out = paged_attention_decode(q, kp, vp, pt, lens)
+        ctx = pps * ps
+        kg = jnp.moveaxis(kp[:, pt], 1, 0).reshape(B, KVH, ctx, D)
+        vg = jnp.moveaxis(vp[:, pt], 1, 0).reshape(B, KVH, ctx, D)
+        for b in range(B):
+            L = int(lens[b])
+            o_ref = mha_reference(
+                q[b][None, None],  # [1, 1, H, D]
+                jnp.swapaxes(kg[b, :, :L], 0, 1)[None],
+                jnp.swapaxes(vg[b, :, :L], 0, 1)[None],
+                causal=False,
+            )
+            np.testing.assert_allclose(out[b], o_ref[0, 0], atol=2e-3, rtol=2e-3)
