@@ -1,0 +1,54 @@
+"""BASELINE workload #5: GRPO RLHF on a language model.
+
+Reward here is a toy (prefer low token ids); swap reward_fn for a learned
+reward model or verifier.
+
+    python examples/rlhf_grpo.py --model tiny-llama --iters 20
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+import argparse
+
+import jax
+import numpy as np
+
+from ray_tpu.models import get_config, init_params
+from ray_tpu.rl import GRPO, GRPOConfig
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--group-size", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--lr", type=float, default=5e-3)
+    args = p.parse_args()
+
+    cfg = get_config(args.model)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def reward_fn(prompt_ids, completion_ids):
+        return float(np.mean([t < cfg.vocab_size // 2 for t in completion_ids]))
+
+    algo = GRPO(params, cfg, reward_fn, GRPOConfig(
+        group_size=args.group_size,
+        max_new_tokens=args.max_new_tokens,
+        lr=args.lr,
+        kl_coef=0.01,
+    ))
+    prompt = [1, 2, 3, 4]
+    for i in range(args.iters):
+        m = algo.train_step(prompt)
+        print(f"iter {m['training_iteration']:3d} "
+              f"reward={m['reward_mean']:.3f}±{m['reward_std']:.3f} "
+              f"kl={m['kl']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""BASELINE workload #6: continuously-batched LLM serving on TPU.
+
+    python examples/serve_llm.py --model llama-600m --requests 16
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+import argparse
+import json
+import threading
+import time
+import urllib.request
+
+from ray_tpu import serve
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--max-tokens", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=8)
+    args = p.parse_args()
+
+    app = serve.LLMServer.bind(
+        model_name=args.model,
+        engine_config=dict(
+            max_batch_size=args.batch_size,
+            page_size=16,
+            max_pages=512,
+            max_seq_len=512,
+            prefill_buckets=(64, 128, 256),
+        ),
+    )
+    handle = serve.run(app, name="llm")
+    port = serve.http_port()
+    print(f"serving {args.model} at http://127.0.0.1:{port}/llm")
+
+    results = []
+    lock = threading.Lock()
+
+    def fire(i):
+        body = json.dumps({
+            "prompt_ids": [1 + i, 2 + i, 3 + i, 4 + i],
+            "max_tokens": args.max_tokens,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llm", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=600) as r:
+            out = json.loads(r.read())["result"]
+        with lock:
+            results.append((time.perf_counter() - t0, out))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(args.requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    total_tokens = sum(len(o["token_ids"]) for _, o in results)
+    ttfts = sorted(o["ttft_s"] for _, o in results)
+    print(f"{args.requests} requests in {wall:.2f}s "
+          f"({total_tokens / wall:.1f} tok/s aggregate decode)")
+    print(f"TTFT p50={ttfts[len(ttfts) // 2] * 1e3:.0f}ms "
+          f"p99={ttfts[-1] * 1e3:.0f}ms")
+    serve.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""BASELINE workload #1: GPT-2 125M pretraining via JaxTrainer.
+
+Single host -> full chip set via the mesh; scale with --mesh fsdp=8 etc.
+
+    python examples/train_gpt2.py --model gpt2-125m --steps 50 --batch 8
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+import argparse
+
+import jax
+
+from ray_tpu import train
+from ray_tpu.train import CheckpointConfig, JaxTrainer, RunConfig, ScalingConfig
+
+
+def train_func(config):
+    from ray_tpu.comm.mesh import MeshSpec, build_mesh, set_mesh
+    from ray_tpu.models import get_config
+    from ray_tpu.train.checkpoint import AsyncCheckpointWriter
+    from ray_tpu.train.lm import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    cfg = get_config(config["model"])
+    mesh = build_mesh(MeshSpec.create(**config["mesh"]))
+    set_mesh(mesh)
+    opt = make_optimizer(
+        learning_rate=config["lr"], total_steps=config["steps"], warmup_steps=10
+    )
+    state, shardings = init_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        from ray_tpu.train.checkpoint import load_pytree
+
+        state = load_pytree(ckpt.as_directory(), target=state, shardings=shardings)
+
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=0)
+    batch = synthetic_batch(cfg, config["batch"], config["seq"])
+    writer = AsyncCheckpointWriter()
+    ctx = train.get_context()
+    with mesh:
+        for i in range(int(state["step"]), config["steps"]):
+            state, metrics = step(state, batch)
+            if (i + 1) % config["report_every"] == 0:
+                loss = float(metrics["loss"])  # readback = device sync
+                ckpt_obj = None
+                if ctx.get_world_rank() == 0 and config["checkpoint"]:
+                    path = f"{ctx.get_trial_dir()}/ckpt_{i + 1:06d}"
+                    writer.save(state, path)
+                    ckpt_obj = train.Checkpoint(path)
+                train.report({"step": i + 1, "loss": loss}, checkpoint=ckpt_obj)
+    writer.wait()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2-125m")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--mesh", default="dp=-1", help="e.g. 'fsdp=4,tp=2'")
+    p.add_argument("--no-checkpoint", action="store_true")
+    args = p.parse_args()
+    mesh = dict(kv.split("=") for kv in args.mesh.split(","))
+    mesh = {k: int(v) for k, v in mesh.items()}
+
+    result = JaxTrainer(
+        train_func,
+        train_loop_config={
+            "model": args.model, "steps": args.steps, "batch": args.batch,
+            "seq": args.seq, "lr": args.lr, "mesh": mesh,
+            "report_every": 10, "checkpoint": not args.no_checkpoint,
+        },
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="gpt2-pretrain",
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    ).fit()
+    print("final:", result.metrics, "checkpoint:", result.checkpoint)

@@ -1,0 +1,178 @@
+"""Autoscaler: resource-demand-driven slice provisioning.
+
+Reference: `python/ray/autoscaler/_private/autoscaler.py ::
+StandardAutoscaler` + `resource_demand_scheduler.py` + `node_provider.py`,
+rebuilt v2-shaped (SURVEY.md §7.5: build only the instance-manager style
+surface). TPU delta: the provisioning unit is a SLICE (host group with ICI
+topology), not a single VM — matching the slice-is-the-failure-domain
+design (§7.1.3).
+
+NodeProvider is the pluggable boundary (reference's AWS/GCP/KubeRay
+providers); FakeNodeProvider backs tests exactly like the reference's
+fake_multi_node provider.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .core.logging import get_logger
+
+logger = get_logger("autoscaler")
+
+
+@dataclasses.dataclass
+class NodeType:
+    """A provisionable shape, e.g. one v5p-16 slice = 4 hosts x 4 chips."""
+
+    name: str
+    resources: Dict[str, float]  # per-node resources
+    num_hosts: int = 1  # hosts per provisioned unit (slice granularity)
+    max_workers: int = 10  # max provisioned units
+    topology: Optional[str] = None  # e.g. "2x2x4"
+
+
+class NodeProvider:
+    """Pluggable cloud boundary."""
+
+    def create_nodes(self, node_type: NodeType, count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """-> {provider_node_id: node_type_name}"""
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Adds virtual nodes to the local Runtime (the reference's
+    RAY_FAKE_CLUSTER / FakeMultiNodeProvider pattern)."""
+
+    def __init__(self, runtime=None):
+        from . import api
+
+        self.runtime = runtime or api._auto_init()
+        self._nodes: Dict[str, Any] = {}
+        self._types: Dict[str, str] = {}
+        self._counter = 0
+
+    def create_nodes(self, node_type: NodeType, count: int) -> List[str]:
+        out = []
+        for _ in range(count):
+            for _h in range(node_type.num_hosts):
+                self._counter += 1
+                pid = f"fake-{node_type.name}-{self._counter}"
+                info = self.runtime.add_node(resources=dict(node_type.resources))
+                self._nodes[pid] = info.node_id
+                self._types[pid] = node_type.name
+                out.append(pid)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        nid = self._nodes.pop(node_id, None)
+        self._types.pop(node_id, None)
+        if nid is not None:
+            self.runtime.remove_node(nid)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        return dict(self._types)
+
+
+class Autoscaler:
+    """Reconciles pending resource demand against provisioned capacity.
+
+    Demand source: the scheduler's infeasible/pending queue (the reference
+    reads the same from GCS resource load).
+    """
+
+    def __init__(
+        self,
+        node_types: List[NodeType],
+        provider: NodeProvider,
+        runtime=None,
+        idle_timeout_s: float = 60.0,
+        update_interval_s: float = 1.0,
+    ):
+        from . import api
+
+        self.runtime = runtime or api._auto_init()
+        self.runtime.autoscaling_enabled = True
+        self.node_types = {t.name: t for t in node_types}
+        self.provider = provider
+        self.idle_timeout_s = idle_timeout_s
+        self.update_interval_s = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._idle_since: Dict[str, float] = {}
+
+    # -- demand → decisions --------------------------------------------------
+
+    def pending_demand(self) -> List[Dict[str, float]]:
+        return self.runtime.pending_resource_demand()
+
+    def _fits(self, demand: Dict[str, float], resources: Dict[str, float]) -> bool:
+        return all(resources.get(k, 0.0) >= v for k, v in demand.items())
+
+    def _cluster_can_fit(self, demand: Dict[str, float]) -> bool:
+        for node in self.runtime.control_plane.alive_nodes():
+            if self._fits(demand, node.resources_available):
+                return True
+        return False
+
+    def update(self) -> Dict[str, int]:
+        """One reconcile pass. Returns {node_type: launched_count}."""
+        launched: Dict[str, int] = {}
+        demands = [d for d in self.pending_demand() if not self._cluster_can_fit(d)]
+        by_type = self.provider.non_terminated_nodes()
+        for demand in demands:
+            for t in self.node_types.values():
+                existing = sum(1 for v in by_type.values() if v == t.name)
+                if existing >= t.max_workers:
+                    continue
+                if self._fits(demand, t.resources):
+                    self.provider.create_nodes(t, 1)
+                    launched[t.name] = launched.get(t.name, 0) + 1
+                    by_type[f"_pending{len(by_type)}"] = t.name
+                    break
+        self._scale_down()
+        return launched
+
+    def _scale_down(self) -> None:
+        """Terminate provider nodes idle (all resources free) past timeout."""
+        now = time.monotonic()
+        nodes_by_provider = self.provider.non_terminated_nodes()
+        alive = {n.node_id: n for n in self.runtime.control_plane.alive_nodes()}
+        for pid in list(nodes_by_provider):
+            nid = getattr(self.provider, "_nodes", {}).get(pid)
+            node = alive.get(nid) if nid is not None else None
+            idle = node is not None and node.resources_available == node.resources_total
+            if idle and not self.pending_demand():
+                since = self._idle_since.setdefault(pid, now)
+                if now - since > self.idle_timeout_s:
+                    logger.info("terminating idle node %s", pid)
+                    self.provider.terminate_node(pid)
+                    self._idle_since.pop(pid, None)
+            else:
+                self._idle_since.pop(pid, None)
+
+    # -- loop ----------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                logger.warning("autoscaler update failed", exc_info=True)
+            self._stop.wait(self.update_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -63,6 +63,7 @@ class NodeInfo:
 class ActorInfo:
     actor_id: ActorID
     name: str
+    class_name: str = ""
     state: ActorState = ActorState.PENDING
     node_id: Optional[NodeID] = None
     num_restarts: int = 0

@@ -164,6 +164,9 @@ class Runtime:
         self.agents: Dict[NodeID, NodeAgent] = {}
         self.head_node_id: Optional[NodeID] = None
         self.is_shutdown = False
+        # With an autoscaler attached, currently-infeasible demands stay
+        # pending (they ARE the scale-up signal) instead of failing fast.
+        self.autoscaling_enabled = False
         self._lock = threading.RLock()
         self._futures: Dict[ObjectID, _Future] = {}
         self._task_table: Dict[TaskID, Dict[str, Any]] = {}
@@ -282,6 +285,7 @@ class Runtime:
         info = ActorInfo(
             actor_id=actor_id,
             name=options.name,
+            class_name=getattr(cls, "__name__", "Actor"),
             max_restarts=options.max_restarts,
         )
         self.control_plane.register_actor(info)
@@ -436,6 +440,13 @@ class Runtime:
                 logger.warning("health check: reaping node %s", node_id.hex()[:8])
                 self.remove_node(node_id)
 
+    def pending_resource_demand(self) -> List[Dict[str, float]]:
+        """Resource shapes of queued-but-unplaced tasks — the autoscaler's
+        demand signal (reference: resource load reported to GCS)."""
+        with self._pending_cv:
+            batch = list(self._pending)
+        return [item.spec.options.resource_demand() for item in batch]
+
     # ------------------------------------------------------------ scheduling
     def _enqueue_pending(self, pending: _PendingTask) -> None:
         with self._pending_cv:
@@ -490,6 +501,8 @@ class Runtime:
                 spec, preferred_node=self.head_node_id, pg_table=self.pg_table
             )
         except ValueError as e:
+            if self.autoscaling_enabled:
+                return False  # keep pending: this demand drives scale-up
             self._fail_task(item, e)
             return True
         if node_id is None:

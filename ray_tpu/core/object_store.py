@@ -83,6 +83,11 @@ class MemoryObjectStore:
         except Exception:
             return 1024  # unpicklable (actor handles etc.) — nominal size
 
+    def list_objects(self):
+        """[(object_id, nbytes)] snapshot — the `ray memory` introspection."""
+        with self._lock:
+            return [(oid, e.nbytes) for oid, e in self._entries.items()]
+
     # -- primary API --------------------------------------------------------
     def put(self, object_id: ObjectID, value: Any, nbytes: Optional[int] = None) -> None:
         size = nbytes if nbytes is not None else self.sizeof(value)

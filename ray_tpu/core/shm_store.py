@@ -1,0 +1,183 @@
+"""ctypes binding for the C++ shared-memory object store (_shm/shm_store.cc).
+
+The native path for the host object plane (SURVEY.md N5): multi-process
+workers map one /dev/shm arena and exchange sealed immutable buffers
+zero-copy. The pure-Python in-process store remains the default for
+thread-mode runtimes; this backend turns on for process-pool workers.
+
+Build: `make -C ray_tpu/core/_shm` (auto-attempted on first use).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_shm", "libshm_store.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+ID_SIZE = 20
+
+
+class ShmStoreError(RuntimeError):
+    pass
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.join(_DIR, "_shm")],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except (subprocess.CalledProcessError, OSError) as e:
+                raise ShmStoreError(f"cannot build libshm_store.so: {e}") from e
+        lib = ctypes.CDLL(_SO)
+        lib.shm_store_create.restype = ctypes.c_void_p
+        lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.shm_store_open.restype = ctypes.c_void_p
+        lib.shm_store_open.argtypes = [ctypes.c_char_p]
+        lib.shm_obj_create.restype = ctypes.c_void_p
+        lib.shm_obj_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_obj_seal.restype = ctypes.c_int
+        lib.shm_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_obj_get.restype = ctypes.c_void_p
+        lib.shm_obj_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        for fn in ("shm_obj_release", "shm_obj_delete", "shm_obj_contains"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_live_bytes.restype = ctypes.c_uint64
+        lib.shm_store_live_bytes.argtypes = [ctypes.c_void_p]
+        lib.shm_store_capacity.restype = ctypes.c_uint64
+        lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_store_close.restype = None
+        lib.shm_store_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def _check_id(object_id: bytes) -> bytes:
+    if len(object_id) != ID_SIZE:
+        raise ValueError(f"object id must be {ID_SIZE} bytes, got {len(object_id)}")
+    return object_id
+
+
+class ShmObjectStore:
+    """One mapped store handle (create for the node owner, open for clients)."""
+
+    def __init__(self, name: str, capacity: int = 1 << 30, max_objects: int = 4096,
+                 create: bool = True):
+        self._lib = _load()
+        self.name = name if name.startswith("/") else f"/{name}"
+        if create:
+            self._h = self._lib.shm_store_create(
+                self.name.encode(), capacity, max_objects
+            )
+        else:
+            self._h = self._lib.shm_store_open(self.name.encode())
+        if not self._h:
+            raise ShmStoreError(
+                f"cannot {'create' if create else 'open'} shm store {self.name}"
+            )
+
+    # -- raw byte API --------------------------------------------------------
+
+    def put(self, object_id: bytes, data: bytes) -> None:
+        _check_id(object_id)
+        ptr = self._lib.shm_obj_create(self._h, object_id, len(data))
+        if not ptr:
+            raise ShmStoreError(
+                f"create failed for {object_id.hex()[:8]} ({len(data)}B): "
+                f"duplicate, table full, or arena exhausted"
+            )
+        ctypes.memmove(ptr, data, len(data))
+        if self._lib.shm_obj_seal(self._h, object_id) != 0:
+            raise ShmStoreError("seal failed")
+
+    def get_view(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy pinned view; call release(id) when done."""
+        _check_id(object_id)
+        size = ctypes.c_uint64()
+        ptr = self._lib.shm_obj_get(self._h, object_id, ctypes.byref(size))
+        if not ptr:
+            return None
+        arr = (ctypes.c_uint8 * size.value).from_address(ptr)
+        return memoryview(arr)
+
+    def get_bytes(self, object_id: bytes) -> Optional[bytes]:
+        view = self.get_view(object_id)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.release(object_id)
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.shm_obj_release(self._h, _check_id(object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.shm_obj_delete(self._h, _check_id(object_id)) == 0
+
+    def contains(self, object_id: bytes) -> bool:
+        return self._lib.shm_obj_contains(self._h, _check_id(object_id)) == 1
+
+    # -- numpy zero-copy -----------------------------------------------------
+
+    def put_array(self, object_id: bytes, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}|".encode()
+        total = len(header) + arr.nbytes
+        ptr = self._lib.shm_obj_create(self._h, _check_id(object_id), total)
+        if not ptr:
+            raise ShmStoreError("create failed")
+        ctypes.memmove(ptr, header, len(header))
+        ctypes.memmove(ptr + len(header), arr.ctypes.data, arr.nbytes)
+        self._lib.shm_obj_seal(self._h, object_id)
+
+    def get_array(self, object_id: bytes) -> Optional[np.ndarray]:
+        """Zero-copy read: the returned array aliases shared memory and
+        holds the pin until garbage-collected (release via .base)."""
+        view = self.get_view(object_id)
+        if view is None:
+            return None
+        raw = np.frombuffer(view, np.uint8)
+        # parse tiny header: dtype|shape|
+        first = bytes(raw[:64])
+        d1 = first.index(b"|")
+        d2 = first.index(b"|", d1 + 1)
+        dtype = np.dtype(first[:d1].decode())
+        shape_s = first[d1 + 1: d2].decode()
+        shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
+        data = raw[d2 + 1:]
+        return data.view(dtype).reshape(shape)
+
+    def live_bytes(self) -> int:
+        return self._lib.shm_store_live_bytes(self._h)
+
+    def capacity(self) -> int:
+        return self._lib.shm_store_capacity(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.shm_store_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -401,11 +401,18 @@ def decode_step(
     return logits[:, 0], {"k": new_k, "v": new_v}
 
 
-def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array, max_len: int):
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,
+    max_len: int,
+    last_index: Optional[jax.Array] = None,
+):
     """Run the full prompt, build a contiguous KV cache of size max_len.
 
-    tokens [B, T] (right-aligned real tokens assumed dense). Returns
-    (last_logits [B,V], cache dict).
+    tokens [B, T]. last_index [B] (default T-1) selects the position whose
+    logits are returned — pass true_len-1 when prompts are right-padded to
+    a compile bucket. Returns (last_logits [B,V], cache dict).
     """
     dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
@@ -439,8 +446,12 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array, max_len: int):
 
     x, (kc, vc) = jax.lax.scan(body, x, params["layers"])
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg)
+    if last_index is None:
+        x_last = x[:, -1]
+    else:
+        x_last = jnp.take_along_axis(x, last_index[:, None, None].astype(jnp.int32), axis=1)[:, 0]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bd,dv->bv", x[:, -1].astype(jnp.float32), head.astype(jnp.float32))
+    logits = jnp.einsum("bd,dv->bv", x_last.astype(jnp.float32), head.astype(jnp.float32))
     if cfg.logits_softcap:
         logits = cfg.logits_softcap * jnp.tanh(logits / cfg.logits_softcap)
     return logits, {"k": kc, "v": vc}

@@ -1,0 +1,105 @@
+"""Pipeline parallelism: SPMD GPipe over the "pp" mesh axis.
+
+Reference status (SURVEY.md §2.4): Ray has no native PP — it is delegated
+to DeepSpeed or hand-built on compiled-graph channels. Here PP is a
+library primitive: layers are stacked per stage and sharded over "pp";
+microbatches flow stage-to-stage via single-hop `ppermute` (ICI
+neighbours); the whole schedule is one `lax.scan`, so XLA overlaps the
+permute with the next microbatch's compute. Differentiable end-to-end —
+the backward pass pipelines in reverse automatically via scan's VJP.
+
+This is the SPMD formulation (every device runs the same program, stage
+identity from `axis_index`) rather than the MPMD per-stage-program design
+(PAPERS.md 2412.14374): single jit, no per-stage executables, works under
+one mesh with dp/fsdp/tp inside each stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    num_microbatches: int,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run x through S pipeline stages (per-rank body — call in shard_map).
+
+    stage_fn(stage_params, h [mb, ...]) -> h [mb, ...] applies THIS rank's
+    layer block. x [B, ...] (same value on every stage). Output [B, ...]
+    replicated across the pp axis.
+    """
+    S = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = num_microbatches
+    assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    mb = B // M
+    xm = x.reshape(M, mb, *x.shape[1:])
+    n_steps = M + S - 1  # fill + drain
+
+    perm_fwd = [(i, i + 1) for i in range(S - 1)]
+
+    def step(carry, t):
+        incoming, outputs = carry
+        # stage 0 consumes fresh microbatches while they last
+        fresh = xm[jnp.clip(t, 0, M - 1)]
+        h = jnp.where(idx == 0, fresh, incoming)
+        out = stage_fn(stage_params, h)
+        nxt = jax.lax.ppermute(out, axis_name, perm_fwd) if S > 1 else out
+        # last stage collects finished microbatch t-(S-1)
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        collect = jnp.logical_and(idx == S - 1, t >= S - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
+        outputs = jnp.where(collect, updated, outputs)
+        return (nxt, outputs), None
+
+    init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
+    (_, outputs), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
+    # only the last stage holds real outputs; broadcast over the ring
+    y = jax.lax.psum(jnp.where(idx == S - 1, outputs, 0.0), axis_name)
+    return y.reshape(B, *x.shape[1:])
+
+
+def pipelined(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    data_spec: PartitionSpec = PartitionSpec(),
+):
+    """Global-view wrapper: returns fn(stacked_stage_params, x) -> y.
+
+    stacked_stage_params: pytree with a leading STAGE axis of size
+    mesh.shape[axis_name] (each leaf [S, ...]); x per data_spec (must not
+    shard over axis_name). The stage axis is sharded over "pp"; each rank
+    sees its own [1, ...] slice, squeezed before stage_fn.
+    """
+
+    def body(params_local, x):
+        params_one = jax.tree.map(lambda p: p[0], params_local)
+        return pipeline_apply(
+            stage_fn, params_one, x, num_microbatches, axis_name
+        )
+
+    param_spec = PartitionSpec(axis_name)
+
+    def run(stacked_params, x):
+        specs_in = (
+            jax.tree.map(lambda _: param_spec, stacked_params),
+            data_spec,
+        )
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=specs_in, out_specs=data_spec,
+            check_vma=False,
+        )(stacked_params, x)
+
+    return run

@@ -1,0 +1,9 @@
+"""ray_tpu.rl — RL at scale (reference: RLlib A7, new API stack shape):
+EnvRunner sampling actors + jitted learner updates; PPO for control, GRPO
+for LLM RLHF (BASELINE workload #5)."""
+
+from .env import CartPole, Env, GymWrapper  # noqa: F401
+from .env_runner import EnvRunner, EnvRunnerGroup  # noqa: F401
+from .grpo import GRPO, GRPOConfig  # noqa: F401
+from .module import init_mlp_module, mlp_forward, mlp_forward_np  # noqa: F401
+from .ppo import PPO, PPOConfig, compute_gae  # noqa: F401

@@ -1,0 +1,121 @@
+"""EnvRunner: sampling actors (reference: `rllib/env/single_agent_env_runner.py`
++ `env_runner_group.py`).
+
+Each runner owns env copies and a frozen policy snapshot; sample() returns
+flat rollout arrays. The group fans sampling across actors and tolerates
+runner death (reference's `restart_failed_env_runners`)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+from ..core.logging import get_logger
+
+logger = get_logger("rl.env_runner")
+
+
+@api.remote
+class EnvRunner:
+    def __init__(self, env_fn: Callable[[], Any], forward_fn, seed: int = 0):
+        self.env = env_fn()
+        # Rollout actors are host-resident: forward_fn must be a HOST
+        # function (numpy in/out, e.g. module.mlp_forward_np). Per-step
+        # device dispatch — even to local CPU jax — costs ~ms; numpy is µs.
+        # The learner owns the accelerator (reference split: EnvRunner=CPU,
+        # Learner=device).
+        self.forward = forward_fn
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._obs = self.env.reset(seed=seed)
+        self._ep_return = 0.0
+        self._ep_returns: List[float] = []
+
+    def set_weights(self, params) -> bool:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, params)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        assert self.params is not None, "set_weights before sample"
+        obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
+        completed = []
+        for _ in range(num_steps):
+            logits, value = self.forward(self.params, self._obs[None])
+            logits = np.asarray(logits[0], np.float64)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            a = int(self.rng.choice(len(p), p=p))
+            obs_l.append(self._obs)
+            act_l.append(a)
+            logp_l.append(np.log(p[a] + 1e-12))
+            val_l.append(float(value[0]))
+            nxt, r, term, trunc, _ = self.env.step(a)
+            self._ep_return += r
+            rew_l.append(r)
+            done_l.append(term or trunc)
+            if term or trunc:
+                completed.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs = self.env.reset()
+            else:
+                self._obs = nxt
+        # bootstrap value for the (possibly unfinished) tail
+        _, tail_v = self.forward(self.params, self._obs[None])
+        self._ep_returns.extend(completed)
+        return {
+            "obs": np.asarray(obs_l, np.float32),
+            "actions": np.asarray(act_l, np.int32),
+            "rewards": np.asarray(rew_l, np.float32),
+            "dones": np.asarray(done_l, np.bool_),
+            "logp": np.asarray(logp_l, np.float32),
+            "values": np.asarray(val_l, np.float32),
+            "bootstrap_value": float(tail_v[0]),
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    def __init__(self, env_fn, forward_fn, num_runners: int = 2, seed: int = 0):
+        self.env_fn = env_fn
+        self.forward_fn = forward_fn
+        self.num_runners = num_runners
+        self.seed = seed
+        self.runners = [
+            EnvRunner.remote(env_fn, forward_fn, seed + i) for i in range(num_runners)
+        ]
+
+    def _restart(self, i: int, params=None) -> None:
+        self.runners[i] = EnvRunner.remote(
+            self.env_fn, self.forward_fn, self.seed + i + 1000
+        )
+        if params is not None:
+            api.get(self.runners[i].set_weights.remote(params))
+
+    def sync_weights(self, params) -> None:
+        """Push weights; dead runners are restarted, not fatal."""
+        for i, r in enumerate(self.runners):
+            try:
+                api.get(r.set_weights.remote(params), timeout=60.0)
+            except (api.RayTaskError, api.RayActorError, api.GetTimeoutError) as e:
+                logger.warning("env runner %d dead on sync (%s); restarting", i, e)
+                self._restart(i, params)
+
+    def sample(self, steps_per_runner: int, params=None) -> List[Dict[str, np.ndarray]]:
+        if params is not None:
+            self.sync_weights(params)
+        refs = [r.sample.remote(steps_per_runner) for r in self.runners]
+        out: List[Dict[str, np.ndarray]] = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(api.get(ref, timeout=300.0))
+            except (api.RayTaskError, api.RayActorError, api.GetTimeoutError) as e:
+                logger.warning("env runner %d failed (%s); restarting", i, e)
+                self._restart(i, params)
+        return out

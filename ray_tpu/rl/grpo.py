@@ -1,0 +1,134 @@
+"""GRPO: group-relative policy optimization for LLM RLHF.
+
+BASELINE.md workload #5 (PPO/GRPO RLHF). Critic-free policy gradient: per
+prompt, sample a group of completions, score with a reward fn, advantage =
+group-standardized reward, maximize advantage-weighted log-likelihood of
+the sampled tokens with a KL leash to the reference policy. Rollouts use
+models.generate (on-device sampling); the update is one jitted step over
+the gang mesh like any other LM train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.logging import get_logger
+from ..models import ModelConfig, forward, generate
+
+logger = get_logger("rl.grpo")
+
+
+@dataclasses.dataclass
+class GRPOConfig:
+    group_size: int = 8
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    lr: float = 1e-5
+    kl_coef: float = 0.02
+    clip_eps: float = 0.2
+    seed: int = 0
+
+
+class GRPO:
+    """reward_fn(prompt_ids, completion_ids) -> float."""
+
+    def __init__(
+        self,
+        params,
+        model_cfg: ModelConfig,
+        reward_fn: Callable[[List[int], List[int]], float],
+        config: Optional[GRPOConfig] = None,
+    ):
+        self.params = params
+        self.ref_params = jax.tree.map(lambda x: x, params)  # frozen reference
+        self.cfg = model_cfg
+        self.reward_fn = reward_fn
+        self.gcfg = config or GRPOConfig()
+        self.optimizer = optax.adam(self.gcfg.lr)
+        self.opt_state = self.optimizer.init(params)
+        self.iteration = 0
+        self._update = self._build_update()
+
+    def _build_update(self):
+        cfg, gcfg = self.cfg, self.gcfg
+
+        def seq_logp(params, tokens, prompt_len):
+            """Per-token logp of the completion segment. tokens [G, T]."""
+            logits, _ = forward(params, tokens[:, :-1], cfg)
+            logp = jax.nn.log_softmax(logits)
+            tgt = tokens[:, 1:]
+            lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]  # [G, T-1]
+            T = tokens.shape[1] - 1
+            mask = jnp.arange(T)[None, :] >= (prompt_len - 1)
+            return lp, mask.astype(jnp.float32)
+
+        def loss_fn(params, batch):
+            lp, mask = seq_logp(params, batch["tokens"], batch["prompt_len"])
+            lp_old = batch["logp_old"]
+            lp_ref = batch["logp_ref"]
+            adv = batch["advantages"][:, None]  # [G,1]
+            ratio = jnp.exp(lp - lp_old)
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - gcfg.clip_eps, 1 + gcfg.clip_eps) * adv
+            pg = -jnp.sum(jnp.minimum(unclipped, clipped) * mask) / jnp.maximum(mask.sum(), 1)
+            # k3 KL estimator (Schulman): E[r - 1 - log r], r = ref/cur
+            r = jnp.exp(lp_ref - lp)
+            kl = jnp.sum((r - 1 - jnp.log(r)) * mask) / jnp.maximum(mask.sum(), 1)
+            total = pg + gcfg.kl_coef * kl
+            return total, {"pg_loss": pg, "kl": kl}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        self._seq_logp = jax.jit(seq_logp)
+        return update
+
+    def train_step(self, prompt_ids: List[int]) -> Dict[str, Any]:
+        g = self.gcfg
+        G = g.group_size
+        prompt = jnp.asarray([prompt_ids] * G, jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(g.seed), self.iteration)
+        completions = generate(
+            self.params, self.cfg, prompt, key,
+            max_new_tokens=g.max_new_tokens, temperature=g.temperature,
+        )  # [G, new]
+        tokens = jnp.concatenate([prompt, completions], axis=1)
+        rewards = np.asarray([
+            self.reward_fn(list(prompt_ids), [int(t) for t in np.asarray(completions)[i]])
+            for i in range(G)
+        ], np.float32)
+        adv = (rewards - rewards.mean()) / (rewards.std() + 1e-6)
+
+        plen = len(prompt_ids)
+        lp_old, _ = self._seq_logp(self.params, tokens, plen)
+        lp_ref, _ = self._seq_logp(self.ref_params, tokens, plen)
+        batch = {
+            "tokens": tokens,
+            "prompt_len": plen,
+            "logp_old": jax.lax.stop_gradient(lp_old),
+            "logp_ref": jax.lax.stop_gradient(lp_ref),
+            "advantages": jnp.asarray(adv),
+        }
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch
+        )
+        self.iteration += 1
+        out = {k: float(v) for k, v in metrics.items()}
+        out.update({
+            "training_iteration": self.iteration,
+            "reward_mean": float(rewards.mean()),
+            "reward_std": float(rewards.std()),
+        })
+        return out

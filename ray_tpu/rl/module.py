@@ -1,0 +1,59 @@
+"""RLModule: the policy/value network (reference: `rllib/core/rl_module/`).
+
+A jax MLP with shared torso, categorical policy head and value head —
+enough for the PPO/IMPALA-style algorithms; swap in any (params, forward)
+pair with the same signature for custom models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_module(
+    key: jax.Array,
+    obs_size: int,
+    num_actions: int,
+    hidden: Sequence[int] = (64, 64),
+) -> Dict[str, Any]:
+    sizes = [obs_size, *hidden]
+    params: Dict[str, Any] = {"layers": []}
+    keys = jax.random.split(key, len(sizes) + 1)
+    for i in range(len(sizes) - 1):
+        w = jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5
+        params["layers"].append({"w": w, "b": jnp.zeros((sizes[i + 1],))})
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (sizes[-1], num_actions)) * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def mlp_forward(params, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, obs_size] -> (logits [B, A], value [B])."""
+    h = obs
+    for layer in params["layers"]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def mlp_forward_np(params, obs):
+    """Numpy twin of mlp_forward for rollout actors: per-step policy eval
+    on the host beats any device dispatch for these sizes (µs vs ms)."""
+    import numpy as np
+
+    h = obs
+    for layer in params["layers"]:
+        h = np.tanh(h @ layer["w"] + layer["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value

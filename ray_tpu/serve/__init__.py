@@ -1,0 +1,23 @@
+"""ray_tpu.serve — online serving (reference: Ray Serve A3/A4).
+
+Controller reconciles declarative deployments into replica actors; a
+pow-2 router balances requests; the HTTP proxy exposes JSON routes; and
+LLMServer/InferenceEngine provide continuously-batched paged-KV LLM
+inference on TPU.
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_port,
+    run,
+    shutdown,
+    status,
+)
+from .batching import batch  # noqa: F401
+from .config import AutoscalingConfig, DeploymentConfig  # noqa: F401
+from .deployment import Application, Deployment, deployment  # noqa: F401
+from .engine import EngineConfig, InferenceEngine, Request  # noqa: F401
+from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .llm import LLMServer  # noqa: F401

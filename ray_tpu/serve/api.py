@@ -1,0 +1,107 @@
+"""serve public API: run/shutdown/status/get_handle.
+
+Reference: `python/ray/serve/api.py :: serve.run` + CLI surface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .. import api as core_api
+from ..core.logging import get_logger
+from .controller import CONTROLLER_NAME, get_or_create_controller
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+from .http_proxy import HTTPProxy
+
+logger = get_logger("serve.api")
+
+_state_lock = threading.Lock()
+_proxy: Optional[HTTPProxy] = None
+_apps: Dict[str, str] = {}  # app name -> deployment name
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    http_port: int = 0,
+    blocking: bool = False,
+) -> DeploymentHandle:
+    """Deploy an application; returns its handle. Starts the HTTP proxy on
+    first use (port 0 = ephemeral)."""
+    global _proxy
+    core_api._auto_init()
+    if not isinstance(app, Application):
+        if isinstance(app, Deployment):
+            app = app.bind()
+        else:
+            raise TypeError("serve.run expects Deployment.bind() output")
+    controller = get_or_create_controller()
+    dep = app.deployment
+    core_api.get(controller.deploy.remote(
+        dep.name, dep._target, app.init_args, app.init_kwargs, dep.config
+    ))
+    handle = DeploymentHandle(dep.name, controller)
+    with _state_lock:
+        _apps[name] = dep.name
+        if _proxy is None:
+            _proxy = HTTPProxy(port=http_port)
+            _proxy.start()
+        _proxy.add_route(name or dep.name, handle)
+    logger.info("app %r -> deployment %r at /%s (port %d)",
+                name, dep.name, name, _proxy.port)
+    if blocking:  # pragma: no cover
+        threading.Event().wait()
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    with _state_lock:
+        dep_name = _apps[name]
+    return DeploymentHandle(dep_name)
+
+
+def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def http_port() -> Optional[int]:
+    with _state_lock:
+        return _proxy.port if _proxy else None
+
+
+def status() -> Dict[str, Any]:
+    try:
+        controller = core_api.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {}
+    return core_api.get(controller.status.remote())
+
+
+def delete(name: str = "default") -> None:
+    global _proxy
+    with _state_lock:
+        dep_name = _apps.pop(name, None)
+        if _proxy is not None:
+            _proxy.remove_route(name)
+    if dep_name is not None:
+        controller = core_api.get_actor(CONTROLLER_NAME)
+        core_api.get(controller.delete_deployment.remote(dep_name))
+
+
+def shutdown() -> None:
+    global _proxy
+    with _state_lock:
+        if _proxy is not None:
+            _proxy.stop()
+            _proxy = None
+        _apps.clear()
+    try:
+        controller = core_api.get_actor(CONTROLLER_NAME)
+        core_api.get(controller.shutdown.remote(), timeout=10.0)
+        core_api.kill(controller)
+    except Exception:
+        pass

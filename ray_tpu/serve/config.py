@@ -1,0 +1,28 @@
+"""Serve config schema (reference: `python/ray/serve/config.py` +
+`schema.py` — deployment options, autoscaling bounds)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    # smoothing on the observed load before comparing against target
+    metrics_interval_s: float = 1.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    health_check_period_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 10.0

@@ -1,0 +1,183 @@
+"""Serve controller: declarative app specs reconciled into replica actors.
+
+Reference: `python/ray/serve/_private/controller.py :: ServeController` +
+`deployment_state.py :: DeploymentStateManager` (replica state machine) +
+`autoscaling_policy.py`. One named controller actor runs a reconcile loop:
+diff target vs live replicas, start/stop, health-check, autoscale from
+replica queue metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import api
+from ..core.logging import get_logger
+from .config import AutoscalingConfig, DeploymentConfig
+from .replica import ServeReplica
+
+logger = get_logger("serve.controller")
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentState:
+    def __init__(self, name, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig):
+        self.name = name
+        self.cls_or_fn = cls_or_fn
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.replicas: List[Any] = []
+        self.version = 0
+        self.target = config.num_replicas
+        self._last_scale_up = 0.0
+        self._last_scale_down = 0.0
+        if config.autoscaling_config:
+            self.target = max(config.autoscaling_config.min_replicas, 1)
+
+
+@api.remote
+class ServeController:
+    def __init__(self, reconcile_period_s: float = 0.25):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._period = reconcile_period_s
+        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._thread.start()
+
+    # ---- control API ------------------------------------------------------
+
+    def deploy(self, name: str, cls_or_fn, init_args, init_kwargs, config: DeploymentConfig) -> bool:
+        with self._lock:
+            old = self._deployments.get(name)
+            state = _DeploymentState(name, cls_or_fn, init_args, init_kwargs, config)
+            if old is not None:
+                state.version = old.version + 1
+                self._drain(old)
+            self._deployments[name] = state
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+            if state is not None:
+                self._drain(state)
+        return state is not None
+
+    def delete_all(self) -> None:
+        with self._lock:
+            for state in self._deployments.values():
+                self._drain(state)
+            self._deployments.clear()
+
+    def get_replicas(self, name: str):
+        """-> (replica handles, version) for routers."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return [], -1
+            return list(state.replicas), state.version * 1000 + len(state.replicas)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": s.target,
+                    "live_replicas": len(s.replicas),
+                    "version": s.version,
+                }
+                for name, s in self._deployments.items()
+            }
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.delete_all()
+
+    # ---- reconcile --------------------------------------------------------
+
+    def _drain(self, state: _DeploymentState) -> None:
+        for r in state.replicas:
+            try:
+                api.kill(r)
+            except Exception:
+                pass
+        state.replicas = []
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.warning("reconcile error", exc_info=True)
+            self._stop.wait(self._period)
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        for state in states:
+            self._autoscale(state)
+            live = []
+            for r in state.replicas:
+                try:
+                    api.get(r.health_check.remote(), timeout=5.0)
+                    live.append(r)
+                except Exception:
+                    logger.warning(
+                        "replica of %s failed health check; replacing", state.name
+                    )
+            state.replicas = live
+            while len(state.replicas) < state.target:
+                opts = dict(state.config.ray_actor_options)
+                opts.setdefault("num_cpus", 1.0)
+                opts["max_concurrency"] = max(
+                    state.config.max_ongoing_requests + 2, 4
+                )
+                replica = ServeReplica.options(**opts).remote(
+                    state.name,
+                    state.cls_or_fn,
+                    state.init_args,
+                    state.init_kwargs,
+                    state.config.max_ongoing_requests,
+                )
+                state.replicas.append(replica)
+            while len(state.replicas) > state.target:
+                victim = state.replicas.pop()
+                try:
+                    api.kill(victim)
+                except Exception:
+                    pass
+
+    def _autoscale(self, state: _DeploymentState) -> None:
+        cfg: Optional[AutoscalingConfig] = state.config.autoscaling_config
+        if cfg is None or not state.replicas:
+            return
+        try:
+            loads = api.get(
+                [r.queue_len.remote() for r in state.replicas], timeout=5.0
+            )
+        except Exception:
+            return
+        avg = sum(loads) / max(len(loads), 1)
+        now = time.monotonic()
+        if avg > cfg.target_ongoing_requests and state.target < cfg.max_replicas:
+            if now - state._last_scale_up > cfg.upscale_delay_s:
+                state.target += 1
+                state._last_scale_up = now
+                logger.info("autoscale %s -> %d (avg load %.2f)", state.name, state.target, avg)
+        elif avg < cfg.target_ongoing_requests / 2 and state.target > cfg.min_replicas:
+            if now - state._last_scale_down > cfg.downscale_delay_s:
+                state.target -= 1
+                state._last_scale_down = now
+                logger.info("autoscale %s -> %d (avg load %.2f)", state.name, state.target, avg)
+
+
+def get_or_create_controller():
+    try:
+        return api.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(name=CONTROLLER_NAME).remote()

@@ -1,0 +1,413 @@
+"""Continuously-batched LLM inference engine with a paged KV cache in HBM.
+
+The TPU rebuild of what the reference delegates to vLLM (serve.llm, A4 in
+SURVEY.md §2.3): requests join and leave the running decode batch every
+step (continuous batching); KV lives in fixed-size pages addressed by
+per-sequence page tables (paged attention — ops/paged_attention.py's
+Pallas kernel); prompt prefill runs at compile-bucketed lengths so XLA
+compiles a handful of shapes, not one per prompt length.
+
+Execution shapes are static: the decode batch is a fixed-size slot array
+(inactive slots write to a reserved trash page and are masked out of
+attention by length=0), so the whole serving loop reuses two compiled
+programs (prefill-per-bucket + one decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.logging import get_logger
+from ..models import ModelConfig
+from ..models.transformer import _dense_ffn, _moe_ffn, _norm, prefill
+from ..ops import apply_rope, paged_attention_decode, rope_frequencies
+
+logger = get_logger("serve.engine")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    max_batch_size: int = 8
+    page_size: int = 16
+    max_pages: int = 512  # total pages in the cache pool (incl. trash page)
+    max_seq_len: int = 1024
+    prefill_buckets: tuple = (64, 128, 256, 512, 1024)
+    eos_token_id: Optional[int] = None
+    cache_dtype: str = "bfloat16"
+
+    @property
+    def pages_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.page_size)
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt: List[int]
+    max_tokens: int
+    temperature: float = 0.0
+    # filled by the engine
+    output: List[int] = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    error: Optional[str] = None
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+
+class _Slot:
+    __slots__ = ("request", "pages", "position", "generated")
+
+    def __init__(self):
+        self.request: Optional[Request] = None
+        self.pages: List[int] = []
+        self.position = 0  # next write position (== current length)
+        self.generated = 0
+
+
+class PageAllocator:
+    """Free-list over page ids; page 0 is the reserved trash page that
+    inactive decode slots write into."""
+
+    def __init__(self, num_pages: int):
+        self._free = list(range(num_pages - 1, 0, -1))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if len(self._free) < n:
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(pages)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+
+class InferenceEngine:
+    def __init__(self, params, model_cfg: ModelConfig, engine_cfg: EngineConfig):
+        self.params = params
+        self.cfg = model_cfg
+        self.ecfg = engine_cfg
+        B = engine_cfg.max_batch_size
+        L, KVH, hd = model_cfg.n_layers, model_cfg.kv_heads, model_cfg.hdim
+        P, ps = engine_cfg.max_pages, engine_cfg.page_size
+        dtype = jnp.dtype(engine_cfg.cache_dtype)
+        self.k_pages = jnp.zeros((L, KVH, P, ps, hd), dtype)
+        self.v_pages = jnp.zeros((L, KVH, P, ps, hd), dtype)
+        self.allocator = PageAllocator(P)
+        self.slots = [_Slot() for _ in range(B)]
+        self.pending: "queue.Queue[Request]" = queue.Queue()
+        self._results: Dict[str, Request] = {}
+        self._step_count = 0
+        self._lock = threading.Lock()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._decode = self._build_decode()
+        self._prefill_cache: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------- compiled
+
+    def _build_decode(self):
+        cfg, ecfg = self.cfg, self.ecfg
+        ps = ecfg.page_size
+
+        def decode(params, k_pages, v_pages, tokens, positions, page_tables, temps, key):
+            """tokens/positions [B]; page_tables [B, pages_per_seq]."""
+            dtype = jnp.dtype(cfg.dtype)
+            B = tokens.shape[0]
+            x = params["embed"][tokens][:, None].astype(dtype)  # [B,1,D]
+            if cfg.positional == "learned":
+                x = x + params["pos_emb"][positions][:, None].astype(dtype)
+                rope_tables = None
+            else:
+                rope_tables = rope_frequencies(cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
+            pos2d = positions[:, None]
+            page_idx = page_tables[jnp.arange(B), positions // ps]  # [B]
+            slot_idx = positions % ps
+
+            def body(carry, xs):
+                x = carry
+                lp, kp, vp = xs  # kp/vp [KVH, P, ps, hd]
+                h = _norm(x, lp["ln1"], lp.get("ln1_b"), cfg)
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+                k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+                v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+                if cfg.positional == "rope":
+                    cos, sin = rope_tables
+                    q = apply_rope(q, cos, sin, pos2d)
+                    k = apply_rope(k, cos, sin, pos2d)
+                # write this token's kv into its page slot
+                kp = kp.at[:, page_idx, slot_idx].set(
+                    k[:, 0].transpose(1, 0, 2).astype(kp.dtype)
+                )
+                vp = vp.at[:, page_idx, slot_idx].set(
+                    v[:, 0].transpose(1, 0, 2).astype(vp.dtype)
+                )
+                o = paged_attention_decode(
+                    q[:, 0], kp, vp, page_tables, positions + 1
+                )
+                o = jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(dtype))[:, None]
+                x = x + o
+                h = _norm(x, lp["ln2"], lp.get("ln2_b"), cfg)
+                if cfg.is_moe:
+                    y, _ = _moe_ffn(h, lp, cfg)
+                else:
+                    y = _dense_ffn(h, lp, cfg)
+                return x + y, (kp, vp)
+
+            x, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages)
+            )
+            x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg)
+            head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+            logits = jnp.einsum(
+                "bd,dv->bv", x[:, 0].astype(jnp.float32), head.astype(jnp.float32)
+            )
+            if cfg.logits_softcap:
+                logits = cfg.logits_softcap * jnp.tanh(logits / cfg.logits_softcap)
+            # per-slot sampling: temp<=0 -> greedy
+            greedy = jnp.argmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(key, scaled, axis=-1)
+            toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return toks, new_k, new_v
+
+        return jax.jit(decode, donate_argnums=(1, 2))
+
+    def _prefill_fn(self, bucket: int):
+        if bucket not in self._prefill_cache:
+            cfg = self.cfg
+
+            def run(params, tokens, true_len):
+                return prefill(
+                    params, cfg, tokens, max_len=bucket, last_index=true_len - 1
+                )
+
+            self._prefill_cache[bucket] = jax.jit(run)
+        return self._prefill_cache[bucket]
+
+    def _scatter_prefill(self, cache, pages: List[int], true_len: int):
+        """Write a prefill cache [L,1,Tpad,KVH,hd] into the page pool."""
+        ps = self.ecfg.page_size
+        n = len(pages)
+        k = cache["k"][:, 0]  # [L, Tpad, KVH, hd]
+        v = cache["v"][:, 0]
+        Tpad = k.shape[1]
+        n_full = min(n, Tpad // ps)
+        page_arr = jnp.asarray(pages[:n_full], jnp.int32)
+        self.k_pages, self.v_pages = _scatter_pages_jit(
+            self.k_pages, self.v_pages, k, v, page_arr, n_full, ps
+        )
+
+    # ------------------------------------------------------------- requests
+
+    def add_request(self, req: Request) -> None:
+        if len(req.prompt) + req.max_tokens > self.ecfg.max_seq_len:
+            req.error = (
+                f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
+                f"max_seq_len {self.ecfg.max_seq_len}"
+            )
+            req.done.set()
+            return
+        with self._lock:
+            self._results[req.request_id] = req
+        self.pending.put(req)
+        self._ensure_loop()
+
+    def _ensure_loop(self):
+        with self._lock:
+            if self._loop_thread is None or not self._loop_thread.is_alive():
+                self._stop.clear()
+                self._loop_thread = threading.Thread(target=self._loop, daemon=True)
+                self._loop_thread.start()
+
+    def _active(self) -> List[_Slot]:
+        return [s for s in self.slots if s.request is not None]
+
+    def _loop(self):
+        idle_since = time.monotonic()
+        while not self._stop.is_set():
+            progressed = self.step()
+            if progressed:
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > 5.0:
+                return  # park the loop; next add_request revives it
+            elif not self._active():
+                try:
+                    req = self.pending.get(timeout=0.2)
+                    self.pending.queue.appendleft(req)  # peeked
+                except queue.Empty:
+                    continue
+
+    # ------------------------------------------------------------- stepping
+
+    def _admit_one(self) -> bool:
+        free_slots = [s for s in self.slots if s.request is None]
+        if not free_slots or self.pending.empty():
+            return False
+        req: Request = self.pending.get()
+        T = len(req.prompt)
+        total = T + req.max_tokens
+        n_pages = -(-total // self.ecfg.page_size)
+        pages = self.allocator.alloc(n_pages)
+        if pages is None:
+            self.pending.queue.appendleft(req)  # wait for frees
+            return False
+        bucket = next(
+            (b for b in self.ecfg.prefill_buckets if b >= T),
+            self.ecfg.prefill_buckets[-1],
+        )
+        if T > bucket:
+            self.allocator.free(pages)
+            req.error = f"prompt length {T} exceeds largest bucket {bucket}"
+            req.done.set()
+            return False
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :T] = req.prompt
+        logits, cache = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(padded), jnp.asarray([T], jnp.int32)
+        )
+        self._scatter_prefill(cache, pages, T)
+        # sample the first generated token on host (one small readback)
+        first = _sample_host(np.asarray(logits[0]), req.temperature)
+        req.first_token_at = time.monotonic()
+        req.output.append(int(first))
+        slot = [s for s in self.slots if s.request is None][0]
+        slot.request = req
+        slot.pages = pages
+        slot.position = T  # the sampled token will be written at T
+        slot.generated = 1
+        self._maybe_finish(slot, int(first))
+        return True
+
+    def step(self) -> bool:
+        """One engine iteration: admit waiting requests, then one decode
+        step for the whole active batch. Returns True if work happened."""
+        admitted = False
+        while self._admit_one():
+            admitted = True
+        active = self._active()
+        if not active:
+            return admitted
+
+        B = self.ecfg.max_batch_size
+        pps = self.ecfg.pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, pps), np.int32)  # page 0 = trash
+        temps = np.zeros((B,), np.float32)
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            tokens[i] = s.request.output[-1]
+            positions[i] = s.position
+            tables[i, : len(s.pages)] = s.pages
+            temps[i] = s.request.temperature
+        self._step_count += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(0), self._step_count)
+        toks, self.k_pages, self.v_pages = self._decode(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(temps), key,
+        )
+        toks = np.asarray(toks)  # the per-step readback
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            s.position += 1
+            tok = int(toks[i])
+            if s.generated < s.request.max_tokens and not s.request.done.is_set():
+                s.request.output.append(tok)
+                s.generated += 1
+            self._maybe_finish(s, tok)
+        return True
+
+    def _maybe_finish(self, slot: _Slot, last_tok: int) -> None:
+        req = slot.request
+        if req is None:
+            return
+        eos = self.ecfg.eos_token_id
+        if slot.generated >= req.max_tokens or (eos is not None and last_tok == eos):
+            if eos is not None and req.output and req.output[-1] == eos:
+                req.output.pop()
+            req.finished_at = time.monotonic()
+            req.done.set()
+            self.allocator.free(slot.pages)
+            slot.request = None
+            slot.pages = []
+            slot.position = 0
+            slot.generated = 0
+
+    # ------------------------------------------------------------- blocking
+
+    def generate(
+        self,
+        prompt: List[int],
+        max_tokens: int = 32,
+        temperature: float = 0.0,
+        request_id: Optional[str] = None,
+        timeout_s: float = 600.0,
+    ) -> Dict[str, Any]:
+        import uuid
+
+        req = Request(
+            request_id=request_id or uuid.uuid4().hex,
+            prompt=list(prompt),
+            max_tokens=max_tokens,
+            temperature=temperature,
+        )
+        self.add_request(req)
+        if not req.done.wait(timeout_s):
+            raise TimeoutError(f"request {req.request_id} timed out")
+        if req.error:
+            raise ValueError(req.error)
+        return {
+            "request_id": req.request_id,
+            "token_ids": list(req.output),
+            "ttft_s": (req.first_token_at or 0) - req.submitted_at,
+            "latency_s": (req.finished_at or 0) - req.submitted_at,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active": len(self._active()),
+            "pending": self.pending.qsize(),
+            "free_pages": self.allocator.num_free,
+            "steps": self._step_count,
+        }
+
+    def stop(self):
+        self._stop.set()
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0, 1))
+def _scatter_pages_jit(k_pages, v_pages, k, v, page_arr, n_full, ps):
+    """k/v [L, Tpad, KVH, hd] -> pages[:, :, page_arr]."""
+    L, Tpad, KVH, hd = k.shape
+    kb = k[:, : n_full * ps].reshape(L, n_full, ps, KVH, hd).transpose(0, 3, 1, 2, 4)
+    vb = v[:, : n_full * ps].reshape(L, n_full, ps, KVH, hd).transpose(0, 3, 1, 2, 4)
+    k_pages = k_pages.at[:, :, page_arr].set(kb.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, :, page_arr].set(vb.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+def _sample_host(logits: np.ndarray, temperature: float) -> int:
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    logits = logits / temperature
+    logits -= logits.max()
+    p = np.exp(logits)
+    p /= p.sum()
+    return int(np.random.choice(len(p), p=p))

@@ -1,0 +1,108 @@
+"""HTTP proxy: JSON requests routed to deployment handles.
+
+Reference: `python/ray/serve/_private/proxy.py :: ProxyActor` (uvicorn).
+Here: a threaded stdlib HTTP server per proxy (no external deps), JSON
+body in / JSON out, one route per application:
+  POST /<app_name>           -> handle.remote(body)
+  POST /<app_name>/<method>  -> handle.<method>.remote(body)
+  GET  /-/healthz, /-/routes
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ..core.logging import get_logger
+
+logger = get_logger("serve.proxy")
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self.routes: Dict[str, Any] = {}  # app name -> DeploymentHandle
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def add_route(self, name: str, handle) -> None:
+        self.routes[name] = handle
+
+    def remove_route(self, name: str) -> None:
+        self.routes.pop(name, None)
+
+    def start(self) -> int:
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, payload: Any):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/-/healthz":
+                    return self._send(200, {"status": "ok"})
+                if self.path == "/-/routes":
+                    return self._send(200, sorted(proxy.routes))
+                return self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                parts = [p for p in self.path.split("/") if p]
+                if not parts or parts[0] not in proxy.routes:
+                    return self._send(404, {"error": f"no app at {self.path}"})
+                handle = proxy.routes[parts[0]]
+                if len(parts) > 1:
+                    handle = handle.options(parts[1])
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(raw) if raw.strip() else {}
+                except json.JSONDecodeError as e:
+                    return self._send(400, {"error": f"bad json: {e}"})
+                try:
+                    result = handle.remote(payload).result(timeout=300.0)
+                    return self._send(200, {"result": _jsonable(result)})
+                except Exception as e:
+                    logger.warning("request failed", exc_info=True)
+                    return self._send(500, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info("HTTP proxy on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def _jsonable(x: Any) -> Any:
+    try:
+        json.dumps(x)
+        return x
+    except TypeError:
+        import numpy as np
+
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+        if isinstance(x, (np.integer, np.floating)):
+            return x.item()
+        if isinstance(x, dict):
+            return {k: _jsonable(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [_jsonable(v) for v in x]
+        return repr(x)

@@ -1,0 +1,57 @@
+"""LLM serving deployment: the serve-level wrapper over InferenceEngine.
+
+Reference analogue: `ray.serve.llm :: LLMServer / build_openai_app` (A4).
+One replica = one engine (= one chip/slice); serve's router spreads
+requests over replicas, the engine continuously batches within a replica.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ..models import get_config, init_params
+from .deployment import deployment
+from .engine import EngineConfig, InferenceEngine
+
+
+@deployment(name="llm", max_ongoing_requests=32)
+class LLMServer:
+    """Token-level LLM server.
+
+    Request: {"prompt_ids": [int], "max_tokens": int, "temperature": float}
+    Response: {"token_ids": [...], "ttft_s": ..., "latency_s": ...}
+
+    params_fn: optional () -> (params, model_cfg) to load real weights;
+    default builds random-init weights for the named config.
+    """
+
+    def __init__(
+        self,
+        model_name: str = "tiny-llama",
+        engine_config: Optional[Dict[str, Any]] = None,
+        params_fn=None,
+        model_overrides: Optional[Dict[str, Any]] = None,
+    ):
+        if params_fn is not None:
+            params, cfg = params_fn()
+        else:
+            cfg = get_config(model_name, **(model_overrides or {}))
+            params = init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(**(engine_config or {}))
+        self.engine = InferenceEngine(params, cfg, ecfg)
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self.engine.generate(
+            prompt=list(request["prompt_ids"]),
+            max_tokens=int(request.get("max_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            request_id=request.get("request_id"),
+        )
+
+    def stats(self, _request: Any = None) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def check_health(self) -> None:
+        pass

@@ -1,0 +1,68 @@
+"""Replica actor: wraps the user callable, tracks in-flight load.
+
+Reference: `python/ray/serve/_private/replica.py :: UserCallableWrapper`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import api
+
+
+@api.remote
+class ServeReplica:
+    def __init__(self, deployment_name: str, cls_or_fn, init_args, init_kwargs,
+                 max_ongoing_requests: int = 8):
+        self.deployment_name = deployment_name
+        self.max_ongoing_requests = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        import inspect
+
+        if inspect.isclass(cls_or_fn):
+            self._callable = cls_or_fn(*init_args, **(init_kwargs or {}))
+            self._is_function = False
+        else:
+            self._callable = cls_or_fn
+            self._is_function = True
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method or "__call__")
+            return target(*args, **(kwargs or {}))
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "deployment": self.deployment_name,
+                "ongoing": self._ongoing,
+                "total": self._total,
+            }
+
+    def health_check(self) -> bool:
+        chk = getattr(self._callable, "check_health", None)
+        if chk is not None:
+            chk()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)

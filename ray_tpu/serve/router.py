@@ -1,0 +1,57 @@
+"""Request router: power-of-two-choices replica scheduling.
+
+Reference: `python/ray/serve/_private/replica_scheduler/pow_2_scheduler.py
+:: PowerOfTwoChoicesReplicaScheduler`. The router samples two replicas,
+compares tracked in-flight counts (local optimistic counts reconciled
+against completed refs), and sends to the shorter queue — O(1) balancing
+with near-optimal tail latency.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import api
+
+
+class Pow2Router:
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._replicas: List[Any] = []  # ActorHandles
+        self._inflight: Dict[int, List[Any]] = {}  # replica idx -> refs
+        self._lock = threading.Lock()
+        self._version = -1
+
+    def update_replicas(self, replicas: List[Any], version: int) -> None:
+        with self._lock:
+            if version <= self._version:
+                return
+            self._replicas = list(replicas)
+            self._inflight = {i: [] for i in range(len(replicas))}
+            self._version = version
+
+    def _load(self, idx: int) -> int:
+        refs = self._inflight.get(idx, [])
+        if refs:
+            done, pending = api.wait(refs, num_returns=len(refs), timeout=0)
+            self._inflight[idx] = pending
+        return len(self._inflight.get(idx, []))
+
+    def assign(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"no replicas available for {self.deployment_name!r}"
+                )
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self._load(a) <= self._load(b) else b
+            replica = self._replicas[idx]
+            ref = replica.handle_request.remote(method, args, kwargs)
+            self._inflight[idx].append(ref)
+            return ref

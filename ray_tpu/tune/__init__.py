@@ -1,0 +1,20 @@
+"""ray_tpu.tune — hyperparameter search over trial actors (reference: Ray
+Tune A5): search spaces, random/grid suggestion, ASHA + PBT schedulers,
+session-based reporting shared with ray_tpu.train."""
+
+from ..train.session import get_checkpoint, get_context, report  # noqa: F401
+from .schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from .search import (  # noqa: F401
+    choice,
+    generate_configs,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from .trial import Trial, TrialStatus  # noqa: F401
+from .tuner import ResultGrid, TuneConfig, Tuner, run  # noqa: F401

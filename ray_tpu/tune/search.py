@@ -1,0 +1,105 @@
+"""Search spaces + suggestion (reference: `python/ray/tune/search/` —
+`sample.py` domains, BasicVariantGenerator, grid_search)."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclasses.dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclasses.dataclass
+class RandInt(Domain):
+    low: int
+    high: int  # exclusive
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclasses.dataclass
+class Choice(Domain):
+    options: Sequence[Any]
+
+    def sample(self, rng):
+        return rng.choice(list(self.options))
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: Sequence[Any]
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(options) -> Choice:
+    return Choice(options)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def _grid_axes(space: Dict[str, Any]):
+    keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
+    axes = [list(space[k].values) for k in keys]
+    return keys, axes
+
+
+def generate_configs(
+    space: Dict[str, Any], num_samples: int, seed: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """Grid axes expand combinatorially; Domains sample; constants pass
+    through. num_samples repeats the whole (sampled) space."""
+    rng = random.Random(seed)
+    keys, axes = _grid_axes(space)
+    grid_points = list(itertools.product(*axes)) if axes else [()]
+    configs = []
+    for _ in range(num_samples):
+        for point in grid_points:
+            cfg = {}
+            for k, v in space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = point[keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            configs.append(cfg)
+    return configs

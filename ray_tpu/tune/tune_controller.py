@@ -1,0 +1,170 @@
+"""Trial execution loop (reference: `python/ray/tune/execution/
+tune_controller.py :: TuneController`).
+
+Trials run as actors (function trainables wrapped with the train-session
+reporting machinery); the controller polls streamed reports, consults the
+scheduler for early-stop decisions, enforces a concurrency cap, retries
+failed trials, and drives PBT exploit/restart.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core.logging import get_logger
+from ..train.checkpoint import Checkpoint
+from ..train.session import TrainContext, _Report, _TrainSession, _set_session
+from .schedulers import COMPLETE, CONTINUE, STOP, FIFOScheduler
+from .trial import Trial, TrialStatus
+
+logger = get_logger("tune.controller")
+
+
+@api.remote
+class TrialRunner:
+    """Runs one trial's trainable with session-based reporting."""
+
+    def __init__(self, trial_id: str):
+        self.trial_id = trial_id
+        self.session: Optional[_TrainSession] = None
+
+    def run(self, trainable: Callable, config: Dict[str, Any],
+            resume_checkpoint: Optional[Checkpoint]) -> Any:
+        ctx = TrainContext(experiment_name=self.trial_id, gang_name=self.trial_id)
+        self.session = _TrainSession(ctx, resume_checkpoint)
+        _set_session(self.session)
+        try:
+            out = trainable(config)
+            if isinstance(out, dict):
+                self.session.report(out, None)
+            return None
+        finally:
+            self.session.finished = True
+            _set_session(None)
+
+    def poll(self) -> List[Any]:
+        return self.session.drain() if self.session else []
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable,
+        configs: List[Dict[str, Any]],
+        scheduler=None,
+        max_concurrent: int = 4,
+        max_retries: int = 0,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+    ):
+        self.trainable = trainable
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent
+        self.max_retries = max_retries
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        self.trials = [
+            Trial(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", config=cfg)
+            for i, cfg in enumerate(configs)
+        ]
+        self._actors: Dict[str, Any] = {}
+        self._run_refs: Dict[str, Any] = {}
+        self._resume: Dict[str, Optional[Checkpoint]] = {}
+
+    # ------------------------------------------------------------------
+
+    def _launch(self, trial: Trial) -> None:
+        actor = TrialRunner.options(
+            max_concurrency=2, num_cpus=self.resources.get("CPU", 1.0),
+            num_tpus=self.resources.get("TPU", 0.0),
+        ).remote(trial.trial_id)
+        ref = actor.run.remote(
+            self.trainable, trial.config, self._resume.get(trial.trial_id)
+        )
+        self._actors[trial.trial_id] = actor
+        self._run_refs[trial.trial_id] = ref
+        trial.status = TrialStatus.RUNNING
+
+    def _stop_trial(self, trial: Trial, *, early: bool) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        self._run_refs.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                api.kill(actor)
+            except Exception:
+                pass
+        trial.status = TrialStatus.TERMINATED
+        trial.stopped_early = early
+
+    def _drain_reports(self, trial: Trial) -> List[_Report]:
+        actor = self._actors.get(trial.trial_id)
+        if actor is None:
+            return []
+        try:
+            return api.get(actor.poll.remote(), timeout=10.0)
+        except Exception:
+            return []
+
+    def _handle_reports(self, trial: Trial) -> None:
+        for rep in self._drain_reports(trial):
+            trial.results.append(rep.metrics)
+            if rep.checkpoint is not None:
+                trial.checkpoint = rep.checkpoint
+            decision = self.scheduler.on_result(trial, rep.metrics, self.trials)
+            if decision in (STOP, COMPLETE) and trial.status is TrialStatus.RUNNING:
+                logger.info(
+                    "scheduler %s %s at %s",
+                    "stopped" if decision == STOP else "completed",
+                    trial.trial_id, rep.metrics,
+                )
+                self._stop_trial(trial, early=decision == STOP)
+                return
+            exploit = self.scheduler.exploit(trial, self.trials)
+            if exploit is not None:
+                new_config, src_ckpt = exploit
+                logger.info("PBT exploit: %s adopts %s", trial.trial_id, new_config)
+                self._stop_trial(trial, early=False)
+                trial.config = new_config
+                trial.status = TrialStatus.PENDING
+                self._resume[trial.trial_id] = src_ckpt
+                return
+
+    def run(self) -> List[Trial]:
+        while True:
+            running = [t for t in self.trials if t.status is TrialStatus.RUNNING]
+            pending = [t for t in self.trials if t.status is TrialStatus.PENDING]
+            if not running and not pending:
+                break
+            while pending and len(running) < self.max_concurrent:
+                t = pending.pop(0)
+                self._launch(t)
+                running.append(t)
+
+            refs = {self._run_refs[t.trial_id]: t for t in running if t.trial_id in self._run_refs}
+            done, _ = api.wait(list(refs), num_returns=len(refs), timeout=0.2)
+            for t in list(running):
+                if t.status is TrialStatus.RUNNING:
+                    self._handle_reports(t)
+            for ref in done:
+                trial = refs[ref]
+                if trial.status is not TrialStatus.RUNNING:
+                    continue  # already stopped/exploited
+                try:
+                    api.get(ref)
+                    self._handle_reports(trial)
+                    self._stop_trial(trial, early=False)
+                except (api.RayTaskError, api.RayActorError) as e:
+                    trial.restarts += 1
+                    if trial.restarts <= self.max_retries:
+                        logger.warning("retrying %s after %s", trial.trial_id, e)
+                        self._actors.pop(trial.trial_id, None)
+                        self._run_refs.pop(trial.trial_id, None)
+                        trial.status = TrialStatus.PENDING
+                        if trial.checkpoint is not None:
+                            self._resume[trial.trial_id] = trial.checkpoint
+                    else:
+                        trial.error = str(e)
+                        self._stop_trial(trial, early=False)
+                        trial.status = TrialStatus.ERROR
+        return self.trials

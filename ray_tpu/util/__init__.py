@@ -1,0 +1,4 @@
+"""ray_tpu.util — user-facing utilities (reference: `python/ray/util/`)."""
+
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Queue  # noqa: F401

@@ -1,0 +1,134 @@
+"""State API (reference: `python/ray/util/state/api.py` — `ray list
+actors/tasks/nodes`, `ray summary`): queryable cluster state with filters,
+plus a Prometheus metrics HTTP endpoint (dashboard-lite: the reference's
+observability planes without the React app, per SURVEY.md §7.5)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import asdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import api
+from ..core.metrics import registry as metrics_registry
+
+Filter = Tuple[str, str, Any]  # (key, "=" | "!=", value)
+
+
+def _apply_filters(rows: List[Dict[str, Any]], filters) -> List[Dict[str, Any]]:
+    for key, op, value in filters or []:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def list_nodes(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = api._auto_init()
+    rows = []
+    for n in rt.control_plane.all_nodes():
+        rows.append({
+            "node_id": n.node_id.hex()[:16],
+            "state": n.state.name,
+            "resources_total": dict(n.resources_total),
+            "resources_available": dict(n.resources_available),
+            "labels": dict(n.labels or {}),
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = api._auto_init()
+    rows = []
+    for a in rt.control_plane.list_actors():
+        rows.append({
+            "actor_id": a.actor_id.hex()[:16],
+            "class_name": a.class_name,
+            "state": a.state.name,
+            "name": a.name or "",
+            "node_id": a.node_id.hex()[:16] if a.node_id else "",
+            "restarts": a.num_restarts,
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_jobs(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = api._auto_init()
+    rows = [
+        {"job_id": j.hex()[:16], **meta}
+        for j, meta in rt.control_plane.list_jobs().items()
+    ]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    rt = api._auto_init()
+    rows = []
+    for agent in rt.agents.values():
+        for oid, size in agent.store.list_objects():
+            rows.append({
+                "object_id": oid.hex()[:16],
+                "node_id": agent.node_id.hex()[:16],
+                "size_bytes": size,
+            })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summary() -> Dict[str, Any]:
+    rt = api._auto_init()
+    actors = list_actors(limit=10_000)
+    by_state: Dict[str, int] = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    return {
+        "nodes_alive": len(rt.control_plane.alive_nodes()),
+        "nodes_total": len(rt.control_plane.all_nodes()),
+        "actors_by_state": by_state,
+        "cluster_resources": api.cluster_resources(),
+        "available_resources": api.available_resources(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Metrics endpoint (per-node agent's /metrics in the reference)
+# ---------------------------------------------------------------------------
+
+_metrics_server = None
+
+
+def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Serve the process metrics registry as Prometheus text. -> bound port."""
+    global _metrics_server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = metrics_registry.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    _metrics_server = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=_metrics_server.serve_forever, daemon=True)
+    t.start()
+    return _metrics_server.server_address[1]
+
+
+def stop_metrics_server() -> None:
+    global _metrics_server
+    if _metrics_server is not None:
+        _metrics_server.shutdown()
+        _metrics_server.server_close()
+        _metrics_server = None

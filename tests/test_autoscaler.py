@@ -1,0 +1,95 @@
+"""Autoscaler tests: demand-driven scale up, max_workers cap, idle scale
+down, end-to-end unblocking of infeasible-at-the-moment tasks."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, FakeNodeProvider, NodeType
+
+
+@pytest.fixture
+def rt():
+    runtime = ray_tpu.init(num_cpus=1, num_tpus=0)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestAutoscaler:
+    def test_scale_up_on_demand(self, rt):
+        provider = FakeNodeProvider(rt)
+        scaler = Autoscaler(
+            [NodeType("cpu-worker", {"CPU": 4.0}, max_workers=3)],
+            provider, rt,
+        )
+
+        @ray_tpu.remote(num_cpus=4)
+        def heavy():
+            return 1
+
+        ref = heavy.remote()  # cannot fit on the 1-CPU head node
+        assert _wait(lambda: rt.pending_resource_demand())
+        launched = scaler.update()
+        assert launched == {"cpu-worker": 1}
+        assert ray_tpu.get(ref, timeout=30) == 1
+
+    def test_max_workers_cap(self, rt):
+        provider = FakeNodeProvider(rt)
+        scaler = Autoscaler(
+            [NodeType("cpu-worker", {"CPU": 2.0}, max_workers=1)],
+            provider, rt,
+        )
+
+        @ray_tpu.remote(num_cpus=2)
+        def task(i):
+            time.sleep(1.0)
+            return i
+
+        refs = [task.remote(i) for i in range(4)]
+        assert _wait(lambda: rt.pending_resource_demand())
+        scaler.update()
+        scaler.update()  # second pass must not exceed the cap
+        assert len(provider.non_terminated_nodes()) == 1
+        assert sorted(ray_tpu.get(refs, timeout=60)) == [0, 1, 2, 3]
+
+    def test_slice_granularity(self, rt):
+        provider = FakeNodeProvider(rt)
+        scaler = Autoscaler(
+            [NodeType("v5p-slice", {"CPU": 1.0, "TPU": 4.0}, num_hosts=4,
+                      topology="2x2x4", max_workers=2)],
+            provider, rt,
+        )
+
+        @ray_tpu.remote(num_tpus=4, num_cpus=0)
+        def tpu_task():
+            return "ok"
+
+        ref = tpu_task.remote()
+        assert _wait(lambda: rt.pending_resource_demand())
+        scaler.update()
+        # one slice = 4 hosts provisioned atomically
+        assert len(provider.non_terminated_nodes()) == 4
+        assert ray_tpu.get(ref, timeout=30) == "ok"
+
+    def test_idle_scale_down(self, rt):
+        provider = FakeNodeProvider(rt)
+        scaler = Autoscaler(
+            [NodeType("cpu-worker", {"CPU": 2.0}, max_workers=2)],
+            provider, rt, idle_timeout_s=0.3,
+        )
+        provider.create_nodes(scaler.node_types["cpu-worker"], 1)
+        assert len(provider.non_terminated_nodes()) == 1
+        scaler.update()  # starts idle clock
+        time.sleep(0.5)
+        scaler.update()  # past timeout -> terminate
+        assert len(provider.non_terminated_nodes()) == 0

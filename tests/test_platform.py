@@ -1,0 +1,108 @@
+"""Platform-layer tests: state API, metrics endpoint, ActorPool, Queue,
+job submission."""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util import state as state_api
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+class TestStateAPI:
+    def test_list_nodes_and_summary(self):
+        nodes = state_api.list_nodes()
+        assert len(nodes) == 1
+        assert nodes[0]["state"] == "ALIVE"
+        s = state_api.summary()
+        assert s["nodes_alive"] == 1
+        assert "CPU" in s["cluster_resources"]
+
+    def test_list_actors_with_filters(self):
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.remote()
+        ray_tpu.get(a.ping.remote())
+        rows = state_api.list_actors(filters=[("state", "=", "ALIVE")])
+        assert any(r["class_name"] == "A" for r in rows)
+        rows = state_api.list_actors(filters=[("class_name", "=", "Nope")])
+        assert rows == []
+
+    def test_metrics_endpoint(self):
+        from ray_tpu.core.metrics import Counter
+
+        c = Counter("test_requests_total", "test")
+        c.inc(3)
+        port = state_api.start_metrics_server()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+            assert "test_requests_total" in text
+        finally:
+            state_api.stop_metrics_server()
+
+
+class TestUtil:
+    def test_actor_pool(self):
+        @ray_tpu.remote
+        class Worker:
+            def work(self, x):
+                return x * 2
+
+        pool = ActorPool([Worker.remote() for _ in range(2)])
+        out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(8)))
+        assert out == [x * 2 for x in range(8)]
+
+    def test_queue(self):
+        q = Queue(maxsize=4)
+        q.put("a")
+        q.put("b")
+        assert q.qsize() == 2
+        assert q.get() == "a"
+        assert q.get() == "b"
+        from ray_tpu.util.queue import Empty
+
+        with pytest.raises(Empty):
+            q.get_nowait()
+        q.shutdown()
+
+
+class TestJobs:
+    def test_submit_and_succeed(self):
+        client = JobSubmissionClient()
+        jid = client.submit_job(entrypoint="echo hello_from_job")
+        status = client.wait_until_finish(jid, timeout_s=60)
+        assert status == JobStatus.SUCCEEDED
+        assert "hello_from_job" in client.get_job_logs(jid)
+
+    def test_failed_job(self):
+        client = JobSubmissionClient()
+        jid = client.submit_job(entrypoint="exit 3")
+        assert client.wait_until_finish(jid, timeout_s=60) == JobStatus.FAILED
+
+    def test_stop_job(self):
+        client = JobSubmissionClient()
+        jid = client.submit_job(entrypoint="sleep 60")
+        time.sleep(0.5)
+        assert client.stop_job(jid)
+        assert client.wait_until_finish(jid, timeout_s=60) == JobStatus.STOPPED
+
+    def test_env_vars_passed(self):
+        client = JobSubmissionClient()
+        jid = client.submit_job(
+            entrypoint="echo VAL=$MYVAR",
+            runtime_env={"env_vars": {"MYVAR": "42"}},
+        )
+        client.wait_until_finish(jid, timeout_s=60)
+        assert "VAL=42" in client.get_job_logs(jid)

@@ -1,0 +1,123 @@
+"""RL tests: env dynamics, GAE, PPO learning on CartPole, runner fault
+tolerance, GRPO reward climbing on a tiny LM."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import (
+    GRPO,
+    GRPOConfig,
+    PPO,
+    CartPole,
+    EnvRunnerGroup,
+    PPOConfig,
+    compute_gae,
+    mlp_forward_np,
+)
+
+
+class TestEnv:
+    def test_cartpole_runs_episodes(self):
+        env = CartPole()
+        obs = env.reset(seed=0)
+        assert obs.shape == (4,)
+        steps = 0
+        done = False
+        while not done and steps < 600:
+            obs, r, term, trunc, _ = env.step(steps % 2)
+            assert r == 1.0
+            done = term or trunc
+            steps += 1
+        assert done
+        assert steps < 500  # alternating actions fall over quickly
+
+    def test_reset_deterministic(self):
+        env = CartPole()
+        a = env.reset(seed=7)
+        b = CartPole().reset(seed=7)
+        np.testing.assert_array_equal(a, b)
+
+
+class TestGAE:
+    def test_matches_manual_single_episode(self):
+        rewards = np.array([1.0, 1.0, 1.0], np.float32)
+        values = np.array([0.5, 0.4, 0.3], np.float32)
+        dones = np.array([False, False, True])
+        adv, ret = compute_gae(rewards, values, dones, 9.9, gamma=1.0, lam=1.0)
+        # terminal: bootstrap ignored; returns are reward-to-go
+        np.testing.assert_allclose(ret, [3.0, 2.0, 1.0], atol=1e-6)
+        np.testing.assert_allclose(adv, [2.5, 1.6, 0.7], atol=1e-6)
+
+    def test_bootstrap_used_when_truncated(self):
+        rewards = np.array([0.0], np.float32)
+        values = np.array([0.0], np.float32)
+        dones = np.array([False])
+        adv, ret = compute_gae(rewards, values, dones, 10.0, gamma=0.5, lam=1.0)
+        np.testing.assert_allclose(ret, [5.0], atol=1e-6)
+
+
+class TestPPO:
+    def test_learns_cartpole(self, ray_start_regular):
+        algo = PPO(PPOConfig(
+            env_fn=CartPole,
+            num_env_runners=2,
+            rollout_steps_per_runner=512,
+            minibatch_size=256,
+            num_epochs=4,
+            seed=0,
+        ))
+        first = None
+        result = None
+        for _ in range(16):
+            result = algo.train()
+            if first is None and result["episodes_this_iter"]:
+                first = result["episode_return_mean"]
+        assert result["training_iteration"] == 16
+        # learning signal: mean return should clearly improve over start
+        # (reaches ~65 from ~26 at these settings; assert with margin)
+        final = result["episode_return_mean"]
+        assert final > 50.0 and final > (first or 0) * 1.8, (first, final)
+
+    def test_runner_crash_restarts(self, ray_start_regular):
+        class Bomb(CartPole):
+            def __init__(self):
+                super().__init__()
+                self.calls = 0
+
+        group = EnvRunnerGroup(Bomb, mlp_forward_np, num_runners=2, seed=0)
+        from ray_tpu.rl import init_mlp_module
+        import jax
+
+        params = init_mlp_module(jax.random.PRNGKey(0), 4, 2)
+        group.sync_weights(params)
+        import ray_tpu
+
+        ray_tpu.kill(group.runners[0])
+        out = group.sample(32, params)
+        assert len(out) >= 1  # surviving runner sampled; dead one restarted
+        out2 = group.sample(32, params)
+        assert len(out2) == 2
+
+
+class TestGRPO:
+    def test_reward_increases(self):
+        import jax
+
+        from ray_tpu.models import get_config, init_params
+
+        cfg = get_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+
+        # Dense reward with a ~50% baseline hit rate so group-relative
+        # advantages carry signal from step one: prefer low token ids.
+        def reward(prompt_ids, completion_ids):
+            return float(np.mean([t < cfg.vocab_size // 2 for t in completion_ids]))
+
+        algo = GRPO(params, cfg, reward, GRPOConfig(
+            group_size=16, max_new_tokens=16, temperature=1.0, lr=5e-3, kl_coef=0.0,
+        ))
+        prompt = [1, 2, 3]
+        rewards = [algo.train_step(prompt)["reward_mean"] for _ in range(20)]
+        # policy should shift mass onto the rewarded half of the vocab
+        # (climbs ~0.48 -> ~0.83 at these settings)
+        assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.15, rewards

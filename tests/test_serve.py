@@ -1,0 +1,250 @@
+"""Serve tests: deployments/replicas/routing, dynamic batching, HTTP proxy,
+autoscaling targets, and the continuous-batching paged-KV engine."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu import serve
+from ray_tpu.models import generate, get_config, init_params
+
+
+@pytest.fixture
+def serve_session(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def _post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestServeCore:
+    def test_function_deployment(self, serve_session):
+        @serve.deployment
+        def echo(request):
+            return {"echo": request["x"] * 2}
+
+        handle = serve.run(echo.bind(), name="echo")
+        out = handle.remote({"x": 21}).result(timeout=30)
+        assert out == {"echo": 42}
+
+    def test_class_deployment_with_state(self, serve_session):
+        @serve.deployment
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def __call__(self, request):
+                self.n += 1
+                return self.n
+
+        handle = serve.run(Counter.bind(10), name="counter")
+        vals = [handle.remote({}).result(timeout=30) for _ in range(3)]
+        assert vals == [11, 12, 13]
+
+    def test_multiple_replicas_balance(self, serve_session):
+        @serve.deployment(num_replicas=2)
+        class WhoAmI:
+            def __init__(self):
+                import uuid
+
+                self.uid = uuid.uuid4().hex
+
+            def __call__(self, request):
+                return self.uid
+
+        handle = serve.run(WhoAmI.bind(), name="who")
+        uids = {handle.remote({}).result(timeout=30) for _ in range(20)}
+        assert len(uids) == 2  # both replicas served traffic
+
+    def test_method_routing_and_status(self, serve_session):
+        @serve.deployment
+        class Multi:
+            def __call__(self, request):
+                return "call"
+
+            def other(self, request):
+                return "other"
+
+        handle = serve.run(Multi.bind(), name="multi")
+        assert handle.remote({}).result(timeout=30) == "call"
+        assert handle.other.remote({}).result(timeout=30) == "other"
+        st = serve.status()
+        assert st["Multi"]["live_replicas"] == 1
+
+    def test_http_proxy(self, serve_session):
+        @serve.deployment
+        def double(request):
+            return {"y": request["x"] * 2}
+
+        serve.run(double.bind(), name="double")
+        port = serve.http_port()
+        out = _post(port, "/double", {"x": 5})
+        assert out["result"] == {"y": 10}
+        # health + routes
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/-/healthz") as r:
+            assert json.loads(r.read())["status"] == "ok"
+
+    def test_replica_crash_recovers(self, serve_session):
+        @serve.deployment
+        class Fragile:
+            def __call__(self, request):
+                if request.get("die"):
+                    import os, signal, threading as th
+                    raise RuntimeError("dying")
+                return "alive"
+
+        handle = serve.run(Fragile.bind(), name="fragile")
+        assert handle.remote({}).result(timeout=30) == "alive"
+        with pytest.raises(Exception):
+            handle.remote({"die": True}).result(timeout=30)
+        # deployment still serves afterwards
+        assert handle.remote({}).result(timeout=30) == "alive"
+
+
+class TestBatching:
+    def test_batch_coalesces(self, serve_session):
+        sizes = []
+
+        @serve.deployment(max_ongoing_requests=16)
+        class Batched:
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+            def __call__(self, requests):
+                sizes.append(len(requests))
+                return [r["x"] + 1 for r in requests]
+
+        handle = serve.run(Batched.bind(), name="batched")
+        responses = [handle.remote({"x": i}) for i in range(8)]
+        results = [r.result(timeout=30) for r in responses]
+        assert sorted(results) == list(range(1, 9))
+
+
+class TestAutoscaling:
+    def test_target_scales_up(self, serve_session):
+        @serve.deployment(
+            autoscaling_config={
+                "min_replicas": 1,
+                "max_replicas": 3,
+                "target_ongoing_requests": 1.0,
+                "upscale_delay_s": 0.0,
+            },
+            max_ongoing_requests=2,
+        )
+        class Slow:
+            def __call__(self, request):
+                time.sleep(1.0)
+                return "ok"
+
+        handle = serve.run(Slow.bind(), name="slow")
+        rs = [handle.remote({}) for _ in range(8)]
+        deadline = time.monotonic() + 20
+        scaled = False
+        while time.monotonic() < deadline:
+            st = serve.status()
+            if st.get("Slow", {}).get("target_replicas", 1) > 1:
+                scaled = True
+                break
+            time.sleep(0.3)
+        for r in rs:
+            r.result(timeout=60)
+        assert scaled
+
+
+class TestEngine:
+    def _engine(self, **kw):
+        from ray_tpu.serve import EngineConfig, InferenceEngine
+
+        cfg = get_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(
+            max_batch_size=4, page_size=8, max_pages=64, max_seq_len=64,
+            prefill_buckets=(16, 32), **kw,
+        )
+        return InferenceEngine(params, cfg, ecfg), params, cfg
+
+    def test_matches_reference_generate(self):
+        engine, params, cfg = self._engine()
+        prompt = [5, 6, 7, 8, 9, 10]
+        out = engine.generate(prompt, max_tokens=8, temperature=0.0)
+        ref = generate(
+            params, cfg, jnp.asarray([prompt], jnp.int32),
+            jax.random.PRNGKey(0), max_new_tokens=8,
+        )
+        assert out["token_ids"] == [int(t) for t in np.asarray(ref)[0]]
+        assert out["ttft_s"] >= 0
+
+    def test_continuous_batching_many_requests(self):
+        engine, _, _ = self._engine()
+        results = {}
+        errs = []
+
+        def worker(i):
+            try:
+                results[i] = engine.generate(
+                    [1 + i, 2 + i, 3 + i], max_tokens=6, temperature=0.0
+                )
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errs
+        assert len(results) == 8
+        for r in results.values():
+            assert len(r["token_ids"]) == 6
+        # all pages returned to the pool
+        assert engine.stats()["free_pages"] == 64 - 1
+
+    def test_batched_equals_solo(self):
+        # the same prompt must decode identically alone and in a busy batch
+        engine, params, cfg = self._engine()
+        solo = engine.generate([4, 5, 6], max_tokens=6)
+        results = {}
+
+        def worker(i, prompt):
+            results[i] = engine.generate(prompt, max_tokens=6)
+
+        threads = [
+            threading.Thread(target=worker, args=(i, [4 + i, 5 + i, 6 + i]))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert results[0]["token_ids"] == solo["token_ids"]
+
+    def test_rejects_oversized(self):
+        engine, _, _ = self._engine()
+        with pytest.raises(ValueError, match="exceeds"):
+            engine.generate(list(range(40)), max_tokens=60)
+
+    def test_llm_deployment_end_to_end(self, serve_session):
+        app = serve.LLMServer.options(name="llm-test").bind(
+            model_name="tiny-llama",
+            engine_config=dict(
+                max_batch_size=2, page_size=8, max_pages=32, max_seq_len=64,
+                prefill_buckets=(16,),
+            ),
+        )
+        handle = serve.run(app, name="llm")
+        out = handle.remote(
+            {"prompt_ids": [1, 2, 3], "max_tokens": 4}
+        ).result(timeout=300)
+        assert len(out["token_ids"]) == 4

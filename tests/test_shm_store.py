@@ -1,0 +1,137 @@
+"""Native shared-memory object store tests: CRUD, zero-copy, eviction,
+cross-process access, crash robustness."""
+
+import multiprocessing
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.shm_store import ID_SIZE, ShmObjectStore, ShmStoreError
+
+
+def _oid(i: int) -> bytes:
+    return i.to_bytes(4, "big") + b"\x00" * (ID_SIZE - 4)
+
+
+@pytest.fixture
+def store():
+    name = f"/rtpu_test_{uuid.uuid4().hex[:8]}"
+    s = ShmObjectStore(name, capacity=1 << 20, max_objects=64)
+    yield s
+    s.close()
+
+
+class TestBasics:
+    def test_put_get_bytes(self, store):
+        store.put(_oid(1), b"hello world")
+        assert store.get_bytes(_oid(1)) == b"hello world"
+        assert store.contains(_oid(1))
+        assert not store.contains(_oid(2))
+        assert store.get_bytes(_oid(2)) is None
+
+    def test_duplicate_put_rejected(self, store):
+        store.put(_oid(1), b"x")
+        with pytest.raises(ShmStoreError):
+            store.put(_oid(1), b"y")
+
+    def test_delete_frees(self, store):
+        store.put(_oid(1), b"x" * 1000)
+        before = store.live_bytes()
+        assert store.delete(_oid(1))
+        assert store.live_bytes() == before - 1000
+        assert not store.contains(_oid(1))
+        # id reusable after delete
+        store.put(_oid(1), b"z")
+        assert store.get_bytes(_oid(1)) == b"z"
+
+    def test_pinned_not_deletable(self, store):
+        store.put(_oid(1), b"data")
+        view = store.get_view(_oid(1))
+        assert not store.delete(_oid(1))  # pinned by the view
+        store.release(_oid(1))
+        assert store.delete(_oid(1))
+        del view
+
+    def test_numpy_roundtrip_zero_copy(self, store):
+        arr = np.arange(1000, dtype=np.float32).reshape(10, 100)
+        store.put_array(_oid(3), arr)
+        out = store.get_array(_oid(3))
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == np.float32
+
+
+class TestEviction:
+    def test_lru_eviction_when_full(self, store):
+        # capacity 1MB; insert 8 x 200KB -> early ones evicted
+        blob = b"a" * (200 * 1024)
+        for i in range(8):
+            store.put(_oid(i), blob)
+        assert store.contains(_oid(7))
+        assert not store.contains(_oid(0))
+        assert store.live_bytes() <= store.capacity()
+
+    def test_pinned_survives_eviction(self, store):
+        store.put(_oid(0), b"p" * (200 * 1024))
+        _ = store.get_view(_oid(0))  # pin
+        for i in range(1, 9):
+            store.put(_oid(i), b"b" * (200 * 1024))
+        assert store.contains(_oid(0))  # pinned: never evicted
+        assert store.get_bytes(_oid(0))[:1] == b"p"
+        store.release(_oid(0))
+        store.release(_oid(0))
+
+    def test_oversized_rejected(self, store):
+        with pytest.raises(ShmStoreError):
+            store.put(_oid(1), b"x" * (2 << 20))
+
+
+def _child_reader(name: str, oid: bytes, q):
+    try:
+        s = ShmObjectStore(name, create=False)
+        q.put(s.get_bytes(oid))
+        s.close()
+    except Exception as e:  # pragma: no cover
+        q.put(f"ERR: {e}")
+
+
+def _child_writer(name: str, oid: bytes, q):
+    try:
+        s = ShmObjectStore(name, create=False)
+        s.put(oid, b"from child process")
+        q.put("ok")
+        s.close()
+    except Exception as e:  # pragma: no cover
+        q.put(f"ERR: {e}")
+
+
+class TestCrossProcess:
+    def test_child_process_reads_parent_object(self):
+        name = f"/rtpu_xp_{uuid.uuid4().hex[:8]}"
+        s = ShmObjectStore(name, capacity=1 << 20, max_objects=64)
+        try:
+            s.put(_oid(1), b"shared across processes")
+            ctx = multiprocessing.get_context("fork")
+            q = ctx.Queue()
+            p = ctx.Process(target=_child_reader, args=(name, _oid(1), q))
+            p.start()
+            out = q.get(timeout=30)
+            p.join(timeout=30)
+            assert out == b"shared across processes"
+        finally:
+            s.close()
+
+    def test_child_writes_parent_reads(self):
+        name = f"/rtpu_xp_{uuid.uuid4().hex[:8]}"
+        s = ShmObjectStore(name, capacity=1 << 20, max_objects=64)
+        try:
+            ctx = multiprocessing.get_context("fork")
+            q = ctx.Queue()
+            p = ctx.Process(target=_child_writer, args=(name, _oid(2), q))
+            p.start()
+            assert q.get(timeout=30) == "ok"
+            p.join(timeout=30)
+            assert s.get_bytes(_oid(2)) == b"from child process"
+        finally:
+            s.close()

@@ -1,0 +1,183 @@
+"""Tune tests: search spaces, trial execution, ASHA early stopping, PBT
+exploit, failure retry, result grid."""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune import (
+    AsyncHyperBandScheduler,
+    PopulationBasedTraining,
+    TuneConfig,
+    Tuner,
+)
+from ray_tpu.tune.trial import TrialStatus
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+class TestSearchSpaces:
+    def test_grid_and_samples(self):
+        cfgs = tune.generate_configs(
+            {"lr": tune.grid_search([0.1, 0.2]), "wd": tune.choice([1, 2]), "c": 7},
+            num_samples=3,
+            seed=0,
+        )
+        assert len(cfgs) == 6  # 2 grid x 3 samples
+        assert all(c["c"] == 7 for c in cfgs)
+        assert {c["lr"] for c in cfgs} == {0.1, 0.2}
+
+    def test_domains_sample_in_range(self):
+        cfgs = tune.generate_configs(
+            {
+                "a": tune.uniform(0.0, 1.0),
+                "b": tune.loguniform(1e-4, 1e-1),
+                "c": tune.randint(3, 9),
+            },
+            num_samples=20,
+            seed=1,
+        )
+        assert all(0 <= c["a"] <= 1 for c in cfgs)
+        assert all(1e-4 <= c["b"] <= 1e-1 for c in cfgs)
+        assert all(3 <= c["c"] < 9 for c in cfgs)
+
+
+class TestTuner:
+    def test_basic_optimization(self):
+        def trainable(config):
+            # deterministic objective: loss = (x - 3)^2
+            tune.report({"loss": (config["x"] - 3.0) ** 2})
+
+        grid = Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([0.0, 1.5, 3.0, 4.0])},
+            tune_config=TuneConfig(metric="loss", mode="min"),
+        ).fit()
+        best = grid.get_best_result()
+        assert best.config["x"] == 3.0
+        assert len(grid) == 4
+        assert not grid.errors
+
+    def test_final_return_dict_is_reported(self):
+        def trainable(config):
+            return {"score": config["x"] * 2}
+
+        grid = Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([1, 5, 3])},
+            tune_config=TuneConfig(metric="score", mode="max"),
+        ).fit()
+        assert grid.get_best_result().config["x"] == 5
+
+    def test_trial_error_captured_and_retried(self, tmp_path):
+        def flaky(config):
+            marker = os.path.join(str(tmp_path), f"m{config['x']}")
+            if config["x"] == 1 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("flaky failure")
+            tune.report({"loss": config["x"]})
+
+        grid = Tuner(
+            flaky,
+            param_space={"x": tune.grid_search([0, 1])},
+            tune_config=TuneConfig(max_retries=1),
+        ).fit()
+        assert not grid.errors
+        assert grid.get_best_result().config["x"] == 0
+
+    def test_trial_error_no_retry(self):
+        def bad(config):
+            raise ValueError("nope")
+
+        grid = Tuner(bad, param_space={"x": tune.grid_search([1])}).fit()
+        assert len(grid.errors) == 1
+        assert grid.errors[0].status is TrialStatus.ERROR
+
+    def test_dataframe(self):
+        def trainable(config):
+            tune.report({"loss": config["x"]})
+
+        grid = Tuner(trainable, param_space={"x": tune.grid_search([1, 2])}).fit()
+        df = grid.dataframe()
+        assert set(df["config/x"]) == {1, 2}
+
+
+class TestASHA:
+    def test_bad_trials_stopped_early(self):
+        iterations = {}
+
+        def trainable(config):
+            # good trials improve; bad ones plateau high
+            for it in range(1, 28):
+                loss = 1.0 / it if config["good"] else 10.0
+                tune.report({"loss": loss, "training_iteration": it})
+                iterations[config["idx"]] = it
+                time.sleep(0.02)
+
+        sched = AsyncHyperBandScheduler(
+            metric="loss", mode="min", max_t=27, grace_period=3, reduction_factor=3
+        )
+        grid = Tuner(
+            trainable,
+            param_space={
+                "idx": tune.grid_search(list(range(6))),
+                "good": tune.grid_search([True, False]),
+            },
+            tune_config=TuneConfig(
+                metric="loss", mode="min", scheduler=sched, max_concurrent_trials=4
+            ),
+        ).fit()
+        assert grid.get_best_result().config["good"] is True
+        stopped = [t for t in grid.trials if t.stopped_early]
+        assert stopped, "ASHA should stop some plateaued trials"
+        assert all(not t.config["good"] for t in stopped)
+
+
+class TestPBT:
+    def test_exploit_copies_top_config(self, tmp_path):
+        def trainable(config):
+            from ray_tpu import train
+
+            ckpt = train.get_checkpoint()
+            start = 0
+            factor = config["factor"]
+            if ckpt is not None:
+                meta = ckpt.get_metadata()
+                start = meta["iteration"]
+            score = float(start) * 1.0
+            for it in range(start + 1, 13):
+                score += factor
+                d = os.path.join(str(tmp_path), f"{config['idx']}_{it}")
+                os.makedirs(d, exist_ok=True)
+                c = train.Checkpoint(d)
+                c.set_metadata({"iteration": it})
+                tune.report(
+                    {"score": score, "training_iteration": it}, checkpoint=c
+                )
+                time.sleep(0.02)
+
+        sched = PopulationBasedTraining(
+            metric="score",
+            mode="max",
+            perturbation_interval=4,
+            hyperparam_mutations={"factor": [1.0, 2.0, 5.0]},
+            seed=0,
+        )
+        grid = Tuner(
+            trainable,
+            param_space={
+                "idx": tune.grid_search(list(range(4))),
+                "factor": tune.grid_search([0.1]),  # all start bad...
+            },
+            tune_config=TuneConfig(
+                metric="score", mode="max", scheduler=sched, max_concurrent_trials=4
+            ),
+        ).fit()
+        # at least one trial must have been exploited into a mutated config
+        mutated = [t for t in grid.trials if t.config["factor"] != 0.1]
+        assert mutated
