@@ -6,13 +6,14 @@ owned by this package: Pallas kernels tuned for the MXU/VMEM hierarchy on
 TPU, and pure-XLA blockwise fallbacks that run anywhere (CPU tests, and
 shapes the kernels don't cover).
 
-Dispatch policy: every public op checks `use_pallas()` — Pallas on a real
-TPU backend, XLA fallback otherwise (or when shapes violate kernel tiling
-constraints). Set RAY_TPU_FORCE_PALLAS=0/1 to override.
+Dispatch policy: selection happens per *lowering platform* inside each op
+(`dispatch.platform_dispatch`): the Pallas kernel when compiling for TPU
+and shapes satisfy kernel tiling constraints, the XLA fallback on every
+other platform. One process can therefore mix a real TPU and a virtual
+CPU mesh. Set RAY_TPU_FORCE_PALLAS=0/1 to override globally.
 """
 
 from .attention import flash_attention, mha_reference  # noqa: F401
 from .norm import layer_norm, rms_norm, rms_norm_reference  # noqa: F401
 from .rope import apply_rope, rope_frequencies  # noqa: F401
-from .dispatch import use_pallas  # noqa: F401
 from .paged_attention import paged_attention_decode  # noqa: F401

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .dispatch import interpret_mode, use_pallas
+from .dispatch import interpret_mode, platform_dispatch, use_pallas
 
 _NEG_INF = -2.0e30
 DEFAULT_BLOCK_Q = 128
@@ -304,27 +304,42 @@ def _pallas_ok(q_bhtd, k_bhtd, block_q, block_k) -> bool:
     )
 
 
+def _fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
+    """Pallas kernel when lowering for TPU and shapes tile; XLA otherwise."""
+    if not _pallas_ok(q, k, block_q, block_k):
+        o, _ = _fwd_xla_blockwise(
+            q, k, v, causal=causal, scale=scale, block_k=min(block_k, k.shape[2])
+        )
+        return o
+    return platform_dispatch(
+        lambda q, k, v: _flash_fwd_pallas(
+            q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+        ),
+        lambda q, k, v: _fwd_xla_blockwise(
+            q, k, v, causal=causal, scale=scale, block_k=block_k
+        )[0],
+        q,
+        k,
+        v,
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_bhtd(q, k, v, causal, scale, block_q, block_k):
-    if _pallas_ok(q, k, block_q, block_k):
-        return _flash_fwd_pallas(
-            q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
-        )
-    o, _ = _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=min(block_k, k.shape[2]))
-    return o
+    return _fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    bk = min(block_k, k.shape[2])
-    if _pallas_ok(q, k, block_q, block_k):
-        o = _flash_fwd_pallas(
-            q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
-        )
-        # lse recomputed at bwd time (flash recompute strategy): saves the
-        # forward from materializing stats; bwd pays one cheap stats pass.
-        return o, (q, k, v, o, None)
-    o, lse = _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=bk)
-    return o, (q, k, v, o, lse)
+    if not _pallas_ok(q, k, block_q, block_k):
+        # Static XLA-only path: keep the lse the forward already computed.
+        bk = min(block_k, k.shape[2])
+        o, lse = _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=bk)
+        return o, (q, k, v, o, lse)
+    # Platform-dispatched path: both branches must return the same pytree,
+    # so lse is recomputed at bwd time (flash recompute strategy — on TPU
+    # the Pallas forward never materializes stats anyway).
+    o = _fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
+    return o, (q, k, v, o, None)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):

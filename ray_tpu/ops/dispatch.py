@@ -1,4 +1,12 @@
-"""Kernel dispatch policy: Pallas on TPU, XLA everywhere else."""
+"""Kernel dispatch policy: Pallas on TPU, XLA everywhere else.
+
+Dispatch is per *lowering platform* (`lax.platform_dependent`), not per
+process: one process can trace computations for both a real TPU and a
+virtual CPU mesh (the fake-cluster test pattern), so a process-wide
+`jax.default_backend()` check misclassifies one of them. The TPU branch
+only ever lowers on TPU, so Pallas kernels there never need interpret
+mode; the default branch is the XLA reference implementation.
+"""
 
 from __future__ import annotations
 
@@ -7,18 +15,37 @@ import os
 import jax
 
 
-def use_pallas() -> bool:
-    """True when the Pallas TPU path should be taken.
-
-    RAY_TPU_FORCE_PALLAS=1 forces Pallas (interpret mode off-TPU — used by
-    kernel correctness tests), =0 forces the XLA fallback everywhere.
-    """
+def _forced() -> "bool | None":
+    """RAY_TPU_FORCE_PALLAS=1 forces Pallas (interpret mode off-TPU — used
+    by kernel correctness tests), =0 forces the XLA fallback everywhere."""
     forced = os.environ.get("RAY_TPU_FORCE_PALLAS")
+    if forced is None:
+        return None
+    return forced not in ("0", "false", "")
+
+
+def use_pallas() -> bool:
+    """True when the Pallas TPU path may be taken this process (gates only
+    the cheap shape checks; real selection is platform_dispatch)."""
+    forced = _forced()
     if forced is not None:
-        return forced not in ("0", "false", "")
-    return jax.default_backend() == "tpu"
+        return forced
+    return True
 
 
 def interpret_mode() -> bool:
     """Pallas interpret mode: on whenever we're not on a real TPU."""
     return jax.default_backend() != "tpu"
+
+
+def platform_dispatch(pallas_fn, xla_fn, *args):
+    """Run `pallas_fn(*args)` when lowering for TPU, `xla_fn(*args)` on any
+    other platform. Both must return identical shapes/dtypes/pytrees.
+    RAY_TPU_FORCE_PALLAS overrides (1 = pallas everywhere, interpret mode
+    off-TPU; 0 = XLA everywhere)."""
+    forced = _forced()
+    if forced is True:
+        return pallas_fn(*args)
+    if forced is False:
+        return xla_fn(*args)
+    return jax.lax.platform_dependent(*args, tpu=pallas_fn, default=xla_fn)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .dispatch import interpret_mode, use_pallas
+from .dispatch import interpret_mode, platform_dispatch, use_pallas
 
 _DEFAULT_BLOCK_ROWS = 256
 
@@ -59,9 +59,14 @@ def _rms_impl(x, w, eps):
     D = x.shape[-1]
     rows = x.size // D
     block = min(_DEFAULT_BLOCK_ROWS, rows)
-    if use_pallas() and rows % block == 0 and D % 128 == 0:
-        return _rms_pallas(x.reshape(rows, D), w, eps, block).reshape(x.shape)
-    return rms_norm_reference(x, w, eps)
+    if not (use_pallas() and rows % block == 0 and D % 128 == 0):
+        return rms_norm_reference(x, w, eps)
+    return platform_dispatch(
+        lambda x, w: _rms_pallas(x.reshape(rows, D), w, eps, block).reshape(x.shape),
+        lambda x, w: rms_norm_reference(x, w, eps),
+        x,
+        w,
+    )
 
 
 def _rms_fwd(x, w, eps):

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .dispatch import interpret_mode, use_pallas
+from .dispatch import interpret_mode, platform_dispatch, use_pallas
 
 _NEG_INF = -2.0e30
 _LANES = 128
@@ -184,6 +184,14 @@ def paged_attention_decode(
     D = q.shape[-1]
     if scale is None:
         scale = D**-0.5
-    if use_pallas() and D % _LANES == 0 and q.shape[1] % k_pages.shape[0] == 0:
-        return _paged_pallas(q, k_pages, v_pages, page_table, lengths, scale)
-    return _paged_reference(q, k_pages, v_pages, page_table, lengths, scale)
+    if not (use_pallas() and D % _LANES == 0 and q.shape[1] % k_pages.shape[0] == 0):
+        return _paged_reference(q, k_pages, v_pages, page_table, lengths, scale)
+    return platform_dispatch(
+        lambda *a: _paged_pallas(*a, scale),
+        lambda *a: _paged_reference(*a, scale),
+        q,
+        k_pages,
+        v_pages,
+        page_table,
+        lengths,
+    )
