@@ -14,6 +14,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -35,15 +36,23 @@ def _load() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
+        def build():
             try:
                 subprocess.run(
-                    ["make", "-C", os.path.join(_DIR, "_shm")],
+                    ["make", "-C", os.path.join(_DIR, "_shm"), "-B"],
                     check=True, capture_output=True, timeout=120,
                 )
             except (subprocess.CalledProcessError, OSError) as e:
                 raise ShmStoreError(f"cannot build libshm_store.so: {e}") from e
-        lib = ctypes.CDLL(_SO)
+
+        if not os.path.exists(_SO):
+            build()
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # Stale binary for another arch/libc: rebuild from source.
+            build()
+            lib = ctypes.CDLL(_SO)
         lib.shm_store_create.restype = ctypes.c_void_p
         lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
         lib.shm_store_open.restype = ctypes.c_void_p
@@ -127,6 +136,8 @@ class ShmObjectStore:
             self.release(object_id)
 
     def release(self, object_id: bytes) -> None:
+        if not self._h:  # store already closed (e.g. interpreter shutdown)
+            return
         self._lib.shm_obj_release(self._h, _check_id(object_id))
 
     def delete(self, object_id: bytes) -> bool:
@@ -146,14 +157,19 @@ class ShmObjectStore:
             raise ShmStoreError("create failed")
         ctypes.memmove(ptr, header, len(header))
         ctypes.memmove(ptr + len(header), arr.ctypes.data, arr.nbytes)
-        self._lib.shm_obj_seal(self._h, object_id)
+        if self._lib.shm_obj_seal(self._h, object_id) != 0:
+            raise ShmStoreError("seal failed")
 
     def get_array(self, object_id: bytes) -> Optional[np.ndarray]:
-        """Zero-copy read: the returned array aliases shared memory and
-        holds the pin until garbage-collected (release via .base)."""
+        """Zero-copy read: the returned array aliases shared memory. The pin
+        is released when the last numpy view dies (finalizer on the buffer
+        owner every view chains to); do NOT also call release(id)."""
         view = self.get_view(object_id)
         if view is None:
             return None
+        # view.obj is the ctypes buffer at the bottom of every numpy view's
+        # .base chain; when it is collected, no aliasing array remains.
+        weakref.finalize(view.obj, self.release, bytes(object_id))
         raw = np.frombuffer(view, np.uint8)
         # parse tiny header: dtype|shape|
         first = bytes(raw[:64])

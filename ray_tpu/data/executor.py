@@ -121,9 +121,45 @@ class StreamingExecutor:
                 stream = self._repartition(stream, seg.num_blocks)
             elif isinstance(seg, Sort):
                 stream = self._sort(stream, seg)
+            elif isinstance(seg, Limit):
+                stream = self._limit(stream, seg.limit)
             else:
                 raise TypeError(f"bad segment {seg}")
         return stream
+
+    # -- streaming global limit ---------------------------------------------
+
+    def _limit(self, upstream: Iterator[Any], n: int) -> Iterator[Any]:
+        """Global row limit: stream blocks, truncate the boundary block, and
+        stop consuming upstream (lazy generators — no further submission).
+        Row-count fetches are pipelined over a bounded window so the stream
+        isn't serialized on one metadata round-trip per block."""
+
+        def gen():
+            remaining = n
+            window: List[Any] = []  # (block_ref, meta_ref) in submission order
+            it = iter(upstream)
+            exhausted = False
+            while remaining > 0:
+                while not exhausted and len(window) < self.max_in_flight:
+                    try:
+                        ref = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    window.append((ref, _block_meta.remote(ref)))
+                if not window:
+                    break
+                ref, meta_ref = window.pop(0)
+                rows = api.get(meta_ref)[0]
+                if rows <= remaining:
+                    remaining -= rows
+                    yield ref
+                else:
+                    yield _run_stage.remote(_take_rows(remaining), ref)
+                    break
+
+        return gen()
 
     # -- pipelined 1:1 stage ------------------------------------------------
 
@@ -185,6 +221,14 @@ class StreamingExecutor:
         refs = list(upstream)
         merged = _concat_blocks.remote(*refs)
         return iter([_sort_block.remote(merged, op.key, op.descending)])
+
+
+def _take_rows(n: int):
+    def take(block: Block) -> Block:
+        return BlockAccessor(block).take(n)
+
+    take.__name__ = f"take_{n}"
+    return take
 
 
 def _permute_rows(seed: Optional[int]):

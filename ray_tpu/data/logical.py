@@ -19,7 +19,10 @@ class Operator:
     name: str
 
     def is_one_to_one(self) -> bool:
-        return isinstance(self, (MapBatches, MapRows, Filter, FlatMap, Limit))
+        # Limit is NOT one-to-one: fusing it would apply the limit to each
+        # block independently (N blocks -> up to N*limit rows). The executor
+        # treats it as a streaming barrier that truncates globally.
+        return isinstance(self, (MapBatches, MapRows, Filter, FlatMap))
 
 
 @dataclasses.dataclass
@@ -132,8 +135,6 @@ def compile_stage(ops: List[Operator]) -> Callable[[Block], Block]:
                 block = _apply_map_batches(op, block)
             elif isinstance(op, (MapRows, Filter, FlatMap)):
                 block = _apply_rows(op, block)
-            elif isinstance(op, Limit):
-                block = BlockAccessor(block).take(op.limit)
             else:
                 raise TypeError(f"not a 1:1 op: {op}")
         return block

@@ -21,6 +21,8 @@ from .replica import ServeReplica
 logger = get_logger("serve.controller")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+_HEALTH_FAIL_THRESHOLD = 3  # consecutive misses before a replica is replaced
+_HEALTH_CHECK_TIMEOUT_S = 5.0
 
 
 class _DeploymentState:
@@ -32,6 +34,15 @@ class _DeploymentState:
         self.config = config
         self.replicas: List[Any] = []
         self.version = 0
+        # Monotonic membership counter: bumped on ANY change to `replicas`
+        # (replacement, scale up/down, drain). Routers cache replica sets
+        # keyed on this, so an unbumped change would leave every existing
+        # handle routing to dead replicas.
+        self.membership = 0
+        # consecutive health-check failures per live replica (keyed by actor
+        # id); replicas are only replaced after _HEALTH_FAIL_THRESHOLD misses
+        # so a long compile or GC pause doesn't get a healthy replica killed.
+        self.fail_counts: Dict[Any, int] = {}
         self.target = config.num_replicas
         self._last_scale_up = 0.0
         self._last_scale_down = 0.0
@@ -57,6 +68,7 @@ class ServeController:
             state = _DeploymentState(name, cls_or_fn, init_args, init_kwargs, config)
             if old is not None:
                 state.version = old.version + 1
+                state.membership = old.membership + 1
                 self._drain(old)
             self._deployments[name] = state
         self._reconcile_once()
@@ -81,7 +93,7 @@ class ServeController:
             state = self._deployments.get(name)
             if state is None:
                 return [], -1
-            return list(state.replicas), state.version * 1000 + len(state.replicas)
+            return list(state.replicas), state.membership
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
@@ -106,6 +118,8 @@ class ServeController:
                 api.kill(r)
             except Exception:
                 pass
+        if state.replicas:
+            state.membership += 1
         state.replicas = []
 
     def _reconcile_loop(self) -> None:
@@ -123,15 +137,39 @@ class ServeController:
             self._autoscale(state)
             live = []
             for r in state.replicas:
+                rid = r._actor_id
                 try:
-                    api.get(r.health_check.remote(), timeout=5.0)
+                    api.get(r.health_check.remote(), timeout=_HEALTH_CHECK_TIMEOUT_S)
+                    state.fail_counts.pop(rid, None)
                     live.append(r)
-                except Exception:
+                except Exception as e:
+                    from ..core.core_worker import RayActorError
+
+                    definitely_dead = isinstance(e, RayActorError)
+                    fails = state.fail_counts.get(rid, 0) + 1
+                    state.fail_counts[rid] = fails
+                    if not definitely_dead and fails < _HEALTH_FAIL_THRESHOLD:
+                        live.append(r)  # transient (compile/GC pause): keep
+                        continue
                     logger.warning(
-                        "replica of %s failed health check; replacing", state.name
+                        "replica of %s failed %d health checks; replacing",
+                        state.name, fails,
                     )
+                    state.fail_counts.pop(rid, None)
+                    try:
+                        api.kill(r)
+                    except Exception:
+                        pass
+            changed = len(live) != len(state.replicas)
             state.replicas = live
+            with self._lock:
+                if self._deployments.get(state.name) is not state:
+                    # deploy()/delete drained this state mid-iteration: do not
+                    # respawn replicas onto an orphaned state object.
+                    self._drain(state)
+                    continue
             while len(state.replicas) < state.target:
+                changed = True
                 opts = dict(state.config.ray_actor_options)
                 opts.setdefault("num_cpus", 1.0)
                 opts["max_concurrency"] = max(
@@ -146,11 +184,15 @@ class ServeController:
                 )
                 state.replicas.append(replica)
             while len(state.replicas) > state.target:
+                changed = True
                 victim = state.replicas.pop()
                 try:
                     api.kill(victim)
                 except Exception:
                     pass
+            if changed:
+                with self._lock:
+                    state.membership += 1
 
     def _autoscale(self, state: _DeploymentState) -> None:
         cfg: Optional[AutoscalingConfig] = state.config.autoscaling_config

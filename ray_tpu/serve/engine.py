@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import queue
 import threading
 import time
@@ -109,8 +110,12 @@ class InferenceEngine:
         self.allocator = PageAllocator(P)
         self.slots = [_Slot() for _ in range(B)]
         self.pending: "queue.Queue[Request]" = queue.Queue()
-        self._results: Dict[str, Request] = {}
         self._step_count = 0
+        # Fresh sampling stream per engine instance: a fixed base key would
+        # replay identical temperature>0 outputs across restarts.
+        self._base_key = jax.random.PRNGKey(
+            int.from_bytes(os.urandom(4), "little")
+        )
         self._lock = threading.Lock()
         self._loop_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -214,15 +219,24 @@ class InferenceEngine:
     # ------------------------------------------------------------- requests
 
     def add_request(self, req: Request) -> None:
-        if len(req.prompt) + req.max_tokens > self.ecfg.max_seq_len:
+        total = len(req.prompt) + req.max_tokens
+        if total > self.ecfg.max_seq_len:
             req.error = (
                 f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
                 f"max_seq_len {self.ecfg.max_seq_len}"
             )
             req.done.set()
             return
-        with self._lock:
-            self._results[req.request_id] = req
+        # Reject at admission anything the pool can never satisfy (page 0 is
+        # the reserved trash page) — otherwise _admit_one re-queues it forever.
+        n_pages = -(-total // self.ecfg.page_size)
+        if n_pages > self.ecfg.max_pages - 1:
+            req.error = (
+                f"request needs {n_pages} pages but the pool only has "
+                f"{self.ecfg.max_pages - 1}; raise EngineConfig.max_pages"
+            )
+            req.done.set()
+            return
         self.pending.put(req)
         self._ensure_loop()
 
@@ -316,7 +330,7 @@ class InferenceEngine:
             tables[i, : len(s.pages)] = s.pages
             temps[i] = s.request.temperature
         self._step_count += 1
-        key = jax.random.fold_in(jax.random.PRNGKey(0), self._step_count)
+        key = jax.random.fold_in(self._base_key, self._step_count)
         toks, self.k_pages, self.v_pages = self._decode(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),

@@ -56,6 +56,21 @@ class TestBasics:
         assert st["num_rows"] == 100
         assert st["num_blocks"] == 4
 
+    def test_limit_is_global_across_blocks(self):
+        # 4 blocks of 25 rows: limit(5) must return exactly 5 rows total,
+        # not up to 5 per block (Limit is a streaming barrier, not fused).
+        ds = data.range(100, parallelism=4).limit(5)
+        rows = [int(r["id"]) for r in ds.take_all()]
+        assert rows == [0, 1, 2, 3, 4]
+        # boundary crossing a block edge
+        ds = data.range(100, parallelism=4).limit(30)
+        assert len(ds.take_all()) == 30
+        # limit larger than the dataset
+        assert len(data.range(10, parallelism=3).limit(50).take_all()) == 10
+        # limit composed with a map stage
+        ds = data.range(100, parallelism=4).map(lambda r: {"id": r["id"] * 2}).limit(7)
+        assert [int(r["id"]) for r in ds.take_all()] == [0, 2, 4, 6, 8, 10, 12]
+
     def test_limit_and_sort(self):
         ds = data.from_items([{"v": i} for i in [5, 3, 8, 1]], parallelism=2)
         got = [int(r["v"]) for r in ds.sort("v").take_all()]

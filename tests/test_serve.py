@@ -98,6 +98,82 @@ class TestServeCore:
         with urllib.request.urlopen(f"http://127.0.0.1:{port}/-/healthz") as r:
             assert json.loads(r.read())["status"] == "ok"
 
+    def test_replica_replacement_reaches_existing_handles(self, serve_session):
+        # Kill the only replica out-of-band: the reconcile loop replaces it
+        # at unchanged count, and the membership version bump must reach an
+        # EXISTING handle (the round-1 composite version missed this case,
+        # leaving handles routing to the dead replica forever).
+        from ray_tpu import api as core_api
+        from ray_tpu.serve.controller import get_or_create_controller
+
+        @serve.deployment
+        class Stable:
+            def __call__(self, request):
+                return "ok"
+
+        handle = serve.run(Stable.bind(), name="stable")
+        assert handle.remote({}).result(timeout=30) == "ok"
+        ctrl = get_or_create_controller()
+        replicas, v0 = core_api.get(ctrl.get_replicas.remote("Stable"))
+        assert len(replicas) == 1
+        core_api.kill(replicas[0])
+
+        deadline = time.monotonic() + 30
+        recovered = False
+        while time.monotonic() < deadline:
+            try:
+                if handle.remote({}).result(timeout=5) == "ok":
+                    _, v1 = core_api.get(ctrl.get_replicas.remote("Stable"))
+                    if v1 > v0:
+                        recovered = True
+                        break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert recovered, "existing handle never reached the replacement replica"
+
+    def test_hung_replica_replaced_after_threshold(self, serve_session, monkeypatch):
+        # A replica whose health_check stops answering (but whose actor is
+        # alive) must survive transient misses and be replaced only after
+        # _HEALTH_FAIL_THRESHOLD consecutive timeouts.
+        from ray_tpu import api as core_api
+        from ray_tpu.serve import controller as ctrl_mod
+
+        monkeypatch.setattr(ctrl_mod, "_HEALTH_CHECK_TIMEOUT_S", 0.3)
+
+        @serve.deployment(ray_actor_options={"max_concurrency": 8})
+        class Hangable:
+            def __init__(self):
+                self._hang = False
+
+            def __call__(self, request):
+                if request.get("hang"):
+                    self._hang = True
+                    return "hanging"
+                return "ok"
+
+            def check_health(self):
+                while self._hang:
+                    time.sleep(0.1)
+
+        handle = serve.run(Hangable.bind(), name="hangable")
+        assert handle.remote({}).result(timeout=30) == "ok"
+        ctrl = ctrl_mod.get_or_create_controller()
+        replicas, v0 = core_api.get(ctrl.get_replicas.remote("Hangable"))
+        old_id = replicas[0]._actor_id
+        assert handle.remote({"hang": True}).result(timeout=30) == "hanging"
+
+        deadline = time.monotonic() + 30
+        replaced = False
+        while time.monotonic() < deadline:
+            reps, v1 = core_api.get(ctrl.get_replicas.remote("Hangable"))
+            if reps and reps[0]._actor_id != old_id and v1 > v0:
+                replaced = True
+                break
+            time.sleep(0.3)
+        assert replaced, "hung replica never replaced after threshold"
+        assert handle.remote({}).result(timeout=30) == "ok"
+
     def test_replica_crash_recovers(self, serve_session):
         @serve.deployment
         class Fragile:
@@ -234,6 +310,24 @@ class TestEngine:
         engine, _, _ = self._engine()
         with pytest.raises(ValueError, match="exceeds"):
             engine.generate(list(range(40)), max_tokens=60)
+
+    def test_rejects_unservable_page_demand(self):
+        # pool has 7 usable pages * 8 tokens = 56 < 60: must error at
+        # admission instead of re-queueing forever until client timeout
+        engine, _, _ = self._engine_small_pool()
+        with pytest.raises(ValueError, match="pages"):
+            engine.generate([1, 2, 3], max_tokens=57, timeout_s=10)
+
+    def _engine_small_pool(self):
+        from ray_tpu.serve import EngineConfig, InferenceEngine
+
+        cfg = get_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(
+            max_batch_size=2, page_size=8, max_pages=8, max_seq_len=64,
+            prefill_buckets=(16, 32),
+        )
+        return InferenceEngine(params, cfg, ecfg), params, cfg
 
     def test_llm_deployment_end_to_end(self, serve_session):
         app = serve.LLMServer.options(name="llm-test").bind(

@@ -61,6 +61,22 @@ class TestBasics:
         np.testing.assert_array_equal(out, arr)
         assert out.dtype == np.float32
 
+    def test_get_array_pin_released_on_gc(self, store):
+        import gc
+
+        arr = np.arange(256, dtype=np.int64)
+        store.put_array(_oid(4), arr)
+        out = store.get_array(_oid(4))
+        assert not store.delete(_oid(4))  # pinned while the array lives
+        view = out[10:20]  # a derived view must keep the pin alive
+        del out
+        gc.collect()
+        assert not store.delete(_oid(4))
+        assert int(view[0]) == 10
+        del view
+        gc.collect()
+        assert store.delete(_oid(4))  # finalizer released the pin
+
 
 class TestEviction:
     def test_lru_eviction_when_full(self, store):
