@@ -43,21 +43,66 @@ class Cluster:
 
     def add_slice(
         self,
-        num_hosts: int,
+        num_hosts: Optional[int] = None,
         chips_per_host: int = 4,
+        generation: str = "v5e",
+        topology_shape: Optional[Tuple[int, ...]] = None,
         extra_resources: Optional[Dict[str, float]] = None,
     ) -> SliceID:
-        """Register a fake TPU slice: num_hosts nodes sharing one SliceID."""
+        """Register a fake TPU slice: nodes sharing one SliceID, plus (when
+        host ownership matches the generation's layout) an ICI topology
+        registration so TopologyRequest placement groups can pack sub-boxes
+        onto it.
+
+        Give either ``num_hosts`` (chip grid shape derived near-cubic) or an
+        explicit ``topology_shape`` (num_hosts derived from it).
+        """
+        from .sched.topology import (
+            GENERATIONS,
+            SliceInfo,
+            SliceTopology,
+            _default_shape,
+        )
+
+        gen = GENERATIONS[generation]
+        if topology_shape is not None:
+            shape = tuple(topology_shape)
+            chips = 1
+            for d in shape:
+                chips *= d
+            num_hosts = max(1, chips // chips_per_host)
+        else:
+            if num_hosts is None:
+                raise ValueError("give num_hosts or topology_shape")
+            shape = _default_shape(num_hosts * chips_per_host, gen.dims)
+
         slice_id = SliceID.generate()
+        topo = SliceTopology(generation, shape)
+        # Topology registration requires the generation's host layout AND a
+        # uniform chip->host partition (ragged partitions from odd-dim shapes
+        # would pin bundles bigger than any node advertises, leaving
+        # topology requests queued forever).
+        partition = topo.host_partition()
+        register_topology = (
+            chips_per_host == gen.chips_per_host
+            and len(partition) == num_hosts
+            and all(len(v) == chips_per_host for v in partition.values())
+        )
+        info = SliceInfo(slice_id=slice_id, topology=topo) if register_topology else None
+
         for h in range(num_hosts):
             resources = {"CPU": 8.0, "TPU": float(chips_per_host)}
             resources.update(extra_resources or {})
-            self.add_node(
+            agent = self.add_node(
                 resources=resources,
                 labels={"slice": slice_id.hex(), "host_index": str(h)},
                 slice_id=slice_id,
                 topology_coords=(h,),
             )
+            if info is not None:
+                info.hosts[h] = agent.node_id
+        if info is not None:
+            self.runtime.register_slice(info)
         return slice_id
 
     def remove_node(self, agent: NodeAgent) -> None:

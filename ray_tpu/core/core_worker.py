@@ -191,6 +191,19 @@ class Runtime:
 
         self.pg_manager = PlacementGroupManager(self)
         self._actor_pg: Dict[ActorID, Tuple[Any, int, Dict[str, float]]] = {}
+        # ICI slice registry: slice_id -> SliceInfo (topology + packer +
+        # host->node map) consumed by topology-aware gang placement.
+        self.slices: Dict[Any, Any] = {}
+
+    def register_slice(self, slice_info) -> None:
+        """Register a physical slice's topology so placement groups can
+        reserve contiguous sub-boxes on it (sched/topology.py::SliceInfo)."""
+        with self._lock:
+            self.slices[slice_info.slice_id] = slice_info
+
+    def unregister_slice(self, slice_id) -> None:
+        with self._lock:
+            self.slices.pop(slice_id, None)
 
     # ------------------------------------------------------------- topology
     def add_node(

@@ -79,19 +79,26 @@ class SliceTopology:
         return list(itertools.product(*[range(d) for d in self.shape]))
 
     def host_of(self, coord: Coord) -> int:
-        """Host index owning a chip coordinate: hosts own contiguous blocks
-        along the innermost axis (4-chip hosts -> 2x2x1 chip sub-blocks on
-        v4/v5p, a 4-chip row on v5e)."""
+        """Host index owning a chip coordinate: hosts own contiguous 2x2
+        blocks in the x-y plane (4-chip hosts -> 2x2x1 sub-blocks on v4/v5p;
+        v5e/v6e hosts likewise connect a 2x2 chip square)."""
         gen = GENERATIONS[self.generation]
+        bx, by = coord[0] // 2, coord[1] // 2
+        hosts_x = max(1, -(-self.shape[0] // 2))
         if gen.dims == 3:
-            # hosts tile the torus in 2x2x1 blocks
-            bx, by = coord[0] // 2, coord[1] // 2
-            hosts_x = max(1, self.shape[0] // 2)
-            hosts_y = max(1, self.shape[1] // 2)
+            hosts_y = max(1, -(-self.shape[1] // 2))
             return (coord[2] * hosts_y + by) * hosts_x + bx
-        # 2D: hosts own rows of chips_per_host along x
-        per_row = max(1, self.shape[0] // gen.chips_per_host)
-        return coord[1] * per_row + coord[0] // gen.chips_per_host
+        return by * hosts_x + bx
+
+    def host_partition(self) -> Dict[int, List[Coord]]:
+        """host index -> chip coords. Callers registering a slice should
+        check the partition is uniform (every host owns chips_per_host
+        chips) before enabling topology-aware placement on it — odd-dim
+        shapes produce ragged partitions that no real slice has."""
+        out: Dict[int, List[Coord]] = {}
+        for c in self.all_coords():
+            out.setdefault(self.host_of(c), []).append(c)
+        return out
 
 
 def _default_shape(chips: int, dims: int) -> Tuple[int, ...]:
@@ -114,6 +121,19 @@ def _default_shape(chips: int, dims: int) -> Tuple[int, ...]:
                 if c >= b >= a:
                     best = (a, b, c)
     return tuple(sorted(best))
+
+
+def _normalize_rank(want: Tuple[int, ...], dims: int) -> Optional[Tuple[int, ...]]:
+    """Pad a short request with 1s; squeeze 1-sized axes from a long one
+    (a (2,2,1) request is a (2,2) box on a 2D mesh). None if impossible."""
+    while len(want) > dims and 1 in want:
+        i = want.index(1)
+        want = want[:i] + want[i + 1:]
+    if len(want) > dims:
+        return None
+    if len(want) < dims:
+        want = want + (1,) * (dims - len(want))
+    return want
 
 
 @dataclass
@@ -153,14 +173,13 @@ class SubSlicePacker:
         self._next_id = 0
 
     def try_allocate(self, shape: Sequence[int]) -> Optional[Tuple[int, Allocation]]:
-        want = tuple(shape)
-        dims = len(self.topology.shape)
-        if len(want) < dims:
-            want = want + (1,) * (dims - len(want))
-        if len(want) != dims:
+        want = _normalize_rank(tuple(shape), len(self.topology.shape))
+        if want is None:
             raise ValueError(
-                f"request rank {len(want)} does not match topology rank {dims}"
+                f"request shape {tuple(shape)} does not fit topology rank "
+                f"{len(self.topology.shape)}"
             )
+        dims = len(self.topology.shape)
         with self._lock:
             best: Optional[Allocation] = None
             best_score: Optional[Tuple] = None
@@ -231,3 +250,33 @@ class SubSlicePacker:
                 if all(c in self._free for c in alloc.coords()):
                     return True
         return False
+
+    def could_ever_fit(self, shape: Sequence[int]) -> bool:
+        """True if some axis permutation of `shape` fits an EMPTY torus —
+        the feasibility test for queueing vs rejecting a gang request."""
+        want = _normalize_rank(tuple(shape), len(self.topology.shape))
+        if want is None:
+            return False
+        return any(
+            all(p <= s for p, s in zip(perm, self.topology.shape))
+            for perm in itertools.permutations(want)
+        )
+
+
+@dataclass
+class SliceInfo:
+    """A registered physical slice: topology + packer + host->node map.
+
+    The control plane keeps one of these per TPU slice so gang placement
+    (sched/placement_group.py) can allocate contiguous sub-boxes and pin
+    bundles to the hosts that own the allocated chips.
+    """
+
+    slice_id: object  # SliceID (kept untyped here: core imports this module)
+    topology: SliceTopology
+    packer: SubSlicePacker = None  # type: ignore[assignment]
+    hosts: Dict[int, object] = field(default_factory=dict)  # host idx -> NodeID
+
+    def __post_init__(self):
+        if self.packer is None:
+            self.packer = SubSlicePacker(self.topology)

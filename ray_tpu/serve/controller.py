@@ -162,6 +162,11 @@ class ServeController:
                         pass
             changed = len(live) != len(state.replicas)
             state.replicas = live
+            # drop stale counters (scaled-down / drained / replaced replicas)
+            live_ids = {r._actor_id for r in live}
+            state.fail_counts = {
+                rid: c for rid, c in state.fail_counts.items() if rid in live_ids
+            }
             with self._lock:
                 if self._deployments.get(state.name) is not state:
                     # deploy()/delete drained this state mid-iteration: do not

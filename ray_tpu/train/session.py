@@ -27,6 +27,10 @@ class TrainContext:
     storage_path: str = ""
     trial_dir: str = ""
     gang_name: str = ""
+    # ICI sub-box granted to the gang (when ScalingConfig.topology is set):
+    # {"origin": (..), "shape": (..), "host_coords": [(..), ..]} — the mesh
+    # axis order should follow "shape" so collectives ride physical links.
+    topology: Optional[Dict[str, Any]] = None
 
     def get_world_rank(self) -> int:
         return self.world_rank

@@ -80,23 +80,67 @@ class WorkerGroup:
         self._start()
 
     def _start(self) -> None:
+        from ..core.task_spec import PlacementGroupSchedulingStrategy, TopologyRequest
+
         n = self.scaling.num_workers
         res = self.scaling.worker_resources()
         rt = api._auto_init()
-        bundles = [dict(res) for _ in range(n)]
         try:
-            self.pg = rt.pg_manager.create(
-                bundles, strategy=self.scaling.placement_strategy
-            )
-            self.pg.ready(timeout=30.0)
+            if self.scaling.topology is not None:
+                # one ICI sub-box; PG expands it to one bundle per TPU host
+                self.pg = rt.pg_manager.create(
+                    [TopologyRequest(tuple(self.scaling.topology))],
+                    strategy=self.scaling.placement_strategy,
+                )
+            else:
+                self.pg = rt.pg_manager.create(
+                    [dict(res) for _ in range(n)],
+                    strategy=self.scaling.placement_strategy,
+                )
+            if not self.pg.ready(timeout=60.0):
+                raise RuntimeError("placement group not ready within 60s")
         except Exception as e:
             logger.warning("gang %s: no placement group (%s); best-effort placement", self.gang_name, e)
+            if self.pg is not None:
+                # drop the queued/failed group now — otherwise it would
+                # materialize later and hold chips no worker ever uses
+                try:
+                    rt.pg_manager.remove(self.pg)
+                except Exception:
+                    pass
             self.pg = None
-        opts = dict(max_concurrency=2, num_cpus=res.get("CPU", 1.0), num_tpus=res.get("TPU", 0.0))
-        self.workers = [
-            TrainWorker.options(**opts).remote(rank, n, self.gang_name)
-            for rank in range(n)
-        ]
+        if self.pg is not None and self.scaling.topology is not None:
+            if n != len(self.pg.bundles):
+                rt.pg_manager.remove(self.pg)
+                raise ValueError(
+                    f"ScalingConfig.num_workers={n} but topology "
+                    f"{self.scaling.topology} spans {len(self.pg.bundles)} TPU "
+                    "hosts; the gang runs one worker per host"
+                )
+        self.workers = []
+        for rank in range(n):
+            if self.pg is not None:
+                # schedule INTO the group's reserved bundle: the demand is
+                # drawn from the bundle tracker, never double-reserved from
+                # the node ledger.
+                bundle = self.pg.bundles[rank]
+                opts = dict(
+                    max_concurrency=2,
+                    num_cpus=bundle.get("CPU", 0.0),
+                    num_tpus=bundle.get("TPU", 0.0),
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group_id=self.pg.id, bundle_index=rank
+                    ),
+                )
+            else:
+                opts = dict(
+                    max_concurrency=2,
+                    num_cpus=res.get("CPU", 1.0),
+                    num_tpus=res.get("TPU", 0.0),
+                )
+            self.workers.append(
+                TrainWorker.options(**opts).remote(rank, n, self.gang_name)
+            )
         if self.scaling.distributed_bootstrap:
             api.get([w.setup_distributed.remote(n) for w in self.workers])
 
@@ -122,9 +166,25 @@ class WorkerGroup:
                 storage_path=self.storage_path,
                 trial_dir=self.storage_path,
                 gang_name=self.gang_name,
+                topology=self._topology_for_rank(rank),
             )
             refs.append(w.run.remote(train_func, cfg, ctx, resume_checkpoint))
         return refs
+
+    def _topology_for_rank(self, rank: int):
+        """The gang member's slice of the ICI sub-box allocation: the box
+        shape/origin (mesh axis order comes from the shape) plus the chip
+        coordinates its host owns."""
+        if self.pg is None or not self.pg.topology_allocations:
+            return None
+        alloc = self.pg.topology_allocations[0]
+        if rank >= len(alloc.bundle_indices):
+            return None
+        return {
+            "origin": tuple(alloc.origin),
+            "shape": tuple(alloc.shape),
+            "host_coords": [tuple(c) for c in alloc.coords_per_bundle[rank]],
+        }
 
     def poll(self) -> List[Any]:
         reports = []
@@ -142,3 +202,10 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
+        if self.pg is not None:
+            rt = api._auto_init()
+            try:
+                rt.pg_manager.remove(self.pg)
+            except Exception:
+                pass
+            self.pg = None

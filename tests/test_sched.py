@@ -152,7 +152,10 @@ class TestPlacementGroups:
         cluster.add_slice(num_hosts=2, chips_per_host=4)
         pg = placement_group([TopologyRequest((2, 2, 1))])
         assert pg.ready(timeout=10)
-        assert pg.bundles[0] == {"TPU": 4.0}
+        # a 2x2 box is one v5e host's chips: one bundle, pinned to that host
+        assert len(pg.bundles) == 1
+        assert pg.bundles[0]["TPU"] == 4.0
+        assert pg.topology_allocations[0].shape in ((2, 2), (2, 2, 1))
         remove_placement_group(pg)
 
     def test_resources_released_on_remove(self, ray_start_cluster):
@@ -162,3 +165,138 @@ class TestPlacementGroups:
         assert node.resources.available()["gpu_like"] == 0.0
         remove_placement_group(pg)
         assert node.resources.available()["gpu_like"] == 2.0
+
+
+class TestTopologyPlacement:
+    """ICI sub-box allocation driving gang placement (SURVEY.md §7.4.2)."""
+
+    def test_box_spans_hosts_with_pinned_bundles(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        # v5p 2x2x4 slice: 16 chips, 4 hosts (2x2x1 block each)
+        cluster.add_slice(generation="v5p", topology_shape=(2, 2, 4))
+        pg = placement_group([TopologyRequest((2, 2, 2))])
+        assert pg.ready(timeout=10)
+        # box spans 2 hosts -> 2 bundles of 4 chips, pinned to distinct nodes
+        assert len(pg.bundles) == 2
+        assert all(b["TPU"] == 4.0 for b in pg.bundles)
+        assert len(set(pg.bundle_nodes)) == 2
+        alloc = pg.topology_allocations[0]
+        assert sorted(alloc.shape) == [2, 2, 2]
+        # contiguity: the 8 coords form an axis-aligned box
+        coords = [c for cs in alloc.coords_per_bundle for c in cs]
+        assert len(coords) == 8
+        los = [min(c[i] for c in coords) for i in range(3)]
+        his = [max(c[i] for c in coords) for i in range(3)]
+        assert all(h - l + 1 == s for l, h, s in zip(los, his, alloc.shape))
+        remove_placement_group(pg)
+
+    def test_fragmented_torus_queues_then_gets_contiguous_box(
+        self, ray_start_cluster
+    ):
+        cluster = ray_start_cluster
+        cluster.add_slice(generation="v5p", topology_shape=(2, 2, 4))
+        # carve the torus into 4 z-layers
+        layers = [placement_group([TopologyRequest((2, 2, 1))]) for _ in range(4)]
+        assert all(pg.ready(timeout=10) for pg in layers)
+        zs = [pg.topology_allocations[0].origin[2] for pg in layers]
+        assert sorted(zs) == [0, 1, 2, 3]
+        # free z=1 and z=3: 8 chips free but NOT contiguous as a 2x2x2 box
+        remove_placement_group(layers[zs.index(1)])
+        remove_placement_group(layers[zs.index(3)])
+        pg = placement_group([TopologyRequest((2, 2, 2))])
+        assert not pg.ready(timeout=0.5), "got a non-contiguous box!"
+        # free z=2 -> contiguous {1,2} or {2,3} exists; queued group lands
+        remove_placement_group(layers[zs.index(2)])
+        assert pg.ready(timeout=10)
+        z0 = pg.topology_allocations[0].origin[2]
+        assert z0 in (1, 2)
+        remove_placement_group(pg)
+
+    def test_impossible_topology_raises(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_slice(generation="v5p", topology_shape=(2, 2, 2))
+        with pytest.raises(PlacementGroupError):
+            placement_group([TopologyRequest((4, 4, 4))])
+
+    def test_tasks_schedule_into_topology_bundle(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_slice(generation="v5p", topology_shape=(2, 2, 2))
+        pg = placement_group([TopologyRequest((2, 2, 2))])
+        assert pg.ready(timeout=10)
+
+        @ray_tpu.remote(
+            num_cpus=0,
+            num_tpus=4,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group_id=pg.id, bundle_index=0
+            ),
+        )
+        def on_chips():
+            return "ok"
+
+        assert ray_tpu.get(on_chips.remote(), timeout=10) == "ok"
+        remove_placement_group(pg)
+
+
+class TestGangScheduling:
+    def test_full_node_gang_no_self_deadlock(self, ray_start_cluster):
+        """A gang sized to the whole node must NOT deadlock against its own
+        placement-group reservation (round-1 bug: workers were scheduled
+        outside the PG while the PG held the same resources)."""
+        from ray_tpu.train.config import ScalingConfig
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        cluster = ray_start_cluster
+        node = cluster.add_node(resources={"CPU": 4.0, "gang_only": 1.0})
+        # consume head-node CPUs so only the 4-CPU node can host the gang
+        head_cpus = cluster.head.resources.available().get("CPU", 0.0)
+        if head_cpus:
+            assert cluster.head.resources.try_acquire({"CPU": head_cpus})
+        wg = WorkerGroup(
+            ScalingConfig(
+                num_workers=4, resources_per_worker={"CPU": 1.0}
+            ),
+            gang_name="gang-deadlock-test",
+            experiment_name="t",
+            storage_path="/tmp/gang-test",
+        )
+        try:
+            assert wg.pg is not None and wg.pg.created
+            refs = wg.run(lambda cfg: "done", {}, None)
+            assert ray_tpu.get(refs, timeout=30) == ["done"] * 4
+        finally:
+            wg.shutdown()
+        # PG removed on shutdown: node resources fully restored
+        assert node.resources.available()["CPU"] == 4.0
+
+    def test_gang_topology_context(self, ray_start_cluster):
+        """Gang workers receive their ICI sub-box coordinates."""
+        from ray_tpu.train.config import ScalingConfig
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        cluster = ray_start_cluster
+        cluster.add_slice(generation="v5p", topology_shape=(2, 2, 4))
+        wg = WorkerGroup(
+            ScalingConfig(num_workers=2, topology=(2, 2, 2)),
+            gang_name="gang-topo-test",
+            experiment_name="t",
+            storage_path="/tmp/gang-topo",
+        )
+        try:
+            assert wg.pg is not None and wg.pg.created
+            assert len(wg.pg.topology_allocations) == 1
+
+            def report_topology(cfg):
+                from ray_tpu.train.session import _get_session
+
+                return _get_session().context.topology
+
+            refs = wg.run(report_topology, {}, None)
+            topos = ray_tpu.get(refs, timeout=30)
+            assert all(t is not None for t in topos)
+            assert all(tuple(sorted(t["shape"])) == (2, 2, 2) for t in topos)
+            all_coords = [c for t in topos for c in t["host_coords"]]
+            assert len(all_coords) == 8
+            assert len(set(all_coords)) == 8
+        finally:
+            wg.shutdown()
