@@ -59,7 +59,13 @@ def declare(name: str, default: Any, doc: str = "") -> None:
 # ---------------------------------------------------------------------------
 
 # Core / scheduling
-declare("worker_pool_size", 0, "Worker processes per node agent; 0 = cpu count.")
+declare(
+    "worker_processes", 0,
+    "CPU-only tasks execute in this many spawned worker processes sharing a "
+    "shm object arena (crash isolation, like the reference's worker pool); "
+    "0 = execute on the node agent's threads. Device tasks always stay on "
+    "threads in the device-owning process.",
+)
 declare("task_max_retries", 3, "Default retries for tasks on worker/node death.")
 declare("actor_max_restarts", 0, "Default actor restarts on failure.")
 declare("lease_timeout_ms", 10_000, "Worker lease grant timeout.")

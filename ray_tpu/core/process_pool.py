@@ -1,0 +1,278 @@
+"""Cross-process worker pool: CPU tasks in spawned processes, shm object plane.
+
+The role of the reference's per-node worker processes (upstream ray
+`src/ray/raylet/worker_pool.cc :: WorkerPool` + plasma `client.cc`): user
+code runs OUTSIDE the runtime's address space, so a segfaulting or leaking
+task kills one worker process — not the node. The TPU split (node_agent.py
+docstring): device tasks stay on threads inside the device-owning process
+(one process owns the TPU); CPU-only tasks route here when
+RAY_TPU_WORKER_PROCESSES > 0.
+
+Data plane: function+args and returns are pickled with protocol 5;
+out-of-band buffers (numpy arrays) travel as separate sealed objects in the
+C++ shared-memory store (core/_shm), so large arrays cross the process
+boundary zero-copy. Payloads that exceed the arena fall back to the control
+pipe. Functions are serialized with cloudpickle (closures, lambdas).
+
+Crash semantics: a worker that dies mid-task fails ONLY that task
+(WorkerCrashedError -> normal retry path); the pool respawns the worker.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+import multiprocessing as mp
+
+import cloudpickle
+
+from .logging import get_logger
+
+logger = get_logger("process_pool")
+
+_POOL_ARENA_BYTES = 256 << 20
+_ID_SIZE = 20
+
+
+class WorkerProcessCrash(RuntimeError):
+    """The worker process executing the task died."""
+
+
+def _oid(tag: bytes) -> bytes:
+    return (tag + uuid.uuid4().bytes)[:_ID_SIZE].ljust(_ID_SIZE, b"\0")
+
+
+# ---------------------------------------------------------------------------
+# shm-backed pickle transport
+# ---------------------------------------------------------------------------
+
+
+def _dump(store, obj: Any, *, use_cloudpickle: bool) -> Tuple[bytes, List[bytes], Optional[bytes]]:
+    """-> (payload_or_empty, buffer_ids, inline_payload).
+
+    Pickles with protocol 5; each out-of-band buffer is sealed as its own shm
+    object. If the store can't take a buffer (arena full / too big), fall
+    back to fully-inline pickling (buffers in-band through the pipe)."""
+    buffers: List[pickle.PickleBuffer] = []
+    dumps = cloudpickle.dumps if use_cloudpickle else pickle.dumps
+    try:
+        payload = dumps(obj, protocol=5, buffer_callback=buffers.append)
+    except Exception:
+        # some object rejects out-of-band buffering; go fully inline
+        return b"", [], dumps(obj, protocol=5)
+    buffer_ids: List[bytes] = []
+    try:
+        for buf in buffers:
+            bid = _oid(b"b")
+            store.put(bid, buf.raw())  # raw(): flat C-contiguous byte view
+            buffer_ids.append(bid)
+    except Exception:
+        for bid in buffer_ids:
+            try:
+                store.delete(bid)
+            except Exception:
+                pass
+        return b"", [], dumps(obj, protocol=5)
+    return payload, buffer_ids, None
+
+
+def _load(store, payload: bytes, buffer_ids: List[bytes], inline: Optional[bytes]) -> Any:
+    if inline is not None:
+        return pickle.loads(inline)
+    pinned: List[bytes] = []
+    try:
+        views = []
+        for bid in buffer_ids:
+            view = store.get_view(bid)
+            if view is None:
+                raise WorkerProcessCrash(f"shm buffer {bid.hex()[:8]} missing")
+            pinned.append(bid)
+            views.append(view)
+        # copy-out on load: the deserialized arrays must outlive the pin
+        return pickle.loads(payload, buffers=[bytes(v) for v in views])
+    finally:
+        for bid in pinned:
+            store.release(bid)
+
+
+def _cleanup_buffers(store, buffer_ids: List[bytes]) -> None:
+    for bid in buffer_ids:
+        try:
+            store.delete(bid)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# worker process
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(store_name: str, req_q, resp_q) -> None:
+    """Entry point of a spawned worker. Imports stay minimal: no jax."""
+    from .shm_store import ShmObjectStore
+
+    store = ShmObjectStore(store_name, create=False)
+    while True:
+        item = req_q.get()
+        if item is None:
+            return
+        task_tag, payload, buffer_ids, inline = item
+        try:
+            fn, args, kwargs = _load(store, payload, buffer_ids, inline)
+            out = fn(*args, **kwargs)
+            r_payload, r_bufs, r_inline = _dump(store, out, use_cloudpickle=False)
+            resp_q.put((task_tag, True, r_payload, r_bufs, r_inline))
+        except BaseException as e:  # noqa: BLE001 — user task may raise anything
+            try:
+                err = cloudpickle.dumps(e)
+            except Exception:
+                err = cloudpickle.dumps(RuntimeError(repr(e)))
+            resp_q.put((task_tag, False, err, [], None))
+
+
+@dataclass
+class _Worker:
+    proc: mp.process.BaseProcess
+    req_q: Any
+    resp_q: Any
+
+
+class ProcessPool:
+    """N spawned worker processes sharing one shm arena with the parent."""
+
+    def __init__(self, num_workers: int, store_name: Optional[str] = None):
+        from .shm_store import ShmObjectStore
+
+        self.num_workers = max(1, num_workers)
+        self.store_name = store_name or f"/ray_tpu_pool_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+        self.store = ShmObjectStore(
+            self.store_name, capacity=_POOL_ARENA_BYTES, max_objects=8192
+        )
+        self._ctx = mp.get_context("spawn")
+        self._tasks: "queue.Queue[Optional[Tuple]]" = queue.Queue()
+        self._closed = threading.Event()
+        self._threads: List[threading.Thread] = []
+        for i in range(self.num_workers):
+            t = threading.Thread(
+                target=self._lane, args=(i,), daemon=True, name=f"pool-lane-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    # ------------------------------------------------------------------ api
+
+    def run(self, fn: Callable, args: tuple, kwargs: dict, timeout: Optional[float] = None) -> Any:
+        """Execute fn(*args, **kwargs) in a worker process; blocks the calling
+        thread. Raises WorkerProcessCrash if the worker dies, or the task's
+        own exception."""
+        if self._closed.is_set():
+            raise RuntimeError("process pool is closed")
+        done = threading.Event()
+        box: List[Any] = [None, None]  # (ok, value_or_error)
+
+        def complete(ok: bool, value: Any) -> None:
+            box[0], box[1] = ok, value
+            done.set()
+
+        self._tasks.put((fn, args, kwargs, complete))
+        if not done.wait(timeout):
+            raise TimeoutError("process-pool task timed out")
+        if box[0]:
+            return box[1]
+        raise box[1]
+
+    def close(self) -> None:
+        self._closed.set()
+        for _ in self._threads:
+            self._tasks.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ internals
+
+    def _spawn(self) -> _Worker:
+        req_q = self._ctx.Queue()
+        resp_q = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=_worker_main,
+            args=(self.store_name, req_q, resp_q),
+            daemon=True,
+        )
+        proc.start()
+        return _Worker(proc, req_q, resp_q)
+
+    def _lane(self, index: int) -> None:
+        """One parent thread drives one worker process: ship task, await
+        response or death. Worker death fails only the in-flight task."""
+        worker: Optional[_Worker] = None
+        while not self._closed.is_set():
+            item = self._tasks.get()
+            if item is None:
+                break
+            fn, args, kwargs, complete = item
+            if worker is None or not worker.proc.is_alive():
+                worker = self._spawn()
+            tag = uuid.uuid4().hex
+            try:
+                payload, buffer_ids, inline = _dump(
+                    self.store, (fn, args, kwargs), use_cloudpickle=True
+                )
+            except Exception as e:
+                complete(False, e)
+                continue
+            worker.req_q.put((tag, payload, buffer_ids, inline))
+            resp = None
+            while resp is None:
+                try:
+                    resp = worker.resp_q.get(timeout=0.05)
+                except queue.Empty:
+                    if not worker.proc.is_alive():
+                        break
+                    if self._closed.is_set():
+                        break
+            _cleanup_buffers(self.store, buffer_ids)
+            if resp is None:
+                code = worker.proc.exitcode
+                worker = None  # respawn lazily for the next task
+                complete(
+                    False,
+                    WorkerProcessCrash(
+                        f"worker process died (exitcode {code}) while running task"
+                    ),
+                )
+                continue
+            rtag, ok, r_payload, r_bufs, r_inline = resp
+            if rtag != tag:  # stale response from a previous crash window
+                complete(False, WorkerProcessCrash("worker desynchronized"))
+                worker.proc.terminate()
+                worker = None
+                continue
+            try:
+                if ok:
+                    value = _load(self.store, r_payload, r_bufs, r_inline)
+                    complete(True, value)
+                else:
+                    complete(False, pickle.loads(r_payload))
+            except Exception as e:
+                complete(False, e)
+            finally:
+                _cleanup_buffers(self.store, r_bufs)
+        if worker is not None and worker.proc.is_alive():
+            try:
+                worker.req_q.put(None)
+                worker.proc.join(timeout=2)
+                if worker.proc.is_alive():
+                    worker.proc.terminate()
+            except Exception:
+                pass
