@@ -104,6 +104,8 @@ def shutdown() -> None:
     if _cw.runtime_initialized():
         _cw.get_runtime().shutdown()
         _cw.set_runtime(None)
+        # init()-scoped system_config must not leak into the next runtime
+        config.reset()
 
 
 def is_initialized() -> bool:
@@ -112,6 +114,14 @@ def is_initialized() -> bool:
 
 def _auto_init() -> Runtime:
     if not _cw.runtime_initialized():
+        if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
+            raise RuntimeError(
+                "the ray_tpu API is not available inside process-pool "
+                "workers: a worker-local runtime's refs/handles would be "
+                "meaningless to the driver. Return plain values instead, "
+                "or run this task with num_tpus/actor semantics so it "
+                "stays in the driver process."
+            )
         init()
     return _cw.get_runtime()
 

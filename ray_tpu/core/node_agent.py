@@ -23,6 +23,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .config import config
 from .control_plane import ControlPlane, NodeInfo
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .logging import get_logger
@@ -153,6 +154,10 @@ class NodeAgent:
         # tasks currently running, for cancellation/failure injection
         self._running: Dict[TaskID, threading.Event] = {}
         self._pending_actor_dones: Dict[TaskID, DoneCallback] = {}
+        # CPU-task process pool (config.worker_processes > 0): created lazily
+        # on the first eligible task so thread-mode runtimes pay nothing.
+        self._pool = None
+        self._pool_lock = threading.Lock()
         # test hook: simulate a hung host (stops heartbeating, keeps running)
         self.suspend_heartbeat = False
 
@@ -257,7 +262,7 @@ class NodeAgent:
             func = getattr(instance, spec.method_name)
         else:
             func = spec.func
-        out = func(*args, **kwargs)
+        out = self._invoke(spec, func, args, kwargs)
         if kill_event.is_set():
             raise WorkerCrashedError("worker killed during execution")
         n = spec.options.num_returns
@@ -269,6 +274,59 @@ class NodeAgent:
             raise ValueError(f"task {spec.name} declared num_returns={n} but "
                              f"returned {type(out).__name__}")
         return list(out)
+
+    def _invoke(self, spec: TaskSpec, func, args, kwargs):
+        """Route execution: stateless CPU-only tasks go to the worker-process
+        pool when enabled (crash isolation, the reference's worker-process
+        model); device tasks and actors stay on threads in the device-owning
+        process (node_agent docstring). Tasks that can't cross the process
+        boundary (unpicklable closures) fall back to in-process execution."""
+        if (
+            spec.kind is TaskKind.NORMAL
+            and config.worker_processes > 0
+            and spec.options.resource_demand().get("TPU", 0.0) <= 0.0
+        ):
+            from .process_pool import (
+                TaskNotSerializableError,
+                WorkerProcessCrash,
+            )
+
+            pool = self._ensure_pool()
+            if pool is not None:
+                try:
+                    return pool.run(func, tuple(args), dict(kwargs))
+                except TaskNotSerializableError:
+                    logger.debug(
+                        "task %s not serializable; executing in-process",
+                        spec.name,
+                    )
+                except WorkerProcessCrash as e:
+                    raise WorkerCrashedError(str(e)) from e
+        return func(*args, **kwargs)
+
+    def _ensure_pool(self):
+        with self._pool_lock:
+            if self._pool is None and not self._stopped.is_set():
+                from .process_pool import (
+                    acquire_shared_pool,
+                    register_inline_only_types,
+                )
+
+                try:
+                    from ..api import ActorHandle
+                    from .core_worker import ObjectRef
+
+                    register_inline_only_types(ObjectRef, ActorHandle)
+                except Exception:
+                    pass
+                try:
+                    # refcounted process-wide singleton: virtual nodes share
+                    # one OS process, so one pool serves them all
+                    self._pool = acquire_shared_pool(config.worker_processes)
+                except Exception as e:  # shm unavailable: stay on threads
+                    logger.warning("process pool unavailable (%s); using threads", e)
+                    self._pool = False
+            return self._pool or None
 
     def _materialize_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
         from .core_worker import ObjectRef  # cycle: resolved at call time
@@ -415,6 +473,12 @@ class NodeAgent:
 
     def stop(self) -> None:
         self._stopped.set()
+        with self._pool_lock:
+            pool, self._pool = self._pool, False
+        if pool:
+            from .process_pool import release_shared_pool
+
+            release_shared_pool()
         with self._lock:
             actors = list(self._actors.values())
         for runner in actors:

@@ -20,9 +20,11 @@ Crash semantics: a worker that dies mid-task fails ONLY that task
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import queue
+import sys
 import threading
 import uuid
 from dataclasses import dataclass
@@ -44,6 +46,40 @@ class WorkerProcessCrash(RuntimeError):
     """The worker process executing the task died."""
 
 
+class TaskNotSerializableError(RuntimeError):
+    """The task (fn/args) cannot cross the process boundary; callers may
+    fall back to in-process execution."""
+
+
+# Runtime-handle types (ObjectRef, ActorHandle) pickle by id and would
+# resolve against a NEW runtime inside a worker process — silently wrong
+# without an RPC back-channel. Registered by the node agent; their presence
+# anywhere in a task payload forces in-process execution.
+_INLINE_ONLY_TYPES: tuple = ()
+
+
+def register_inline_only_types(*types: type) -> None:
+    global _INLINE_ONLY_TYPES
+    _INLINE_ONLY_TYPES = tuple(set(_INLINE_ONLY_TYPES + types))
+
+
+class _TaskPickler(cloudpickle.CloudPickler):
+    def reducer_override(self, obj):
+        if _INLINE_ONLY_TYPES and isinstance(obj, _INLINE_ONLY_TYPES):
+            raise TaskNotSerializableError(
+                f"{type(obj).__name__} cannot cross the process boundary"
+            )
+        return super().reducer_override(obj)
+
+
+def _cloudpickle_dumps(obj: Any, protocol: int = 5, buffer_callback=None) -> bytes:
+    import io
+
+    buf = io.BytesIO()
+    _TaskPickler(buf, protocol=protocol, buffer_callback=buffer_callback).dump(obj)
+    return buf.getvalue()
+
+
 def _oid(tag: bytes) -> bytes:
     return (tag + uuid.uuid4().bytes)[:_ID_SIZE].ljust(_ID_SIZE, b"\0")
 
@@ -60,9 +96,11 @@ def _dump(store, obj: Any, *, use_cloudpickle: bool) -> Tuple[bytes, List[bytes]
     object. If the store can't take a buffer (arena full / too big), fall
     back to fully-inline pickling (buffers in-band through the pipe)."""
     buffers: List[pickle.PickleBuffer] = []
-    dumps = cloudpickle.dumps if use_cloudpickle else pickle.dumps
+    dumps = _cloudpickle_dumps if use_cloudpickle else pickle.dumps
     try:
         payload = dumps(obj, protocol=5, buffer_callback=buffers.append)
+    except TaskNotSerializableError:
+        raise  # inline retry would serialize everything again just to re-raise
     except Exception:
         # some object rejects out-of-band buffering; go fully inline
         return b"", [], dumps(obj, protocol=5)
@@ -114,10 +152,43 @@ def _cleanup_buffers(store, buffer_ids: List[bytes]) -> None:
 # ---------------------------------------------------------------------------
 
 
+_main_guard = threading.Lock()
+
+
+@contextlib.contextmanager
+def _suppress_main_reimport():
+    """Stop multiprocessing from re-running the driver's __main__ in workers.
+
+    mp's spawn/forkserver preparation re-executes the parent's main module in
+    every child — which crashes outright when the driver is <stdin>/REPL and
+    re-runs script side effects otherwise. Workers here never need driver
+    state: functions arrive by value via cloudpickle (main-module functions
+    included). Blanking __main__.__file__/__spec__ while start() computes the
+    preparation data makes the child skip the main-module fixup entirely."""
+    main = sys.modules.get("__main__")
+    if main is None:
+        yield
+        return
+    with _main_guard:
+        saved_file = main.__dict__.pop("__file__", None)
+        saved_spec = main.__dict__.get("__spec__", None)
+        main.__spec__ = None
+        try:
+            yield
+        finally:
+            if saved_file is not None:
+                main.__file__ = saved_file
+            main.__spec__ = saved_spec
+
+
 def _worker_main(store_name: str, req_q, resp_q) -> None:
     """Entry point of a spawned worker. Imports stay minimal: no jax."""
     from .shm_store import ShmObjectStore
 
+    # Runtime API calls inside a pool worker would _auto_init a PRIVATE
+    # runtime whose refs/handles are meaningless to the parent; api.py
+    # checks this flag and raises a clear error instead.
+    os.environ["RAY_TPU_IN_POOL_WORKER"] = "1"
     store = ShmObjectStore(store_name, create=False)
     while True:
         item = req_q.get()
@@ -155,9 +226,18 @@ class ProcessPool:
         self.store = ShmObjectStore(
             self.store_name, capacity=_POOL_ARENA_BYTES, max_objects=8192
         )
-        self._ctx = mp.get_context("spawn")
+        # forkserver, not spawn: spawn re-imports the parent's __main__ in
+        # every worker, which crashes when the driver is <stdin>/REPL and
+        # re-executes side effects when it is a script. The forkserver child
+        # forks from a clean server process that never saw driver state (or
+        # jax/TPU handles). spawn is the fallback where forkserver is absent.
+        try:
+            self._ctx = mp.get_context("forkserver")
+        except ValueError:
+            self._ctx = mp.get_context("spawn")
         self._tasks: "queue.Queue[Optional[Tuple]]" = queue.Queue()
         self._closed = threading.Event()
+        self._submit_lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         for i in range(self.num_workers):
             t = threading.Thread(
@@ -172,8 +252,6 @@ class ProcessPool:
         """Execute fn(*args, **kwargs) in a worker process; blocks the calling
         thread. Raises WorkerProcessCrash if the worker dies, or the task's
         own exception."""
-        if self._closed.is_set():
-            raise RuntimeError("process pool is closed")
         done = threading.Event()
         box: List[Any] = [None, None]  # (ok, value_or_error)
 
@@ -181,7 +259,14 @@ class ProcessPool:
             box[0], box[1] = ok, value
             done.set()
 
-        self._tasks.put((fn, args, kwargs, complete))
+        # submit under the close lock: a task can never be enqueued after
+        # close() drained the queue (it would strand this caller forever).
+        # WorkerProcessCrash (not RuntimeError) so callers keep the normal
+        # system-failure retry path when a node stop races a submission.
+        with self._submit_lock:
+            if self._closed.is_set():
+                raise WorkerProcessCrash("process pool is closed")
+            self._tasks.put((fn, args, kwargs, complete))
         if not done.wait(timeout):
             raise TimeoutError("process-pool task timed out")
         if box[0]:
@@ -189,15 +274,32 @@ class ProcessPool:
         raise box[1]
 
     def close(self) -> None:
-        self._closed.set()
-        for _ in self._threads:
-            self._tasks.put(None)
+        with self._submit_lock:
+            if self._closed.is_set():
+                return
+            self._closed.set()
+            for _ in self._threads:
+                self._tasks.put(None)
+        all_joined = True
         for t in self._threads:
             t.join(timeout=5)
-        try:
-            self.store.close()
-        except Exception:
-            pass
+            all_joined = all_joined and not t.is_alive()
+        # lanes exit at the top-of-loop closed check without draining: fail
+        # anything still queued so no caller blocks in done.wait() forever
+        while True:
+            try:
+                item = self._tasks.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[3](False, WorkerProcessCrash("process pool closed"))
+        # a lane that outlived the join (task >5s) still holds the store;
+        # leak the mapping rather than hand it a dead handle
+        if all_joined:
+            try:
+                self.store.close()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ internals
 
@@ -209,7 +311,8 @@ class ProcessPool:
             args=(self.store_name, req_q, resp_q),
             daemon=True,
         )
-        proc.start()
+        with _suppress_main_reimport():
+            proc.start()
         return _Worker(proc, req_q, resp_q)
 
     def _lane(self, index: int) -> None:
@@ -229,7 +332,7 @@ class ProcessPool:
                     self.store, (fn, args, kwargs), use_cloudpickle=True
                 )
             except Exception as e:
-                complete(False, e)
+                complete(False, TaskNotSerializableError(repr(e)))
                 continue
             worker.req_q.put((tag, payload, buffer_ids, inline))
             resp = None
@@ -276,3 +379,37 @@ class ProcessPool:
                     worker.proc.terminate()
             except Exception:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# process-wide shared pool
+# ---------------------------------------------------------------------------
+# Virtual nodes share one OS process, so per-agent pools would multiply
+# worker processes and /dev/shm arenas for no isolation gain. Agents acquire
+# a refcounted singleton instead; the last release closes it.
+
+_shared_lock = threading.Lock()
+_shared_pool: Optional[ProcessPool] = None
+_shared_refs = 0
+
+
+def acquire_shared_pool(num_workers: int) -> ProcessPool:
+    global _shared_pool, _shared_refs
+    with _shared_lock:
+        if _shared_pool is None:
+            _shared_pool = ProcessPool(num_workers)
+            _shared_refs = 0
+        _shared_refs += 1
+        return _shared_pool
+
+
+def release_shared_pool() -> None:
+    global _shared_pool, _shared_refs
+    with _shared_lock:
+        if _shared_pool is None:
+            return
+        _shared_refs -= 1
+        if _shared_refs > 0:
+            return
+        pool, _shared_pool = _shared_pool, None
+    pool.close()

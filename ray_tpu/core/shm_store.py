@@ -104,23 +104,52 @@ class ShmObjectStore:
 
     # -- raw byte API --------------------------------------------------------
 
-    def put(self, object_id: bytes, data: bytes) -> None:
-        _check_id(object_id)
-        ptr = self._lib.shm_obj_create(self._h, object_id, len(data))
+    def _handle(self):
+        """The C functions do no null check: calling through a closed handle
+        is a segfault, not an error. Every entry point goes through here."""
+        h = self._h
+        if not h:
+            raise ShmStoreError(f"shm store {self.name} is closed")
+        return h
+
+    def _create_write_seal(self, object_id: bytes, total: int, write) -> None:
+        """Allocate, fill via write(ptr), seal. A failure after create must
+        reclaim the slot: the creator pin (pins=1 until seal) blocks delete,
+        so release it first — otherwise the unsealed entry is a permanent
+        compaction barrier the LRU can never evict."""
+        h = self._handle()
+        ptr = self._lib.shm_obj_create(h, object_id, total)
         if not ptr:
             raise ShmStoreError(
-                f"create failed for {object_id.hex()[:8]} ({len(data)}B): "
+                f"create failed for {object_id.hex()[:8]} ({total}B): "
                 f"duplicate, table full, or arena exhausted"
             )
-        ctypes.memmove(ptr, data, len(data))
-        if self._lib.shm_obj_seal(self._h, object_id) != 0:
-            raise ShmStoreError("seal failed")
+        try:
+            write(ptr)
+            if self._lib.shm_obj_seal(h, object_id) != 0:
+                raise ShmStoreError("seal failed")
+        except Exception:
+            self._lib.shm_obj_release(h, object_id)  # drop creator pin
+            self._lib.shm_obj_delete(h, object_id)
+            raise
+
+    def put(self, object_id: bytes, data) -> None:
+        """data: bytes or any C-contiguous buffer (memoryview, pickle5 raw)."""
+        _check_id(object_id)
+        if not isinstance(data, (bytes, bytearray)):
+            data = np.frombuffer(data, np.uint8)  # zero-copy address handle
+        n = data.nbytes if isinstance(data, np.ndarray) else len(data)
+        src = data.ctypes.data if isinstance(data, np.ndarray) else bytes(data)
+        self._create_write_seal(object_id, n, lambda ptr: ctypes.memmove(ptr, src, n))
 
     def get_view(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy pinned view; call release(id) when done."""
         _check_id(object_id)
         size = ctypes.c_uint64()
-        ptr = self._lib.shm_obj_get(self._h, object_id, ctypes.byref(size))
+        h = self._h
+        if not h:  # closed mid-flight: report missing, don't segfault
+            return None
+        ptr = self._lib.shm_obj_get(h, object_id, ctypes.byref(size))
         if not ptr:
             return None
         arr = (ctypes.c_uint8 * size.value).from_address(ptr)
@@ -141,10 +170,16 @@ class ShmObjectStore:
         self._lib.shm_obj_release(self._h, _check_id(object_id))
 
     def delete(self, object_id: bytes) -> bool:
-        return self._lib.shm_obj_delete(self._h, _check_id(object_id)) == 0
+        h = self._h
+        if not h:
+            return False
+        return self._lib.shm_obj_delete(h, _check_id(object_id)) == 0
 
     def contains(self, object_id: bytes) -> bool:
-        return self._lib.shm_obj_contains(self._h, _check_id(object_id)) == 1
+        h = self._h
+        if not h:
+            return False
+        return self._lib.shm_obj_contains(h, _check_id(object_id)) == 1
 
     # -- numpy zero-copy -----------------------------------------------------
 
@@ -152,13 +187,12 @@ class ShmObjectStore:
         arr = np.ascontiguousarray(arr)
         header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}|".encode()
         total = len(header) + arr.nbytes
-        ptr = self._lib.shm_obj_create(self._h, _check_id(object_id), total)
-        if not ptr:
-            raise ShmStoreError("create failed")
-        ctypes.memmove(ptr, header, len(header))
-        ctypes.memmove(ptr + len(header), arr.ctypes.data, arr.nbytes)
-        if self._lib.shm_obj_seal(self._h, object_id) != 0:
-            raise ShmStoreError("seal failed")
+
+        def write(ptr):
+            ctypes.memmove(ptr, header, len(header))
+            ctypes.memmove(ptr + len(header), arr.ctypes.data, arr.nbytes)
+
+        self._create_write_seal(_check_id(object_id), total, write)
 
     def get_array(self, object_id: bytes) -> Optional[np.ndarray]:
         """Zero-copy read: the returned array aliases shared memory. The pin
@@ -182,10 +216,10 @@ class ShmObjectStore:
         return data.view(dtype).reshape(shape)
 
     def live_bytes(self) -> int:
-        return self._lib.shm_store_live_bytes(self._h)
+        return self._lib.shm_store_live_bytes(self._handle())
 
     def capacity(self) -> int:
-        return self._lib.shm_store_capacity(self._h)
+        return self._lib.shm_store_capacity(self._handle())
 
     def close(self) -> None:
         if self._h:

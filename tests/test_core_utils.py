@@ -75,7 +75,7 @@ class TestConfig:
     def test_describe(self):
         flags = describe_flags()
         assert "task_max_retries" in flags
-        assert flags["worker_pool_size"]["doc"]
+        assert flags["worker_processes"]["doc"]
 
 
 class TestMetrics:
