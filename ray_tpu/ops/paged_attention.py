@@ -171,6 +171,7 @@ def paged_attention_decode(
     page_table: jax.Array,
     lengths: jax.Array,
     scale: float | None = None,
+    force_xla: bool = False,
 ) -> jax.Array:
     """One decode step of attention over a paged KV cache.
 
@@ -179,12 +180,16 @@ def paged_attention_decode(
       k_pages/v_pages: [KVH, num_pages, page_size, D].
       page_table: [B, pages_per_seq] int32 page ids (unused tail arbitrary).
       lengths: [B] int32 valid context length per sequence.
+      force_xla: skip the Pallas kernel (callers running under GSPMD
+        sharding, where the single-device pallas_call cannot partition).
     Returns [B, H, D].
     """
     D = q.shape[-1]
     if scale is None:
         scale = D**-0.5
-    if not (use_pallas() and D % _LANES == 0 and q.shape[1] % k_pages.shape[0] == 0):
+    if force_xla or not (
+        use_pallas() and D % _LANES == 0 and q.shape[1] % k_pages.shape[0] == 0
+    ):
         return _paged_reference(q, k_pages, v_pages, page_table, lengths, scale)
     return platform_dispatch(
         lambda *a: _paged_pallas(*a, scale),

@@ -21,3 +21,8 @@ from .deployment import Application, Deployment, deployment  # noqa: F401
 from .engine import EngineConfig, InferenceEngine, Request  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from .llm import LLMServer  # noqa: F401
+from .openai_api import (  # noqa: F401
+    ByteTokenizer,
+    OpenAIServer,
+    build_openai_app,
+)

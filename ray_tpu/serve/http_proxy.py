@@ -62,7 +62,10 @@ class HTTPProxy:
                     return self._send(404, {"error": f"no app at {self.path}"})
                 handle = proxy.routes[parts[0]]
                 if len(parts) > 1:
-                    handle = handle.options(parts[1])
+                    # nested paths map to underscored methods, so the
+                    # OpenAI wire path /v1/chat/completions hits
+                    # chat_completions on the deployment
+                    handle = handle.options("_".join(parts[1:]))
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b"{}"
                 try:
@@ -71,10 +74,29 @@ class HTTPProxy:
                     return self._send(400, {"error": f"bad json: {e}"})
                 try:
                     result = handle.remote(payload).result(timeout=300.0)
+                    if _is_stream(result):
+                        return self._send_sse(result)
                     return self._send(200, {"result": _jsonable(result)})
                 except Exception as e:
                     logger.warning("request failed", exc_info=True)
                     return self._send(500, {"error": str(e)})
+
+            def _send_sse(self, chunks):
+                """Server-sent events: one `data:` line per chunk, then
+                [DONE] (the OpenAI streaming wire format)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        data = json.dumps(_jsonable(chunk))
+                        self.wfile.write(f"data: {data}\n\n".encode())
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.debug("SSE client disconnected")
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
@@ -88,6 +110,11 @@ class HTTPProxy:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+
+def _is_stream(x: Any) -> bool:
+    """Generators/iterators stream as SSE; don't mistake JSON containers."""
+    return hasattr(x, "__next__")
 
 
 def _jsonable(x: Any) -> Any:

@@ -33,6 +33,7 @@ class LLMServer:
         engine_config: Optional[Dict[str, Any]] = None,
         params_fn=None,
         model_overrides: Optional[Dict[str, Any]] = None,
+        tensor_parallel: int = 1,
     ):
         if params_fn is not None:
             params, cfg = params_fn()
@@ -40,10 +41,34 @@ class LLMServer:
             cfg = get_config(model_name, **(model_overrides or {}))
             params = init_params(cfg, jax.random.PRNGKey(0))
         ecfg = EngineConfig(**(engine_config or {}))
-        self.engine = InferenceEngine(params, cfg, ecfg)
+        mesh = None
+        if tensor_parallel > 1:
+            from ..comm.mesh import MeshSpec, build_mesh
+
+            devices = jax.devices()
+            if len(devices) < tensor_parallel:
+                raise ValueError(
+                    f"tensor_parallel={tensor_parallel} needs that many local "
+                    f"devices, have {len(devices)}"
+                )
+            mesh = build_mesh(
+                MeshSpec.create(tp=tensor_parallel),
+                devices=devices[:tensor_parallel],
+            )
+        self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         return self.engine.generate(
+            prompt=list(request["prompt_ids"]),
+            max_tokens=int(request.get("max_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            request_id=request.get("request_id"),
+        )
+
+    def stream(self, request: Dict[str, Any]):
+        """Token iterator: first token arrives at TTFT, not completion.
+        (In-process runtime: the generator crosses the handle live.)"""
+        return self.engine.generate_stream(
             prompt=list(request["prompt_ids"]),
             max_tokens=int(request.get("max_tokens", 32)),
             temperature=float(request.get("temperature", 0.0)),

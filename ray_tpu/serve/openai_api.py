@@ -1,0 +1,216 @@
+"""OpenAI-compatible serving surface over the inference engine.
+
+Reference analogue: `ray.serve.llm :: build_openai_app` (A4 in SURVEY.md
+§2.3), which fronts vLLM with /v1/completions + /v1/chat/completions.
+Here the app is one deployment whose methods map to proxy routes:
+
+    app = build_openai_app(model_name=..., tokenizer="byte")
+    serve.run(app, name="v1")
+    # POST /v1/completions        {"prompt": "...", "max_tokens": 8}
+    # POST /v1/chat_completions   {"messages": [{"role": "user", ...}]}
+    # POST /v1/models
+    # "stream": true -> server-sent events through the HTTP proxy
+
+Tokenizers: "byte" (utf-8 bytes, zero deps — any model with vocab >= 256)
+or a HuggingFace tokenizer name (lazy transformers import).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+
+from ..models import get_config, init_params
+from .deployment import deployment
+from .engine import EngineConfig, InferenceEngine
+
+
+class ByteTokenizer:
+    """utf-8 bytes as token ids. No vocab files, no downloads — the test
+    and smoke-path tokenizer (models only need vocab_size >= 256)."""
+
+    eos_token_id: Optional[int] = None
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Iterable[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", "replace")
+
+
+class HFTokenizer:
+    """HuggingFace tokenizer wrapper (lazy import; needs local files or a
+    warm cache — this image has no egress)."""
+
+    def __init__(self, name: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name)
+        self.eos_token_id = self._tok.eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return list(self._tok.encode(text))
+
+    def decode(self, ids: Iterable[int]) -> str:
+        return self._tok.decode(list(ids))
+
+
+def _make_tokenizer(spec) -> Any:
+    if spec is None or spec == "byte":
+        return ByteTokenizer()
+    if isinstance(spec, str):
+        return HFTokenizer(spec)
+    return spec  # duck-typed: encode/decode/eos_token_id
+
+
+def _chat_prompt(messages: List[Dict[str, str]]) -> str:
+    """Minimal chat template: role-tagged lines, assistant turn opened."""
+    lines = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+@deployment(name="openai", max_ongoing_requests=64)
+class OpenAIServer:
+    """OpenAI-shaped routes over one continuously-batched engine."""
+
+    def __init__(
+        self,
+        model_name: str = "tiny-llama",
+        engine_config: Optional[Dict[str, Any]] = None,
+        params_fn=None,
+        model_overrides: Optional[Dict[str, Any]] = None,
+        tokenizer: Any = "byte",
+        tensor_parallel: int = 1,
+    ):
+        self.model_name = model_name
+        self.tokenizer = _make_tokenizer(tokenizer)
+        if params_fn is not None:
+            params, cfg = params_fn()
+        else:
+            cfg = get_config(model_name, **(model_overrides or {}))
+            params = init_params(cfg, jax.random.PRNGKey(0))
+        ecfg_kw = dict(engine_config or {})
+        ecfg_kw.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+        ecfg = EngineConfig(**ecfg_kw)
+        mesh = None
+        if tensor_parallel > 1:
+            from ..comm.mesh import MeshSpec, build_mesh
+
+            devices = jax.devices()[:tensor_parallel]
+            mesh = build_mesh(MeshSpec.create(tp=tensor_parallel), devices=devices)
+        self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
+
+    # ------------------------------------------------------------- routes
+
+    def completions(self, body: Dict[str, Any]):
+        prompt = body.get("prompt", "")
+        ids = (
+            list(prompt)
+            if isinstance(prompt, (list, tuple))
+            else self.tokenizer.encode(str(prompt))
+        )
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        if body.get("stream"):
+            return self._stream_sse(
+                rid, "text_completion", ids, max_tokens, temperature
+            )
+        out = self.engine.generate(ids, max_tokens=max_tokens, temperature=temperature)
+        text = self.tokenizer.decode(out["token_ids"])
+        return {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [
+                {"index": 0, "text": text, "finish_reason": "length"}
+            ],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(out["token_ids"]),
+                "total_tokens": len(ids) + len(out["token_ids"]),
+            },
+        }
+
+    def chat_completions(self, body: Dict[str, Any]):
+        messages = body.get("messages", [])
+        ids = self.tokenizer.encode(_chat_prompt(messages))
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        if body.get("stream"):
+            return self._stream_sse(rid, "chat.completion", ids, max_tokens, temperature)
+        out = self.engine.generate(ids, max_tokens=max_tokens, temperature=temperature)
+        text = self.tokenizer.decode(out["token_ids"])
+        return {
+            "id": rid,
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "length",
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(out["token_ids"]),
+                "total_tokens": len(ids) + len(out["token_ids"]),
+            },
+        }
+
+    def models(self, _body: Any = None):
+        return {
+            "object": "list",
+            "data": [
+                {"id": self.model_name, "object": "model", "owned_by": "ray_tpu"}
+            ],
+        }
+
+    def stats(self, _body: Any = None):
+        return self.engine.stats()
+
+    def check_health(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ helpers
+
+    def _stream_sse(self, rid, obj, ids, max_tokens, temperature):
+        """Generator of OpenAI stream chunks; the HTTP proxy emits each as
+        a server-sent event (in-process runtime: generators cross the
+        handle live)."""
+        tokenizer, model = self.tokenizer, self.model_name
+        stream = self.engine.generate_stream(
+            ids, max_tokens=max_tokens, temperature=temperature
+        )
+
+        def gen():
+            created = int(time.time())
+            for tok in stream:
+                piece = tokenizer.decode([tok])
+                if obj == "chat.completion":
+                    delta = {"delta": {"content": piece}, "index": 0}
+                else:
+                    delta = {"text": piece, "index": 0}
+                yield {
+                    "id": rid,
+                    "object": obj + ".chunk",
+                    "created": created,
+                    "model": model,
+                    "choices": [delta],
+                }
+
+        return gen()
+
+
+def build_openai_app(**kwargs):
+    """-> bound OpenAIServer deployment; serve.run(app, name='v1') exposes
+    POST /v1/completions, /v1/chat_completions, /v1/models."""
+    return OpenAIServer.bind(**kwargs)

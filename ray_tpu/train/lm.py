@@ -92,7 +92,10 @@ def init_train_state(
         }
 
     with mesh:
-        state = _init(key)
+        # jit + out_shardings, not eager: leaves materialize directly into
+        # their distributed shardings (never whole on one device), and in a
+        # multi-process mesh this is the only way to produce global arrays
+        state = jax.jit(_init, out_shardings=state_shardings)(key)
     return state, state_shardings
 
 
@@ -141,3 +144,17 @@ def synthetic_batch(cfg: ModelConfig, batch_size: int, seq_len: int, seed: int =
     key = jax.random.PRNGKey(seed)
     toks = jax.random.randint(key, (batch_size, seq_len + 1), 0, cfg.vocab_size)
     return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def make_global_batch(batch: Dict[str, Any], shardings: Dict[str, Any]):
+    """Assemble global device arrays from host data for a (possibly
+    multi-process) mesh: every process passes the same full-size host batch
+    and contributes only its addressable shards. In single-process meshes
+    this is equivalent to device_put with the sharding."""
+    import numpy as np
+
+    def put(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, s, lambda idx: x[idx])
+
+    return jax.tree.map(put, batch, shardings)

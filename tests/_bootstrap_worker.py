@@ -1,0 +1,65 @@
+"""Subprocess entry for test_bootstrap_multiproc.
+
+One process of a 2-process jax.distributed gang (the analogue of one Train
+worker host; upstream ray `python/ray/train/torch/config.py ::
+_setup_torch_process_group` path). Joins the coordination service, builds
+the GLOBAL 8-device mesh (4 local CPU devices per process), runs one full
+sharded LM train step, prints the loss for the parent to compare.
+
+Usage: _bootstrap_worker.py <coordinator> <process_id> <num_processes>
+(env must set JAX_PLATFORMS=cpu and xla_force_host_platform_device_count=4).
+"""
+
+import sys
+
+
+def main() -> int:
+    coord, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from ray_tpu.comm.bootstrap import init_distributed
+
+    init_distributed("mp-gang", nproc, pid, coordinator_address=coord)
+
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+    assert len(jax.local_devices()) == 4
+
+    from ray_tpu.comm.mesh import MeshSpec, build_mesh
+    from ray_tpu.models import get_config
+    from ray_tpu.train.lm import (
+        batch_shardings,
+        init_train_state,
+        make_global_batch,
+        make_optimizer,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    cfg = get_config("tiny-llama")
+    mesh = build_mesh(MeshSpec.create(dp=2, fsdp=2, tp=2))
+    opt = make_optimizer(total_steps=10)
+    state, shardings = init_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+    step = jax.jit(
+        make_train_step(cfg, opt),
+        donate_argnums=0,
+        in_shardings=(shardings, batch_shardings(mesh)),
+    )
+    # identical host batch in every process; each contributes its shards
+    host_batch = jax.tree.map(
+        lambda x: jax.device_get(x), synthetic_batch(cfg, 4, 32)
+    )
+    batch = make_global_batch(host_batch, batch_shardings(mesh))
+    with mesh:
+        state, metrics = step(state, batch)
+        state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    assert jnp.isfinite(jnp.asarray(loss)), loss
+    print(f"GANG_LOSS {loss:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,3 +48,31 @@ def cpu_mesh_devices():
     devices = jax.devices("cpu")
     assert len(devices) >= 8, "conftest should provide 8 virtual devices"
     return devices[:8]
+
+
+# --------------------------------------------------------------------------
+# Fast/slow tiers. The XLA-fallback kernel variants are correctness-critical
+# but compile-bound on CPU (10-80s per eager call); they run in the slow tier
+# (full suite / CI), while `-m "not slow"` stays a quick signal. The Pallas
+# interpret variants stay fast.
+# --------------------------------------------------------------------------
+
+_SLOW_COMPILE_TESTS = {
+    # test_ops.py: eager XLA-fallback compiles dominate
+    "test_non_multiple_seq_len",
+    "test_against_flash",
+    "test_grads_match_reference",
+    "test_matches_reference",
+    "test_uneven_blocks_fall_back",
+    "test_matches_dense",
+    "test_rms_norm_grad",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.fspath.basename != "test_ops.py":
+            continue
+        name = getattr(item, "originalname", None) or item.name
+        if name in _SLOW_COMPILE_TESTS and "pallas" not in item.name:
+            item.add_marker(pytest.mark.slow)
