@@ -97,13 +97,25 @@ def _dump(store, obj: Any, *, use_cloudpickle: bool) -> Tuple[bytes, List[bytes]
     back to fully-inline pickling (buffers in-band through the pipe)."""
     buffers: List[pickle.PickleBuffer] = []
     dumps = _cloudpickle_dumps if use_cloudpickle else pickle.dumps
+
+    def inline(o):
+        # pickling-phase failures (any exception type — reducers can raise
+        # ValueError, NotImplementedError, ...) classify as not-serializable
+        # so callers may fall back in-process; infra errors stay distinct.
+        try:
+            return dumps(o, protocol=5)
+        except TaskNotSerializableError:
+            raise
+        except Exception as e:
+            raise TaskNotSerializableError(repr(e)) from e
+
     try:
         payload = dumps(obj, protocol=5, buffer_callback=buffers.append)
     except TaskNotSerializableError:
         raise  # inline retry would serialize everything again just to re-raise
     except Exception:
         # some object rejects out-of-band buffering; go fully inline
-        return b"", [], dumps(obj, protocol=5)
+        return b"", [], inline(obj)
     buffer_ids: List[bytes] = []
     try:
         for buf in buffers:
@@ -116,7 +128,7 @@ def _dump(store, obj: Any, *, use_cloudpickle: bool) -> Tuple[bytes, List[bytes]
                 store.delete(bid)
             except Exception:
                 pass
-        return b"", [], dumps(obj, protocol=5)
+        return b"", [], inline(obj)
     return payload, buffer_ids, None
 
 
@@ -331,8 +343,16 @@ class ProcessPool:
                 payload, buffer_ids, inline = _dump(
                     self.store, (fn, args, kwargs), use_cloudpickle=True
                 )
-            except Exception as e:
+            except TaskNotSerializableError as e:
+                # genuinely unpicklable task (see _dump's phase-based
+                # classification): callers may fall back in-process
                 complete(False, TaskNotSerializableError(repr(e)))
+                continue
+            except Exception as e:
+                # store/infrastructure failure — NOT a serialization problem;
+                # surface it so pool degradation is visible (ADVICE r2)
+                logger.warning("pool transport failure: %r", e)
+                complete(False, WorkerProcessCrash(f"pool transport failure: {e!r}"))
                 continue
             worker.req_q.put((tag, payload, buffer_ids, inline))
             resp = None

@@ -25,4 +25,5 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     health_check_period_s: float = 10.0
+    health_check_timeout_s: float = 30.0
     graceful_shutdown_timeout_s: float = 10.0

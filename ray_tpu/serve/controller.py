@@ -22,7 +22,6 @@ logger = get_logger("serve.controller")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 _HEALTH_FAIL_THRESHOLD = 3  # consecutive misses before a replica is replaced
-_HEALTH_CHECK_TIMEOUT_S = 5.0
 
 
 class _DeploymentState:
@@ -43,6 +42,9 @@ class _DeploymentState:
         # id); replicas are only replaced after _HEALTH_FAIL_THRESHOLD misses
         # so a long compile or GC pause doesn't get a healthy replica killed.
         self.fail_counts: Dict[Any, int] = {}
+        # in-flight async health probes: actor id -> (ref, issued_at)
+        self.health_pending: Dict[Any, Any] = {}
+        self.last_health_check = 0.0
         self.target = config.num_replicas
         self._last_scale_up = 0.0
         self._last_scale_down = 0.0
@@ -130,36 +132,82 @@ class ServeController:
                 logger.warning("reconcile error", exc_info=True)
             self._stop.wait(self._period)
 
+    def _check_health(self, state: _DeploymentState) -> List[Any]:
+        """Probe replica health without ever blocking the reconcile loop.
+
+        Two planes, like upstream serve: (1) the control plane's actor table
+        gives instant detection of provable death (crash/kill); (2) async
+        ``health_check`` probes, issued once per ``health_check_period_s``
+        and harvested with zero timeout on later passes, catch hangs. A slow
+        probe only counts as a miss after ``health_check_timeout_s``, and a
+        replica is replaced only on death or _HEALTH_FAIL_THRESHOLD
+        consecutive misses — a long first-compile (which can stall every
+        thread in the process for 10s+) never gets a live replica killed.
+        """
+        from ..core.core_worker import RayActorError
+        from ..core.control_plane import ActorState
+
+        cfg = state.config
+        rt = api._auto_init()
+        now = time.monotonic()
+        dead: Dict[Any, Any] = {}  # actor id -> handle (deduped)
+        by_id = {r._actor_id: r for r in state.replicas}
+        for r in state.replicas:  # plane 1: actor-table death
+            info = rt.control_plane.get_actor(r._actor_id)
+            if info is not None and info.state is ActorState.DEAD:
+                dead[r._actor_id] = r
+        for rid, (ref, issued) in list(state.health_pending.items()):
+            r = by_id.get(rid)
+            if r is None:
+                state.health_pending.pop(rid, None)
+                continue
+            ready, _ = api.wait([ref], timeout=0)
+            if ready:
+                state.health_pending.pop(rid, None)
+                try:
+                    api.get(ref, timeout=0)
+                    state.fail_counts.pop(rid, None)
+                    continue
+                except Exception as e:
+                    if isinstance(e, RayActorError):
+                        dead[rid] = r
+                        continue
+            elif now - issued <= cfg.health_check_timeout_s:
+                continue  # probe still in flight and within budget
+            else:
+                state.health_pending.pop(rid, None)
+            fails = state.fail_counts.get(rid, 0) + 1
+            state.fail_counts[rid] = fails
+            if fails >= _HEALTH_FAIL_THRESHOLD:
+                dead[rid] = r
+        if now - state.last_health_check >= cfg.health_check_period_s:
+            state.last_health_check = now
+            for r in state.replicas:
+                rid = r._actor_id
+                if rid not in state.health_pending and rid not in dead:
+                    try:
+                        state.health_pending[rid] = (r.health_check.remote(), now)
+                    except Exception:
+                        dead[rid] = r
+        return list(dead.values())
+
     def _reconcile_once(self) -> None:
         with self._lock:
             states = list(self._deployments.values())
         for state in states:
             self._autoscale(state)
-            live = []
-            for r in state.replicas:
-                rid = r._actor_id
+            to_replace = self._check_health(state)
+            live = [r for r in state.replicas if r not in to_replace]
+            for r in to_replace:
+                logger.warning(
+                    "replica of %s is dead or unresponsive; replacing", state.name
+                )
+                state.fail_counts.pop(r._actor_id, None)
+                state.health_pending.pop(r._actor_id, None)
                 try:
-                    api.get(r.health_check.remote(), timeout=_HEALTH_CHECK_TIMEOUT_S)
-                    state.fail_counts.pop(rid, None)
-                    live.append(r)
-                except Exception as e:
-                    from ..core.core_worker import RayActorError
-
-                    definitely_dead = isinstance(e, RayActorError)
-                    fails = state.fail_counts.get(rid, 0) + 1
-                    state.fail_counts[rid] = fails
-                    if not definitely_dead and fails < _HEALTH_FAIL_THRESHOLD:
-                        live.append(r)  # transient (compile/GC pause): keep
-                        continue
-                    logger.warning(
-                        "replica of %s failed %d health checks; replacing",
-                        state.name, fails,
-                    )
-                    state.fail_counts.pop(rid, None)
-                    try:
-                        api.kill(r)
-                    except Exception:
-                        pass
+                    api.kill(r)
+                except Exception:
+                    pass
             changed = len(live) != len(state.replicas)
             state.replicas = live
             # drop stale counters (scaled-down / drained / replaced replicas)

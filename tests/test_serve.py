@@ -139,9 +139,11 @@ class TestServeCore:
         from ray_tpu import api as core_api
         from ray_tpu.serve import controller as ctrl_mod
 
-        monkeypatch.setattr(ctrl_mod, "_HEALTH_CHECK_TIMEOUT_S", 0.3)
-
-        @serve.deployment(ray_actor_options={"max_concurrency": 8})
+        @serve.deployment(
+            ray_actor_options={"max_concurrency": 8},
+            health_check_period_s=0.3,
+            health_check_timeout_s=0.3,
+        )
         class Hangable:
             def __init__(self):
                 self._hang = False
@@ -391,7 +393,7 @@ class TestEngine:
 
         # A: long streaming generation, stamps arrival time per token
         stamps = []
-        stream = engine.generate_stream([1, 2, 3], max_tokens=64)
+        stream = engine.generate_stream([1, 2, 3], max_tokens=56)
         collector_done = threading.Event()
 
         def collect():
@@ -401,7 +403,11 @@ class TestEngine:
 
         t = threading.Thread(target=collect, daemon=True)
         t.start()
+        deadline = time.monotonic() + 60.0
         while len(stamps) < 3:  # A is decoding
+            assert time.monotonic() < deadline, (
+                f"request A never started decoding: {len(stamps)} tokens in 60s"
+            )
             time.sleep(0.005)
         # B: submit with the slow prefill armed
         slow["armed"] = True
@@ -508,8 +514,11 @@ class TestOpenAI:
                 if payload == "[DONE]":
                     break
                 chunks.append(json.loads(payload))
-        assert len(chunks) == 4
+        # 4 content chunks + 1 terminal chunk carrying finish_reason
+        assert len(chunks) == 5
         assert all(c["object"] == "text_completion.chunk" for c in chunks)
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+        assert all("finish_reason" not in c["choices"][0] for c in chunks[:-1])
         # stream pieces concatenate to the non-stream completion
         text = "".join(c["choices"][0]["text"] for c in chunks)
         out = _post(port, "/v1/completions", {"prompt": "hi", "max_tokens": 4})
