@@ -10,10 +10,12 @@ own). Design follows the TPU memory hierarchy:
   in VMEM scratch that persists across the kv axis.
 - GQA is handled with index maps (kv head = q head // group), so K/V are
   never materialized at full head count — saves G× HBM traffic vs repeat.
-- Backward is the standard flash-attention-2 recompute formulation as a
-  `lax.scan` over kv blocks in XLA: O(T·block) activation memory, MXU-sized
-  matmuls, no O(T²) residuals. (A fused Pallas backward is a later
-  optimization; the scan already keeps the MXU busy.)
+- Backward on the TPU path is a pair of fused Pallas kernels (flash-2
+  formulation): a dq kernel gridded (batch, heads, q-blocks, kv-blocks)
+  and a dk/dv kernel gridded (batch, heads, kv-blocks, q-blocks), both
+  reading the forward's logsumexp residual. GQA dk/dv are computed
+  per-q-head and group-summed outside the kernel. Off-TPU platforms fall
+  back to a `lax.scan` XLA formulation with identical semantics.
 
 Layout convention: public API is [B, T, H, D] (model layout); kernels run
 [B, H, T, D].
@@ -69,8 +71,12 @@ def mha_reference(
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k
+    q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, block_q, block_k, return_lse
 ):
+    if return_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, rest
     i, j = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -125,19 +131,31 @@ def _fwd_kernel(
         l = l_ref[...][:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp residual for the backward, lane-replicated
+            lse_ref[0, 0] = m_ref[...] + jnp.log(jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...]))
 
 
-def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
-    """q [B,H,T,D], k/v [B,KVH,T,D] -> o [B,H,T,D]."""
+def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k, return_lse=False):
+    """q [B,H,T,D], k/v [B,KVH,T,D] -> o [B,H,T,D] (and lse [B,H,T] f32)."""
     B, H, Tq, D = q.shape
     KVH, Tk = k.shape[1], k.shape[2]
     g = H // KVH
     grid = (B, H, Tq // block_q, Tk // block_k)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        return_lse=return_lse,
     )
-    return pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))]
+    if return_lse:
+        # lane-replicated [B,H,Tq,LANES]; sliced to [B,H,Tq] after the call
+        out_shape.append(jax.ShapeDtypeStruct((B, H, Tq, _LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0))
+        )
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -145,8 +163,8 @@ def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -162,6 +180,188 @@ def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
         ),
         interpret=interpret_mode(),
     )(q, k, v)
+    if return_lse:
+        o, lse_rep = out
+        return o, lse_rep[..., 0]
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (flash-2: dq gridded q-major, dk/dv kv-major)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref, dq_ref, dq_acc,
+    *, scale, causal, block_q, block_k,
+):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        run = i * block_q + block_q - 1 >= j * block_k
+    else:
+        run = jnp.bool_(True)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])  # masked entries -> exp(-inf)=0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, lse_ref, delta_ref, do_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, causal, block_q, block_k,
+):
+    j, i = pl.program_id(2), pl.program_id(3)  # kv-major: q blocks innermost
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        run = i * block_q + block_q - 1 >= j * block_k
+    else:
+        run = jnp.bool_(True)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        # contract over the q axis (axis 0 of both): p^T @ do without an
+        # explicit transpose — the MXU takes it as a dot_general directly.
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q, block_k,
+                      dlse=None):
+    """Fused backward: q/o/do [B,H,Tq,D], k/v [B,KVH,Tk,D], lse [B,H,Tq] f32.
+
+    Returns (dq, dk, dv) in the input dtypes. dk/dv are computed per q-head
+    inside the kernel and summed over the GQA group outside (an [B,H,Tk,D]
+    f32 transient — XLA fuses the group-sum with the cast). An lse cotangent
+    (ring attention) folds in as a delta shift: d lse_i/d s_ij = p_ij."""
+    B, H, Tq, D = q.shape
+    KVH, Tk = k.shape[1], k.shape[2]
+    g = H // KVH
+    nq, nk = Tq // block_q, Tk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    lse_rep = jnp.broadcast_to(lse[..., None], (B, H, Tq, _LANES))
+    delta_rep = jnp.broadcast_to(delta[..., None], (B, H, Tq, _LANES))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // g, j, 0))
+    lane_spec = pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, lane_spec, lane_spec, q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(6 * B * H * Tq * Tk * D * (0.5 if causal else 1.0)),
+            bytes_accessed=int(3 * q.size * q.dtype.itemsize),
+            transcendentals=int(B * H * Tq * Tk),
+        ),
+        interpret=interpret_mode(),
+    )(q, k, v, lse_rep, delta_rep, do)
+
+    # kv-major grid: (b, h, j, i) — note index maps see (b, h, j, i)
+    q_spec_t = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    kv_spec_t = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h // g, j, 0))
+    lane_spec_t = pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, j, i: (b, h, i, 0))
+    dkv_out_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(B, H, nk, nq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, lane_spec_t, lane_spec_t, q_spec_t],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(8 * B * H * Tq * Tk * D * (0.5 if causal else 1.0)),
+            bytes_accessed=int(4 * q.size * q.dtype.itemsize),
+            transcendentals=int(B * H * Tq * Tk),
+        ),
+        interpret=interpret_mode(),
+    )(q, k, v, lse_rep, delta_rep, do)
+
+    dk = dk_h.reshape(B, KVH, g, Tk, D).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(B, KVH, g, Tk, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -229,10 +429,12 @@ def _fwd_xla_blockwise(q, k, v, *, causal, scale, block_k):
         acc = acc * alpha[..., None] + pv
         return (acc, m_next, l_next), None
 
+    # init derived from qf so it inherits any device-varying mesh axes when
+    # called under shard_map (scan carry in/out vma types must agree)
     init = (
-        jnp.zeros((B, H, Tq, D), jnp.float32),
-        jnp.full((B, H, Tq), _NEG_INF, jnp.float32),
-        jnp.zeros((B, H, Tq), jnp.float32),
+        qf * 0.0,
+        qf[..., 0] * 0.0 + _NEG_INF,
+        qf[..., 0] * 0.0,
     )
     (acc, m, l), _ = jax.lax.scan(body, init, (kb, vb, jnp.arange(nk)))
     l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -241,8 +443,13 @@ def _fwd_xla_blockwise(q, k, v, *, causal, scale, block_k):
     return o, lse
 
 
-def _bwd_xla_blockwise(q, k, v, o, lse, do, *, causal, scale, block_k):
-    """Flash-2 backward as a scan over kv blocks. [B,H,T,D] layout."""
+def _bwd_xla_blockwise(q, k, v, o, lse, do, *, causal, scale, block_k, dlse=None):
+    """Flash-2 backward as a scan over kv blocks. [B,H,T,D] layout.
+
+    dlse: optional [B,H,Tq] cotangent for the lse output (ring attention
+    merges blocks through lse); folds in as a delta shift since
+    d lse_i / d s_ij = p_ij.
+    """
     B, H, Tq, D = q.shape
     KVH, Tk_orig = k.shape[1], k.shape[2]
     k, v, Tk = _pad_kv(k, v, block_k)
@@ -251,6 +458,8 @@ def _bwd_xla_blockwise(q, k, v, o, lse, do, *, causal, scale, block_k):
     qf = q.astype(jnp.float32).reshape(B, KVH, g, Tq, D)
     dof = do.astype(jnp.float32).reshape(B, KVH, g, Tq, D)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,Tq]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = delta.reshape(B, KVH, g, Tq)
     lse_r = lse.reshape(B, KVH, g, Tq)
     kb = jnp.moveaxis(k.astype(jnp.float32).reshape(B, KVH, nk, block_k, D), 2, 0)
@@ -276,7 +485,7 @@ def _bwd_xla_blockwise(q, k, v, o, lse, do, *, causal, scale, block_k):
         dk_j = jnp.einsum("bcgqk,bcgqd->bckd", ds, qf, preferred_element_type=jnp.float32)
         return dq_acc, (dk_j, dv_j)
 
-    dq0 = jnp.zeros((B, KVH, g, Tq, D), jnp.float32)
+    dq0 = qf * 0.0  # derived from qf: inherits vma under shard_map
     dq, (dk, dv) = jax.lax.scan(body, dq0, (kb, vb, jnp.arange(nk)))
     dk = jnp.moveaxis(dk, 0, 2).reshape(B, KVH, -1, D)[:, :, :Tk_orig]
     dv = jnp.moveaxis(dv, 0, 2).reshape(B, KVH, -1, D)[:, :, :Tk_orig]
@@ -330,29 +539,112 @@ def _flash_bhtd(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    if not _pallas_ok(q, k, block_q, block_k):
-        # Static XLA-only path: keep the lse the forward already computed.
-        bk = min(block_k, k.shape[2])
-        o, lse = _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=bk)
-        return o, (q, k, v, o, lse)
-    # Platform-dispatched path: both branches must return the same pytree,
-    # so lse is recomputed at bwd time (flash recompute strategy — on TPU
-    # the Pallas forward never materializes stats anyway).
-    o = _fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
-    return o, (q, k, v, o, None)
+    # Both branches of the dispatch return (o, lse[B,H,Tq] f32); the lse
+    # residual feeds the fused Pallas backward (no fwd recompute).
+    o, lse = _fwd_lse_dispatch(q, k, v, causal, scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
-    bk = min(block_k, k.shape[2])
-    if lse is None:
-        _, lse = _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=bk)
-    return _bwd_xla_blockwise(
-        q, k, v, o, lse, do, causal=causal, scale=scale, block_k=bk
+    if not _pallas_ok(q, k, block_q, block_k):
+        bk = min(block_k, k.shape[2])
+        return _bwd_xla_blockwise(
+            q, k, v, o, lse, do, causal=causal, scale=scale, block_k=bk
+        )
+    return platform_dispatch(
+        lambda q, k, v, o, lse, do: _flash_bwd_pallas(
+            q, k, v, o, lse, do, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        ),
+        lambda q, k, v, o, lse, do: _bwd_xla_blockwise(
+            q, k, v, o, lse, do, causal=causal, scale=scale, block_k=block_k
+        ),
+        q, k, v, o, lse, do,
     )
 
 
 _flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Stats-returning variant: (o, lse) both differentiable. Ring attention
+# merges per-block partials through lse, so its cotangent matters; it folds
+# into the same kernels as a delta shift (see _flash_bwd_pallas).
+# ---------------------------------------------------------------------------
+
+
+def _fwd_lse_dispatch(q, k, v, causal, scale, block_q, block_k):
+    if not _pallas_ok(q, k, block_q, block_k):
+        bk = min(block_k, k.shape[2])
+        return _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=bk)
+    return platform_dispatch(
+        lambda q, k, v: _flash_fwd_pallas(
+            q, k, v, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, return_lse=True,
+        ),
+        lambda q, k, v: _fwd_xla_blockwise(
+            q, k, v, causal=causal, scale=scale, block_k=block_k
+        ),
+        q, k, v,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse_bhtd(q, k, v, causal, scale, block_q, block_k):
+    return _fwd_lse_dispatch(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _fwd_lse_dispatch(q, k, v, causal, scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd_rule(causal, scale, block_q, block_k, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    if not _pallas_ok(q, k, block_q, block_k):
+        bk = min(block_k, k.shape[2])
+        return _bwd_xla_blockwise(
+            q, k, v, o, lse, do, causal=causal, scale=scale, block_k=bk, dlse=dlse
+        )
+    return platform_dispatch(
+        lambda q, k, v, o, lse, do, dlse: _flash_bwd_pallas(
+            q, k, v, o, lse, do, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, dlse=dlse,
+        ),
+        lambda q, k, v, o, lse, do, dlse: _bwd_xla_blockwise(
+            q, k, v, o, lse, do, causal=causal, scale=scale,
+            block_k=block_k, dlse=dlse,
+        ),
+        q, k, v, o, lse, do, dlse,
+    )
+
+
+_flash_lse_bhtd.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> "tuple[jax.Array, jax.Array]":
+    """Flash attention returning (o, lse).
+
+    Args as `flash_attention`; returns o [B, T, H, D] and the per-row
+    logsumexp lse [B, H, T] (f32). Both outputs are differentiable — the
+    building block for ring attention's block merges."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o, lse = _flash_lse_bhtd(qt, kt, vt, causal, scale, block_q, block_k)
+    return jnp.swapaxes(o, 1, 2), lse
 
 
 def flash_attention(

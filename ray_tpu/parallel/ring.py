@@ -4,12 +4,17 @@ Net-new relative to the reference (SURVEY.md §5.7: Ray has no
 sequence/context parallelism; long context was delegated to vLLM /
 user code). Here it is first-class: the sequence axis is a mesh axis
 ("sp"), each rank holds a sequence block, and KV blocks rotate around the
-ring via ``ppermute`` while a flash-style online softmax accumulates exact
-attention — memory per chip stays O(T/n), comms ride single-hop ICI links,
-and XLA overlaps the permute with the block matmuls.
+ring via ``ppermute`` while flash-style partials merge through logsumexp —
+memory per chip stays O(T/n), comms ride single-hop ICI links, and XLA
+overlaps the permute with the block matmuls.
 
-The blockwise compute maps onto the MXU as plain batched matmuls; a fused
-Pallas kernel for the per-block inner loop lives in ray_tpu.ops.
+Each block's attention is ``ops.attention.flash_attention_with_lse`` — the
+fused Pallas kernel on TPU (XLA blockwise elsewhere) — so the inner loop
+rides the same kernel as dense attention, forward and backward (the lse
+cotangent of the merge folds into the kernel's delta term). Under causal
+masking, blocks strictly in the future (src > my rank) are fully masked:
+a ``lax.cond`` skips their compute entirely while the ring rotation keeps
+going, so each rank does only the ~half of the work that is visible to it.
 """
 
 from __future__ import annotations
@@ -19,25 +24,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
+
+from ..ops.attention import flash_attention_with_lse
 
 _NEG_INF = -1e30
-
-
-def _block_attend(q, k, v, scale, mask):
-    """One KV block's contribution: returns (scores_max, exp_scores, pv).
-
-    q: [B, Tq, H, D]  k/v: [B, Tk, H, D]  mask: [Tq, Tk] bool (True = keep)
-    """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
-    m = jnp.max(scores, axis=-1)  # [B,H,Tq]
-    p = jnp.exp(scores - m[..., None])
-    # fully-masked rows: m == _NEG_INF -> p rows are exp(0)=1; zero them
-    valid = m > _NEG_INF / 2
-    p = p * valid[..., None]
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    return m, p.sum(axis=-1), pv
 
 
 def ring_attention_local(
@@ -54,41 +45,48 @@ def ring_attention_local(
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
-    Tk = k.shape[1]
     if scale is None:
         scale = 1.0 / (D**0.5)
-    q_pos = my_idx * Tq + jnp.arange(Tq)
+
+    # s = 0 is always the rank's own block: local causal mask, and under
+    # causal attention every row sees at least itself, so lse0 is finite —
+    # later merges never hit a -inf/-inf corner.
+    o0, lse0 = flash_attention_with_lse(q, k, v, causal=causal, scale=scale)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def attend(q, k_blk, v_blk):
+        o_blk, lse_blk = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=False, scale=scale
+        )
+        return o_blk.astype(jnp.float32), lse_blk
+
+    def skip(q, k_blk, v_blk):
+        # derived from q so both cond branches agree on device-varying axes
+        zero = q.astype(jnp.float32) * 0.0
+        return zero, zero[..., 0].transpose(0, 2, 1) + _NEG_INF
 
     def step(carry, s):
-        o, m, l, k_blk, v_blk = carry
-        src = (my_idx + s) % n  # which sequence block we currently hold
-        k_pos = src * Tk + jnp.arange(Tk)
+        o, lse, k_blk, v_blk = carry
+        # rotate first: at scan step s (1..n-1) we hold block src
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (my_idx + s) % n
         if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
+            # blocks from later ranks are fully masked — skip the kernel
+            o_blk, lse_blk = jax.lax.cond(src < my_idx, attend, skip, q, k_blk, v_blk)
         else:
-            mask = jnp.ones((Tq, Tk), dtype=bool)
-        blk_m, blk_l, blk_pv = _block_attend(q, k_blk, v_blk, scale, mask)
-        m_new = jnp.maximum(m, blk_m)
-        # guard: both -inf (nothing seen yet AND fully-masked block)
-        alpha = jnp.exp(jnp.where(m > _NEG_INF / 2, m - m_new, _NEG_INF))
-        beta = jnp.exp(jnp.where(blk_m > _NEG_INF / 2, blk_m - m_new, _NEG_INF))
-        l_new = l * alpha + blk_l * beta
-        o_new = o * alpha.transpose(0, 2, 1)[..., None] + blk_pv * beta.transpose(0, 2, 1)[..., None]
-        # rotate KV to the next rank (ring over ICI neighbours)
-        perm = [(i, (i - 1) % n) for i in range(n)]
-        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+            o_blk, lse_blk = attend(q, k_blk, v_blk)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        alpha = jnp.exp(lse - lse_new)  # [B,H,Tq]; lse finite -> no nan
+        beta = jnp.exp(lse_blk - lse_new)
+        w_a = alpha.transpose(0, 2, 1)[..., None]
+        w_b = beta.transpose(0, 2, 1)[..., None]
+        o = o * w_a + o_blk * w_b
+        return (o, lse_new, k_blk, v_blk), None
 
-    o0 = jnp.zeros_like(q)
-    # derive init carries from q so they inherit its device-varying axes
-    # (scan requires carry in/out vma types to agree under shard_map)
-    zero_bhq = q[:, :, :, 0].transpose(0, 2, 1) * 0.0
-    m0 = zero_bhq + _NEG_INF
-    l0 = zero_bhq
-    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    l = jnp.maximum(l, 1e-20)  # rows with no visible keys (shouldn't happen causally)
-    return o / l.transpose(0, 2, 1)[..., None]
+    carry = (o0.astype(jnp.float32), lse0, k, v)
+    (o, _, _, _), _ = jax.lax.scan(step, carry, jnp.arange(1, n))
+    return o.astype(q.dtype)
 
 
 def ring_attention(

@@ -78,6 +78,26 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gqa_grads_match_reference(self, kernel_mode, causal):
+        # kvh < H exercises the per-q-head dk/dv group-sum in the Pallas bwd
+        B, T, H, KVH, D = 1, 256, 4, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, T, KVH, D))
+        v = _rand(ks[2], (B, T, KVH, D))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
     @pytest.mark.parametrize("t", [200, 129])
     def test_non_multiple_seq_len(self, kernel_mode, t):
         # regression: XLA fallback must handle T in (128, 256) not divisible
