@@ -34,9 +34,20 @@ from jax.experimental.pallas import tpu as pltpu
 from .dispatch import interpret_mode, platform_dispatch, use_pallas
 
 _NEG_INF = -2.0e30
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
 _LANES = 128
+_MAX_BLOCK = 1024  # measured knee on v5e: 1024² blocks ~3.4x faster than 128²
+
+
+def _auto_block(t: int) -> int:
+    """Largest power-of-two block <= _MAX_BLOCK dividing t (>=128 floor).
+
+    Bigger tiles amortize Mosaic per-program overhead and keep the MXU fed;
+    measured on v5e (B8 S2048 H12 D128): fwd 9.3->3.8ms, fwd+bwd
+    18.3->5.4ms going from 128^2 to 1024^2 blocks."""
+    b = _MAX_BLOCK
+    while b > 128 and t % b:
+        b //= 2
+    return b
 
 
 def mha_reference(
@@ -513,11 +524,19 @@ def _pallas_ok(q_bhtd, k_bhtd, block_q, block_k) -> bool:
     )
 
 
+
+def _xla_bk(block_k: int, k) -> int:
+    """Block size for the XLA fallback paths. Big tiles only help the Pallas
+    kernels (amortizing Mosaic per-program overhead); the XLA scan's temps
+    scale with block_k, so a 1024 auto-block would 8x its peak memory. Cap
+    at the historical 128."""
+    return min(block_k, 128, k.shape[2])
+
 def _fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
     """Pallas kernel when lowering for TPU and shapes tile; XLA otherwise."""
     if not _pallas_ok(q, k, block_q, block_k):
         o, _ = _fwd_xla_blockwise(
-            q, k, v, causal=causal, scale=scale, block_k=min(block_k, k.shape[2])
+            q, k, v, causal=causal, scale=scale, block_k=_xla_bk(block_k, k)
         )
         return o
     return platform_dispatch(
@@ -525,7 +544,7 @@ def _fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
             q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
         ),
         lambda q, k, v: _fwd_xla_blockwise(
-            q, k, v, causal=causal, scale=scale, block_k=block_k
+            q, k, v, causal=causal, scale=scale, block_k=_xla_bk(block_k, k)
         )[0],
         q,
         k,
@@ -548,7 +567,7 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
     if not _pallas_ok(q, k, block_q, block_k):
-        bk = min(block_k, k.shape[2])
+        bk = _xla_bk(block_k, k)
         return _bwd_xla_blockwise(
             q, k, v, o, lse, do, causal=causal, scale=scale, block_k=bk
         )
@@ -558,7 +577,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
             block_q=block_q, block_k=block_k,
         ),
         lambda q, k, v, o, lse, do: _bwd_xla_blockwise(
-            q, k, v, o, lse, do, causal=causal, scale=scale, block_k=block_k
+            q, k, v, o, lse, do, causal=causal, scale=scale,
+            block_k=_xla_bk(block_k, k)
         ),
         q, k, v, o, lse, do,
     )
@@ -576,7 +596,7 @@ _flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def _fwd_lse_dispatch(q, k, v, causal, scale, block_q, block_k):
     if not _pallas_ok(q, k, block_q, block_k):
-        bk = min(block_k, k.shape[2])
+        bk = _xla_bk(block_k, k)
         return _fwd_xla_blockwise(q, k, v, causal=causal, scale=scale, block_k=bk)
     return platform_dispatch(
         lambda q, k, v: _flash_fwd_pallas(
@@ -584,7 +604,7 @@ def _fwd_lse_dispatch(q, k, v, causal, scale, block_q, block_k):
             block_q=block_q, block_k=block_k, return_lse=True,
         ),
         lambda q, k, v: _fwd_xla_blockwise(
-            q, k, v, causal=causal, scale=scale, block_k=block_k
+            q, k, v, causal=causal, scale=scale, block_k=_xla_bk(block_k, k)
         ),
         q, k, v,
     )
@@ -604,7 +624,7 @@ def _flash_lse_bwd_rule(causal, scale, block_q, block_k, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     if not _pallas_ok(q, k, block_q, block_k):
-        bk = min(block_k, k.shape[2])
+        bk = _xla_bk(block_k, k)
         return _bwd_xla_blockwise(
             q, k, v, o, lse, do, causal=causal, scale=scale, block_k=bk, dlse=dlse
         )
@@ -615,7 +635,7 @@ def _flash_lse_bwd_rule(causal, scale, block_q, block_k, res, cts):
         ),
         lambda q, k, v, o, lse, do, dlse: _bwd_xla_blockwise(
             q, k, v, o, lse, do, causal=causal, scale=scale,
-            block_k=block_k, dlse=dlse,
+            block_k=_xla_bk(block_k, k), dlse=dlse,
         ),
         q, k, v, o, lse, do, dlse,
     )
@@ -630,8 +650,8 @@ def flash_attention_with_lse(
     v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> "tuple[jax.Array, jax.Array]":
     """Flash attention returning (o, lse).
 
@@ -640,6 +660,8 @@ def flash_attention_with_lse(
     building block for ring attention's block merges."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    block_q = block_q or _auto_block(q.shape[1])
+    block_k = block_k or _auto_block(k.shape[1])
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -653,8 +675,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Multi-head / grouped-query flash attention.
 
@@ -662,10 +684,14 @@ def flash_attention(
       q: [B, T, H, D]; k, v: [B, T, KVH, D] with H % KVH == 0 (GQA).
       causal: apply causal mask.
       scale: score scale, default 1/sqrt(D).
+      block_q/block_k: kernel tile sizes; default picks the largest
+        power-of-two <=1024 dividing each sequence length.
     Returns [B, T, H, D] in q's dtype.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    block_q = block_q or _auto_block(q.shape[1])
+    block_k = block_k or _auto_block(k.shape[1])
     qt = jnp.swapaxes(q, 1, 2)  # [B,H,T,D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
