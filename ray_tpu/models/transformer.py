@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from ..ops import apply_rope, flash_attention, layer_norm, rms_norm, rope_frequencies
 from ..parallel.moe import top_k_gating
-from ..parallel.sharding import constrain
+from ..parallel.sharding import _current_mesh, constrain
 from .config import ModelConfig
 
 Params = Dict[str, Any]
@@ -260,6 +260,28 @@ def _block(x, lp, cfg, rope_tables, positions, mesh=None):
 # ---------------------------------------------------------------------------
 
 
+def _embed_lookup(table: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
+    """Embedding lookup, mesh-aware.
+
+    When the active mesh shards the table (tp on vocab / fsdp on embed),
+    a plain gather forces GSPMD into an "involuntary full
+    rematerialization" — the table-propagated sharding on the gather
+    output cannot be resharded to the batch-sharded activation layout
+    efficiently. The one-hot matmul form partitions cleanly (it is just a
+    dot, which GSPMD knows how to shard on both operands), keeps the
+    lookup on the MXU, and makes the backward a matmul instead of a
+    scatter-add. On unsharded meshes the gather is cheaper — keep it."""
+    mesh = _current_mesh()
+    # vocab->tp, embed->fsdp are the only rules that shard the table
+    table_sharded = mesh is not None and any(
+        mesh.shape.get(a, 1) > 1 for a in ("tp", "fsdp")
+    )
+    if not table_sharded:
+        return table[tokens].astype(dtype)
+    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=dtype)
+    return jnp.einsum("btv,vd->btd", onehot, table.astype(dtype))
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -269,7 +291,7 @@ def forward(
     """tokens [B, T] -> (logits [B, T, V] f32, aux_loss scalar)."""
     dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(dtype)  # [B,T,D]
+    x = _embed_lookup(params["embed"], tokens, dtype)  # [B,T,D]
     if cfg.positional == "learned":
         pos = positions if positions is not None else jnp.arange(T)[None, :]
         x = x + params["pos_emb"][pos].astype(dtype)
@@ -361,7 +383,7 @@ def decode_step(
     this token). Returns (logits [B,V] f32, new_cache)."""
     dtype = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
-    x = params["embed"][tokens][:, None].astype(dtype)  # [B,1,D]
+    x = _embed_lookup(params["embed"], tokens[:, None], dtype)  # [B,1,D]
     if cfg.positional == "learned":
         x = x + params["pos_emb"][positions][:, None].astype(dtype)
         rope_tables = None
@@ -416,7 +438,7 @@ def prefill(
     """
     dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(dtype)
+    x = _embed_lookup(params["embed"], tokens, dtype)
     if cfg.positional == "learned":
         x = x + params["pos_emb"][jnp.arange(T)][None].astype(dtype)
         rope_tables = None
