@@ -1,22 +1,24 @@
-"""Driver benchmark: flagship LM training throughput on the local TPU.
+"""Driver benchmark: three workloads on the local TPU (BASELINE.md plan).
 
 Prints one JSON line per metric: {"metric", "value", "unit", "vs_baseline"}.
-The first/primary line is the train throughput, measured with per-step
-dispatch — the same methodology as the recorded anchor, so vs_baseline is
-apples-to-apples. A second line reports the scanned-dispatch number
-(RAY_TPU_BENCH_SCAN steps per jit call, donated carry), which is what a
-production train loop would see: the axon dev tunnel costs ~100ms per
-dispatch that real deployments don't pay.
 
-Workload: llama-600m (Llama-3 family, head_dim 128 so the Pallas flash
-path is exercised) full train step (fwd+bwd+adamw, bf16 compute / f32
-state) on one chip. vs_baseline is measured tokens/s over the recorded
-baseline in BASELINE.json ("bench_anchor") — the round-1 measurement
-anchors it; later rounds must beat it.
+1. train — flagship LM (llama-600m: Llama-3 family, head_dim 128 so the
+   Pallas flash path is exercised) full train step (fwd+bwd+adamw, bf16
+   compute / f32 state). Primary line uses per-step dispatch — the anchor
+   methodology, apples-to-apples vs_baseline; a second "scanned" line uses
+   RAY_TPU_BENCH_SCAN steps per jit call (what a production loop sees; the
+   axon dev tunnel costs ~100ms/dispatch that real deployments don't pay).
+2. serve — continuous-batched inference on the same model: req/s, p50
+   TTFT, decode tok/s (BASELINE.md row 6).
+3. data — input-pipeline stall % against a simulated accelerator step
+   (BASELINE.md row 4's metric).
+
+vs_baseline divides by the matching anchor in BASELINE.json ("bench_anchor"
+for train, "serve_anchor"/"data_anchor" for the rest); missing anchor -> 1.0.
 
 Env knobs: RAY_TPU_BENCH_MODEL, RAY_TPU_BENCH_BATCH, RAY_TPU_BENCH_SEQ,
-RAY_TPU_BENCH_STEPS, RAY_TPU_BENCH_SCAN (steps per dispatch for the
-second metric; 0 disables it).
+RAY_TPU_BENCH_STEPS, RAY_TPU_BENCH_SCAN (0 disables the scanned metric),
+RAY_TPU_BENCH_SUITE (comma list of train,serve,data; default all).
 """
 
 from __future__ import annotations
@@ -27,16 +29,148 @@ import sys
 import time
 
 
-def _load_anchor() -> float:
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _anchors() -> dict:
     try:
         with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
-            data = json.load(f)
-        return float(data.get("bench_anchor", {}).get("value", 0.0))
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _load_anchor(key: str = "bench_anchor") -> float:
+    try:
+        return float(_anchors().get(key, {}).get("value", 0.0))
     except Exception:
         return 0.0
 
 
-def main() -> None:
+def _emit(metric: str, value: float, unit: str, anchor_key: str,
+          lower_is_better: bool = False) -> None:
+    anchor = _load_anchor(anchor_key)
+    if anchor > 0:
+        vs = anchor / value if lower_is_better else value / anchor
+    else:
+        vs = 1.0
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 4),
+        "unit": unit,
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+def bench_serve(model: str) -> None:
+    """Continuous-batched inference: req/s, p50 TTFT, decode tok/s."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config(model)
+    ecfg = EngineConfig(max_batch_size=8, max_seq_len=512)
+    engine = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg, ecfg)
+    rng = np.random.default_rng(0)
+    prompt_len, max_tokens, n_req = 128, 64, 24
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len)) for _ in range(n_req)]
+    # warmup with a full-length prompt so the timed run hits only
+    # already-compiled prefill buckets and the decode step
+    engine.generate(prompts[0], max_tokens=4)
+
+    results: list = [None] * n_req
+    errors: list = [None] * n_req
+
+    def worker(i):
+        try:
+            results[i] = engine.generate(prompts[i], max_tokens=max_tokens)
+        except Exception as e:  # noqa: BLE001 — surfaced after join
+            errors[i] = e
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_req)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    engine.stop()
+    failed = [e for e in errors if e is not None]
+    if failed:
+        raise RuntimeError(f"{len(failed)}/{n_req} serve requests failed: {failed[0]!r}")
+
+    ttfts = sorted(float(r["ttft_s"]) for r in results)
+    total_toks = sum(len(r["token_ids"]) for r in results)
+    p50_ttft = ttfts[len(ttfts) // 2]
+    # steady-state decode rate: tokens after the first, over the time spent
+    # decoding them (per request; continuous batching shares the chip)
+    decode_rates = [
+        (len(r["token_ids"]) - 1) / max(r["latency_s"] - r["ttft_s"], 1e-6)
+        for r in results
+        if len(r["token_ids"]) > 1
+    ]
+    mean_decode = sum(decode_rates) / max(len(decode_rates), 1)
+    print(
+        f"# serve: model={model} n_req={n_req} prompt={prompt_len} "
+        f"max_tokens={max_tokens} wall={wall:.2f}s",
+        file=sys.stderr,
+    )
+    mname = model.replace("-", "_")
+    _emit(f"serve_req_per_s_{mname}", n_req / wall, "req/s", "serve_anchor")
+    _emit(f"serve_p50_ttft_{mname}", p50_ttft, "s", "serve_ttft_anchor",
+          lower_is_better=True)
+    # end-to-end output-token throughput (prefill + queueing included)
+    _emit(f"serve_output_tok_per_s_{mname}", total_toks / wall, "tokens/s",
+          "serve_output_anchor")
+    _emit(f"serve_decode_tok_per_s_per_req_{mname}", mean_decode, "tokens/s",
+          "serve_decode_anchor")
+
+
+def bench_data() -> None:
+    """Input-pipeline stall %: fraction of a simulated accelerator step
+    loop spent waiting on the next batch (streaming executor + prefetch)."""
+    import numpy as np
+
+    from ray_tpu import data as rd
+
+    n_rows, batch_size, step_s = 1_600_000, 4096, 0.010
+
+    def transform(batch):
+        x = batch["id"].astype(np.float32)
+        return {"x": np.sqrt(x + 1.0), "y": x * 0.5}
+
+    ds = rd.range(n_rows, parallelism=32).map_batches(transform)
+    it = ds.iter_batches(batch_size=batch_size)
+    # prime the pipeline with the first batch (startup, not steady-state)
+    next(it)
+    wait, steps, t_loop = 0.0, 0, time.perf_counter()
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        wait += time.perf_counter() - t0
+        assert len(batch["x"]) > 0
+        steps += 1
+        time.sleep(step_s)  # simulated accelerator step
+    total = time.perf_counter() - t_loop
+    stall_pct = 100.0 * wait / total if total > 0 else 0.0
+    print(
+        f"# data: rows={n_rows} batches={steps} total={total:.2f}s "
+        f"wait={wait:.3f}s",
+        file=sys.stderr,
+    )
+    _emit("data_pipeline_stall_pct", stall_pct, "%", "data_anchor",
+          lower_is_better=True)
+
+
+def bench_train() -> None:
     import jax
     import jax.numpy as jnp  # noqa: F401
 
@@ -70,8 +204,6 @@ def main() -> None:
     attn_flops = 12 * cfg.n_layers * cfg.hdim * cfg.n_heads * seq  # per token
     flops_per_token = 6 * n_params + attn_flops
     peak = 197e12 if jax.default_backend() == "tpu" else 1e12  # v5e bf16 peak
-    anchor = _load_anchor()
-
     def report(tag, tokens_per_sec, dt, loss):
         mfu = tokens_per_sec * flops_per_token / (n_dev * peak)
         print(
@@ -79,12 +211,7 @@ def main() -> None:
             f"batch={batch} seq={seq} dt={dt:.2f}s loss={loss:.3f} mfu={mfu:.2%}",
             file=sys.stderr,
         )
-        print(json.dumps({
-            "metric": tag,
-            "value": round(tokens_per_sec, 1),
-            "unit": "tokens/s",
-            "vs_baseline": round(tokens_per_sec / anchor, 3) if anchor > 0 else 1.0,
-        }))
+        _emit(tag, tokens_per_sec, "tokens/s", "bench_anchor")
 
     mname = model.replace("-", "_")
     with mesh:
@@ -126,6 +253,18 @@ def main() -> None:
                 f"train_tokens_per_sec_{mname}_scanned",
                 batch * seq * n_spans * span / dt, dt, loss,
             )
+
+
+def main() -> None:
+    suite = os.environ.get("RAY_TPU_BENCH_SUITE", "train,serve,data")
+    wanted = {s.strip() for s in suite.split(",") if s.strip()}
+    model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
+    if "train" in wanted:
+        bench_train()
+    if "serve" in wanted:
+        bench_serve(model)
+    if "data" in wanted:
+        bench_data()
 
 
 if __name__ == "__main__":
