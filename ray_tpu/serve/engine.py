@@ -57,6 +57,13 @@ class EngineConfig:
     prefill_buckets: tuple = (64, 128, 256, 512, 1024)
     eos_token_id: Optional[int] = None
     cache_dtype: str = "bfloat16"
+    # Decode steps per device dispatch (vLLM multi-step scheduling
+    # analogue): sampling stays on device and K tokens come back per
+    # round-trip, amortizing dispatch/readback latency. Tokens stream in
+    # bursts of K and waiting prefills join between spans; K is clamped to
+    # the smallest remaining token budget among active slots. 1 = classic
+    # per-token stepping.
+    decode_span: int = 4
 
     @property
     def pages_per_seq(self) -> int:
@@ -184,6 +191,10 @@ class InferenceEngine:
     # ------------------------------------------------------------- compiled
 
     def _build_decode(self):
+        """Jit a K-step decode: lax.scan over the single-step body with
+        device-side sampling feeding the next step. One dispatch + one
+        [K,B] readback per span. Cached per K (K varies only near request
+        completion)."""
         cfg, ecfg = self.cfg, self.ecfg
         ps = ecfg.page_size
         force_xla = self._tp > 1  # pallas_call cannot partition under GSPMD
@@ -250,7 +261,32 @@ class InferenceEngine:
             toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
             return toks, new_k, new_v
 
-        return jax.jit(decode, donate_argnums=(1, 2))
+        def decode_span(params, k_pages, v_pages, tokens, positions,
+                        page_tables, temps, key, n_steps):
+            def sub(carry, i):
+                toks_in, pos, kp, vp = carry
+                ki = jax.random.fold_in(key, i)
+                toks, kp, vp = decode(
+                    params, kp, vp, toks_in, pos, page_tables, temps, ki
+                )
+                return (toks, pos + 1, kp, vp), toks
+
+            (_, _, kp, vp), seq = jax.lax.scan(
+                sub, (tokens, positions, k_pages, v_pages), jnp.arange(n_steps)
+            )
+            return seq, kp, vp  # seq [n_steps, B]
+
+        cache: Dict[int, Any] = {}
+
+        def for_span(n_steps: int):
+            if n_steps not in cache:
+                cache[n_steps] = jax.jit(
+                    functools.partial(decode_span, n_steps=n_steps),
+                    donate_argnums=(1, 2),
+                )
+            return cache[n_steps]
+
+        return for_span
 
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill_cache:
@@ -419,8 +455,14 @@ class InferenceEngine:
     # ------------------------------------------------------------- stepping
 
     def step(self) -> bool:
-        """One engine iteration: install finished prefills, then one decode
-        step for the whole active batch. Returns True if work happened."""
+        """One engine iteration: install finished prefills, then a K-step
+        decode span for the whole active batch (K = decode_span, fixed, so
+        exactly one decode program ever compiles). A slot that finishes
+        mid-span keeps decoding to span end; its extra tokens are discarded
+        by the host loop, and its extra KV writes are harmless — table
+        entries past the allocated pages are 0 (the reserved trash page),
+        and page frees happen on the host only after this span's readback,
+        so no recycled page can be written. Returns True if work happened."""
         installed = self._install_ready()
         active = self._active()
         if not active:
@@ -439,26 +481,28 @@ class InferenceEngine:
             positions[i] = s.position
             tables[i, : len(s.pages)] = s.pages
             temps[i] = s.request.temperature
+        span = max(1, self.ecfg.decode_span)
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
-        toks, self.k_pages, self.v_pages = self._decode(
+        seq, self.k_pages, self.v_pages = self._decode(span)(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(temps), key,
         )
-        toks = np.asarray(toks)  # the per-step readback
-        for i, s in enumerate(self.slots):
-            if s.request is None:
-                continue
-            s.position += 1
-            tok = int(toks[i])
-            if s.generated < s.request.max_tokens and not s.request.done.is_set():
-                s.request.output.append(tok)
-                s.generated += 1
-                eos = self.ecfg.eos_token_id
-                if eos is None or tok != eos:  # eos is control, not content
-                    s.request._emit(tok)
-            self._maybe_finish(s, tok)
+        seq = np.asarray(seq)  # [span, B] — one readback per span
+        for t in range(span):
+            for i, s in enumerate(self.slots):
+                if s.request is None:
+                    continue  # finished earlier in this span (or empty slot)
+                s.position += 1
+                tok = int(seq[t, i])
+                if s.generated < s.request.max_tokens and not s.request.done.is_set():
+                    s.request.output.append(tok)
+                    s.generated += 1
+                    eos = self.ecfg.eos_token_id
+                    if eos is None or tok != eos:  # eos is control, not content
+                        s.request._emit(tok)
+                self._maybe_finish(s, tok)
         return True
 
     def _maybe_finish(self, slot: _Slot, last_tok: int) -> None:
