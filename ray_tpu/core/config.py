@@ -60,11 +60,11 @@ def declare(name: str, default: Any, doc: str = "") -> None:
 
 # Core / scheduling
 declare(
-    "worker_processes", 0,
+    "worker_processes", max(2, min(8, (os.cpu_count() or 2) // 2)),
     "CPU-only tasks execute in this many spawned worker processes sharing a "
     "shm object arena (crash isolation, like the reference's worker pool); "
     "0 = execute on the node agent's threads. Device tasks always stay on "
-    "threads in the device-owning process.",
+    "threads in the device-owning process. Default derives from host CPUs.",
 )
 declare("task_max_retries", 3, "Default retries for tasks on worker/node death.")
 declare("actor_max_restarts", 0, "Default actor restarts on failure.")

@@ -355,7 +355,10 @@ class Runtime:
             self._put_index += 1
             oid = ObjectID.for_put(self._driver_task_id, self._put_index)
         agent = self.driver_agent
-        agent.store.put(oid, value)
+        from .object_store import seal_value
+
+        # aliasing-safe: the caller may keep mutating `value` after put()
+        agent.store.put(oid, seal_value(value))
         self.directory.add_location(oid, agent.node_id)
         fut = _Future()
         fut.event.set()

@@ -28,13 +28,17 @@ from .control_plane import ControlPlane, NodeInfo
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from .logging import get_logger
 from .metrics import Counter, Gauge
-from .object_store import MemoryObjectStore, ObjectLostError
+from .object_store import MemoryObjectStore, ObjectLostError, seal_value
 from .task_spec import TaskKind, TaskSpec
 
 logger = get_logger("node_agent")
 
 _tasks_counter = Counter("ray_tpu_tasks_finished", "Tasks finished by outcome")
 _running_gauge = Gauge("ray_tpu_tasks_running", "Tasks currently executing")
+_pool_fallback_counter = Counter(
+    "ray_tpu_pool_fallbacks",
+    "CPU tasks that bypassed process isolation (unpicklable args/closure)",
+)
 
 
 class WorkerCrashedError(RuntimeError):
@@ -238,7 +242,7 @@ class NodeAgent:
         _running_gauge.add(1, {"node": self.node_id.hex()[:8]})
         try:
             args, kwargs = self._materialize_args(spec)
-            values = list(self._call_user_function(spec, None, args, kwargs, kill_event))
+            values = self._call_user_function(spec, None, args, kwargs, kill_event)
             self._seal_returns(spec, values)
             _tasks_counter.inc(tags={"outcome": "ok"})
             return TaskResult(spec.task_id, ok=True, values=values)
@@ -294,8 +298,16 @@ class NodeAgent:
             pool = self._ensure_pool()
             if pool is not None:
                 try:
-                    return pool.run(func, tuple(args), dict(kwargs))
+                    # sealed=True hands back the worker's pickled payload as
+                    # SealedBytes without deserializing in this process —
+                    # _seal_returns stores it as-is (single-return tasks;
+                    # multi-return needs the tuple split, so it deserializes)
+                    return pool.run(
+                        func, tuple(args), dict(kwargs),
+                        sealed=spec.options.num_returns == 1,
+                    )
                 except TaskNotSerializableError:
+                    _pool_fallback_counter.inc(tags={"task": spec.name[:40]})
                     logger.debug(
                         "task %s not serializable; executing in-process",
                         spec.name,
@@ -341,8 +353,15 @@ class NodeAgent:
         return args, kwargs
 
     def _seal_returns(self, spec: TaskSpec, values: List[Any]) -> None:
+        """Publish return values to the object plane, sealed.
+
+        seal_value pickles host objects (SealedBytes) so the stored form can
+        never alias live state the producer keeps mutating, and every get()
+        deserializes a private copy — the serialization boundary the
+        reference gets by construction from worker processes + plasma.
+        jax.Array trees and already-sealed pool payloads pass through."""
         for oid, value in zip(spec.return_ids, values):
-            self.store.put(oid, value)
+            self.store.put(oid, seal_value(value, spec.name))
             self._directory.add_location(oid, self.node_id)
 
     # ---------------------------------------------------------------- actors
@@ -399,7 +418,9 @@ class NodeAgent:
             self._running[spec.task_id] = kill_event
         try:
             args, kwargs = self._materialize_args(spec)
-            values = self._call_user_function(spec, runner.instance, args, kwargs, kill_event)
+            values = self._call_user_function(
+                spec, runner.instance, args, kwargs, kill_event
+            )
             self._seal_returns(spec, values)
             _tasks_counter.inc(tags={"outcome": "ok"})
             done(TaskResult(spec.task_id, ok=True, values=values))
@@ -447,7 +468,9 @@ class NodeAgent:
             holder = self._directory.locate(object_id, exclude=self.node_id)
             if holder is not None:
                 try:
-                    value = holder.store.get(object_id, timeout=5.0)
+                    # raw: a SealedBytes stays sealed across the hop, so the
+                    # fresh-copy-per-get guarantee survives multi-node paths
+                    value = holder.store.get_raw(object_id, timeout=5.0)
                     self.store.put(object_id, value)
                     self._directory.add_location(object_id, self.node_id)
                     on_ready()

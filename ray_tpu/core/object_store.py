@@ -35,6 +35,71 @@ class ObjectStoreFullError(RuntimeError):
     pass
 
 
+class SealedBytes:
+    """A pickled payload sealed into the store. Every ``get`` deserializes a
+    fresh object, so no consumer can alias the producer's live object or
+    another consumer's copy — the serialization boundary the reference
+    enforces by construction with worker processes + plasma. Large array
+    buffers ride out-of-band (pickle protocol 5): the store keeps ONE
+    immutable bytes copy and each ``get`` reconstructs arrays as zero-copy
+    read-only views over it — plasma's shared-read semantics."""
+
+    __slots__ = ("payload", "buffers")
+
+    def __init__(self, payload: bytes, buffers=()):
+        self.payload = payload
+        self.buffers = tuple(buffers)
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.payload) + sum(len(b) for b in self.buffers)
+
+    def load(self) -> Any:
+        if self.buffers:
+            return pickle.loads(self.payload, buffers=self.buffers)
+        return pickle.loads(self.payload)
+
+
+def _has_device_leaves(value: Any) -> bool:
+    """True if the value's pytree contains jax.Arrays (checked lazily — if
+    jax was never imported, there can be none)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return any(isinstance(l, jax.Array) for l in jax.tree.leaves(value))
+    except Exception:
+        return True  # exotic tree: don't risk serializing
+
+
+def seal_value(value: Any, name: str = "<put>") -> Any:
+    """Wrap a value for aliasing-safe storage (see SealedBytes).
+
+    Already-sealed payloads and immutable scalars pass through; jax.Array
+    trees pass through (immutable, and pickling would drag device buffers
+    through the host — plasma-style zero-copy sharing is exactly right for
+    them); unpicklable values are stored live as a documented fallback."""
+    if value is None or isinstance(
+        value, (bool, int, float, str, bytes, SealedBytes)
+    ):
+        return value
+    if _has_device_leaves(value):
+        return value
+    import cloudpickle
+
+    buffers: list = []
+    try:
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
+        return SealedBytes(payload, [bytes(b.raw()) for b in buffers])
+    except Exception:
+        logger.debug("value from %s not picklable; stored live", name)
+        return value
+
+
 class ObjectLostError(RuntimeError):
     def __init__(self, object_id: ObjectID, reason: str = "object lost"):
         super().__init__(f"{reason}: {object_id}")
@@ -141,6 +206,15 @@ class MemoryObjectStore:
             return object_id in self._entries
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        value = self.get_raw(object_id, timeout)
+        if isinstance(value, SealedBytes):
+            return value.load()  # fresh object per consumer
+        return value
+
+    def get_raw(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        """get() without unwrapping SealedBytes — for store-to-store
+        transfer, which must preserve the sealed form so the guarantee
+        survives node hops."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while object_id not in self._entries:
@@ -152,11 +226,11 @@ class MemoryObjectStore:
             self._entries.move_to_end(object_id)  # LRU touch
             value = entry.value
             path = entry.spilled_path
-        if value is not None or path is None:
-            return value
-        # restore from disk OUTSIDE the lock
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        if value is None and path is not None:
+            # restore from disk OUTSIDE the lock
+            with open(path, "rb") as f:
+                value = pickle.load(f)
+        return value
 
     def on_available(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
         """Invoke callback once the object is sealed (immediately if already)."""

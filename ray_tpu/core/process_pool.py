@@ -151,6 +151,31 @@ def _load(store, payload: bytes, buffer_ids: List[bytes], inline: Optional[bytes
             store.release(bid)
 
 
+def _load_sealed(store, payload: bytes, buffer_ids: List[bytes],
+                 inline: Optional[bytes]):
+    """Like _load, but hands back a store-ready SealedBytes instead of
+    deserializing: the object store gives each consumer a private copy at
+    get() time, so deserializing here would only add a redundant
+    pickle round-trip. Out-of-band shm buffers are copied out once."""
+    from .object_store import SealedBytes
+
+    if inline is not None:
+        return SealedBytes(inline)
+    pinned: List[bytes] = []
+    try:
+        bufs = []
+        for bid in buffer_ids:
+            view = store.get_view(bid)
+            if view is None:
+                raise WorkerProcessCrash(f"shm buffer {bid.hex()[:8]} missing")
+            pinned.append(bid)
+            bufs.append(bytes(view))
+        return SealedBytes(payload, bufs)
+    finally:
+        for bid in pinned:
+            store.release(bid)
+
+
 def _cleanup_buffers(store, buffer_ids: List[bytes]) -> None:
     for bid in buffer_ids:
         try:
@@ -260,10 +285,13 @@ class ProcessPool:
 
     # ------------------------------------------------------------------ api
 
-    def run(self, fn: Callable, args: tuple, kwargs: dict, timeout: Optional[float] = None) -> Any:
+    def run(self, fn: Callable, args: tuple, kwargs: dict,
+            timeout: Optional[float] = None, sealed: bool = False) -> Any:
         """Execute fn(*args, **kwargs) in a worker process; blocks the calling
         thread. Raises WorkerProcessCrash if the worker dies, or the task's
-        own exception."""
+        own exception. sealed=True returns the worker's pickled result as a
+        store-ready SealedBytes without deserializing it in this process
+        (the caller's store hands each consumer a private copy on get)."""
         done = threading.Event()
         box: List[Any] = [None, None]  # (ok, value_or_error)
 
@@ -278,7 +306,7 @@ class ProcessPool:
         with self._submit_lock:
             if self._closed.is_set():
                 raise WorkerProcessCrash("process pool is closed")
-            self._tasks.put((fn, args, kwargs, complete))
+            self._tasks.put((fn, args, kwargs, complete, sealed))
         if not done.wait(timeout):
             raise TimeoutError("process-pool task timed out")
         if box[0]:
@@ -335,7 +363,7 @@ class ProcessPool:
             item = self._tasks.get()
             if item is None:
                 break
-            fn, args, kwargs, complete = item
+            fn, args, kwargs, complete, sealed = item
             if worker is None or not worker.proc.is_alive():
                 worker = self._spawn()
             tag = uuid.uuid4().hex
@@ -382,9 +410,10 @@ class ProcessPool:
                 worker = None
                 continue
             try:
-                if ok:
-                    value = _load(self.store, r_payload, r_bufs, r_inline)
-                    complete(True, value)
+                if ok and sealed:
+                    complete(True, _load_sealed(self.store, r_payload, r_bufs, r_inline))
+                elif ok:
+                    complete(True, _load(self.store, r_payload, r_bufs, r_inline))
                 else:
                     complete(False, pickle.loads(r_payload))
             except Exception as e:

@@ -1,6 +1,7 @@
 """Tests for the task/actor runtime: the reference's core API surface
 (SURVEY.md §3.2/§3.3 call stacks) exercised through ray_tpu."""
 
+import os
 import time
 
 import numpy as np
@@ -58,17 +59,29 @@ class TestTasks:
         assert isinstance(e.value.cause, ValueError)
 
     def test_retry_exceptions(self, ray_start_regular):
-        state = {"n": 0}
+        # attempt counter lives in a file: worker processes don't share
+        # closure state across attempts (serialization boundary by design)
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".cnt", delete=False) as f:
+            path = f.name
 
         @ray_tpu.remote(retry_exceptions=True, max_retries=3)
         def flaky():
-            state["n"] += 1
-            if state["n"] < 3:
+            with open(path, "a") as fh:
+                fh.write("x")
+            with open(path) as fh:
+                n = len(fh.read())
+            if n < 3:
                 raise RuntimeError("transient")
             return "ok"
 
-        assert ray_tpu.get(flaky.remote()) == "ok"
-        assert state["n"] == 3
+        try:
+            assert ray_tpu.get(flaky.remote()) == "ok"
+            with open(path) as fh:
+                assert len(fh.read()) == 3
+        finally:
+            os.unlink(path)
 
     def test_put_get(self, ray_start_regular):
         arr = np.arange(100)

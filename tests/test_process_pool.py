@@ -213,3 +213,62 @@ class TestRuntimeIntegration:
             return ("locked", lock.locked())
 
         assert ray_tpu.get(uses_lock.remote()) == ("locked", False)
+
+
+class TestSerializationBoundary:
+    """Copy-on-seal + fresh-copy-per-get: the aliasing holes the reference
+    closes by construction (worker processes + plasma) must be closed on
+    every execution path, including in-process fallbacks (VERDICT r2 #4)."""
+
+    @pytest.fixture
+    def proc_runtime(self):
+        rt = ray_tpu.init(
+            num_cpus=4, num_tpus=0, system_config={"worker_processes": 2}
+        )
+        yield rt
+        ray_tpu.shutdown()
+
+    def test_consumer_mutation_does_not_corrupt_store(self, proc_runtime):
+        @ray_tpu.remote
+        def make():
+            return {"xs": [1, 2, 3]}
+
+        ref = make.remote()
+        first = ray_tpu.get(ref)
+        first["xs"].append(99)  # consumer mutates its private copy
+        assert ray_tpu.get(ref) == {"xs": [1, 2, 3]}
+
+    def test_producer_mutation_does_not_corrupt_store(self, proc_runtime):
+        # force the in-process path (a lock is unpicklable) so the producer
+        # keeps a live reference to the returned object after sealing
+        lock = threading.Lock()
+        kept = {}
+
+        @ray_tpu.remote
+        def produce():
+            assert lock is not None
+            out = {"xs": [1, 2, 3]}
+            kept["out"] = out
+            return out
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref) == {"xs": [1, 2, 3]}
+        kept["out"]["xs"].append(99)  # producer mutates after seal
+        assert ray_tpu.get(ref) == {"xs": [1, 2, 3]}
+
+    def test_put_then_mutate_does_not_corrupt_store(self, proc_runtime):
+        value = {"xs": [1, 2, 3]}
+        ref = ray_tpu.put(value)
+        value["xs"].append(99)  # owner mutates after put
+        assert ray_tpu.get(ref) == {"xs": [1, 2, 3]}
+
+    def test_mutated_task_arg_does_not_corrupt_owner_copy(self, proc_runtime):
+        ref = ray_tpu.put({"xs": [1, 2, 3]})
+
+        @ray_tpu.remote
+        def mutate(d):
+            d["xs"].append(99)  # task mutates its received copy
+            return len(d["xs"])
+
+        assert ray_tpu.get(mutate.remote(ref)) == 4
+        assert ray_tpu.get(ref) == {"xs": [1, 2, 3]}
