@@ -60,12 +60,18 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = True,
+    resume_from: Optional[str] = None,
     _existing_runtime: Optional[Runtime] = None,
 ) -> Runtime:
     """Start (or attach to) the runtime with one local node.
 
     On a real TPU host this discovers local devices and advertises them as
     TPU resources with topology labels (see ray_tpu.sched.topology).
+
+    resume_from: path to a control-plane snapshot (see
+    ``system_config={"control_plane_snapshot_path": ...}``); restores the
+    KV/job tables and re-creates named actors from their pickled specs
+    (`ray_tpu.core.persistence` documents the restore policy).
     """
     if _cw.runtime_initialized():
         if ignore_reinit_error:
@@ -85,6 +91,20 @@ def init(
     rt.add_node(resources=node_resources, is_head=True)
     _cw.set_runtime(rt)
     atexit.register(shutdown)
+    if resume_from:
+        from .core import persistence
+
+        try:
+            persistence.restore_into(rt, persistence.load_snapshot(resume_from))
+        except Exception:
+            shutdown()  # no half-initialized global runtime on failed restore
+            raise
+    if config.control_plane_snapshot_path:
+        from .core.persistence import SnapshotWriter
+
+        rt._snapshot_writer = SnapshotWriter(
+            rt, config.control_plane_snapshot_path
+        )
     return rt
 
 

@@ -91,6 +91,18 @@ declare("metrics_export_port", 0, "Prometheus port; 0 = disabled.")
 declare("event_log_dir", "", "Structured event-log directory; empty = session dir.")
 declare("task_events_max_buffer", 10_000, "Ring-buffer size for task events.")
 
+# Control-plane persistence (GCS-Redis analogue, file-backed)
+declare(
+    "control_plane_snapshot_path", "",
+    "Snapshot the control-plane tables (KV/jobs/named actors/...) to this "
+    "file on an interval; init(resume_from=path) rebuilds from it. "
+    "Empty = persistence off.",
+)
+declare(
+    "control_plane_snapshot_interval_s", 5.0,
+    "Seconds between control-plane snapshots when persistence is on.",
+)
+
 
 class Config:
     """Resolved configuration view. Thread-safe."""

@@ -200,6 +200,10 @@ class Runtime:
         reserve contiguous sub-boxes on it (sched/topology.py::SliceInfo)."""
         with self._lock:
             self.slices[slice_info.slice_id] = slice_info
+        # new capacity: gangs queued for topology must get a pass now, not
+        # when some unrelated group happens to be removed (upstream:
+        # gcs_placement_group_manager pending-queue retry on node add)
+        self.pg_manager._retry_queued()
 
     def unregister_slice(self, slice_id) -> None:
         with self._lock:
@@ -228,6 +232,8 @@ class Runtime:
             self.agents[info.node_id] = agent
             if is_head or self.head_node_id is None:
                 self.head_node_id = info.node_id
+        # node join = new capacity: kick queued placement groups too
+        self.pg_manager._retry_queued()
         self._kick_scheduler()
         return agent
 
@@ -759,6 +765,10 @@ class Runtime:
     # -------------------------------------------------------------- shutdown
     def shutdown(self) -> None:
         self.is_shutdown = True
+        writer = getattr(self, "_snapshot_writer", None)
+        if writer is not None:
+            writer.stop(final_write=True)
+            self._snapshot_writer = None
         self._kick_scheduler()
         self.control_plane.finish_job(self.job_id)
         with self._lock:
