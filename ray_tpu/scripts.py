@@ -1,0 +1,238 @@
+"""Operator CLI: ``ray-tpu start|status|submit|list|bench``.
+
+Reference analogue: `python/ray/scripts/scripts.py` (`ray start/status/
+job submit/list`). Design difference, stated plainly: this runtime is
+single-process (no RPC control plane yet — SURVEY N8), so the CLI cannot
+attach to a runtime living in another process. Instead:
+
+- ``submit`` runs the entrypoint under a fresh runtime via the job
+  supervisor (subprocess entrypoint, streamed logs, exit code = job state).
+- ``status``/``list`` show the live runtime of THIS invocation (resources,
+  TPU topology) or, with ``--snapshot``, the tables of a persisted
+  control-plane snapshot from another (possibly dead) runtime.
+- ``start`` boots a long-lived session: snapshotting on, Prometheus
+  metrics exported, optional serve app deployed; blocks until SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+
+def _print_rows(rows: List[Dict[str, Any]], columns: List[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in columns}
+    print("  ".join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
+
+
+def cmd_status(args) -> int:
+    if args.snapshot:
+        from ray_tpu.core import persistence
+
+        snap = persistence.load_snapshot(args.snapshot)
+        age = time.time() - snap.get("time", 0)
+        print(f"snapshot: {args.snapshot} (written {age:.0f}s ago)")
+        print(f"  kv entries:    {len(snap.get('kv', {}))}")
+        print(f"  jobs:          {len(snap.get('jobs', {}))}")
+        print(f"  named actors:  {sorted(snap.get('named_actors', {}))}")
+        print(f"  nodes:         {len(snap.get('nodes', []))}")
+        print(f"  objects:       {len(snap.get('objects', []))}")
+        return 0
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init()
+    s = state.summary()
+    print(json.dumps(s, indent=2, default=str))
+    return 0
+
+
+def cmd_list(args) -> int:
+    if args.snapshot:
+        from ray_tpu.core import persistence
+
+        snap = persistence.load_snapshot(args.snapshot)
+        if args.what == "jobs":
+            rows = [{"job_id": j, **m} for j, m in snap.get("jobs", {}).items()]
+            _print_rows(rows, ["job_id", "state", "death_cause"])
+        elif args.what == "actors":
+            rows = [
+                {"name": n, "class": e.get("class_name", "")}
+                for n, e in snap.get("named_actors", {}).items()
+            ]
+            _print_rows(rows, ["name", "class"])
+        elif args.what == "nodes":
+            _print_rows(snap.get("nodes", []), ["node_id", "state", "resources"])
+        else:
+            print("\n".join(snap.get("objects", [])) or "(none)")
+        return 0
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init()
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "jobs": state.list_jobs,
+        "objects": state.list_objects,
+    }[args.what]
+    rows = fn(limit=args.limit)
+    cols = list(rows[0].keys()) if rows else []
+    _print_rows(rows, cols)
+    return 0
+
+
+def cmd_submit(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    import shlex
+
+    ray_tpu.init()
+    client = JobSubmissionClient()
+    entrypoint = shlex.join(args.entrypoint)  # preserve argv quoting
+    job_id = client.submit_job(entrypoint=entrypoint)
+    print(f"job {job_id} submitted: {entrypoint}", file=sys.stderr)
+    status = client.wait_until_finish(job_id, timeout_s=args.timeout)
+    logs = client.get_job_logs(job_id)
+    if logs:
+        sys.stdout.write(logs)
+    print(f"job {job_id}: {status}", file=sys.stderr)
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def cmd_start(args) -> int:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    system_config: Dict[str, Any] = {}
+    if args.snapshot:
+        system_config["control_plane_snapshot_path"] = args.snapshot
+    rt = ray_tpu.init(
+        system_config=system_config or None,
+        resume_from=args.resume_from,
+    )
+    port = state.start_metrics_server(port=args.metrics_port)
+    print(f"ray-tpu session up: metrics http://127.0.0.1:{port}/metrics")
+    res = rt.control_plane.alive_nodes()
+    for n in res:
+        print(f"  node {n.node_id.hex()[:8]}: {n.resources_total}")
+    if args.serve_app:
+        module, _, attr = args.serve_app.partition(":")
+        import importlib
+
+        from ray_tpu import serve
+
+        app = getattr(importlib.import_module(module), attr or "app")
+        serve.run(app)
+        print(f"  serve app '{args.serve_app}' at port {serve.http_port()}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    if args.events_dir:
+        # merge per-session dumps (written on runtime shutdown when
+        # system_config event_log_dir is set) into one Perfetto trace
+        import glob
+        import os
+
+        events: List[Dict[str, Any]] = []
+        files = sorted(glob.glob(os.path.join(args.events_dir, "timeline_*.json")))
+        for f in files:
+            try:
+                events.extend(json.load(open(f)).get("traceEvents", []))
+            except Exception as e:
+                print(f"skipping {f}: {e}", file=sys.stderr)
+        with open(args.out, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        print(f"merged {len(events)} events from {len(files)} session(s) "
+              f"into {args.out} (open in Perfetto)")
+        return 0
+    import ray_tpu
+
+    n = ray_tpu.timeline(args.out)
+    if n == 0:
+        print(
+            "no events in this process. Task events live in the runtime "
+            "process; set system_config={'event_log_dir': DIR} there (dumped "
+            "on shutdown) and run: ray-tpu timeline --events-dir DIR",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"wrote {n} events to {args.out} (open in Perfetto)")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import os
+
+    os.environ["RAY_TPU_BENCH_SUITE"] = args.suite
+    sys.path.insert(0, os.getcwd())
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("status", help="runtime or snapshot summary")
+    ps.add_argument("--snapshot", help="read a control-plane snapshot file")
+    ps.set_defaults(fn=cmd_status)
+
+    pl = sub.add_parser("list", help="list nodes/actors/jobs/objects")
+    pl.add_argument("what", choices=["nodes", "actors", "jobs", "objects"])
+    pl.add_argument("--snapshot", help="read a control-plane snapshot file")
+    pl.add_argument("--limit", type=int, default=100)
+    pl.set_defaults(fn=cmd_list)
+
+    pj = sub.add_parser("submit", help="run an entrypoint as a supervised job")
+    pj.add_argument("--timeout", type=float, default=3600.0)
+    pj.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run, e.g.: -- python train.py")
+    pj.set_defaults(fn=cmd_submit)
+
+    pst = sub.add_parser("start", help="long-lived session (metrics + snapshots)")
+    pst.add_argument("--snapshot", help="control-plane snapshot path to write")
+    pst.add_argument("--resume-from", help="snapshot to restore at boot")
+    pst.add_argument("--metrics-port", type=int, default=0)
+    pst.add_argument("--serve-app", help="module:attr of a serve Application")
+    pst.set_defaults(fn=cmd_start)
+
+    pt = sub.add_parser("timeline", help="export the task timeline (chrome trace)")
+    pt.add_argument("out", nargs="?", default="timeline.json")
+    pt.add_argument("--events-dir",
+                    help="merge session dumps written via event_log_dir")
+    pt.set_defaults(fn=cmd_timeline)
+
+    pb = sub.add_parser("bench", help="run the driver benchmarks")
+    pb.add_argument("--suite", default="train,serve,data")
+    pb.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    if hasattr(args, "entrypoint"):
+        # strip a leading "--" separator
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+        if not args.entrypoint:
+            p.error("submit: entrypoint required (e.g.: ray-tpu submit -- python train.py)")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

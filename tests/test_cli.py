@@ -1,0 +1,84 @@
+"""CLI smoke tests (`ray-tpu ...` console entry; reference:
+`python/ray/scripts/scripts.py`). Each invocation is a subprocess, matching
+how operators run it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", *args],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "")},
+    )
+
+
+def test_status_live():
+    r = run_cli("status")
+    assert r.returncode == 0, r.stderr
+    s = json.loads(r.stdout)
+    assert "nodes" in s or "num_nodes" in s or s  # summary shape is flexible
+
+
+def test_list_nodes():
+    r = run_cli("list", "nodes")
+    assert r.returncode == 0, r.stderr
+    assert "NODE" in r.stdout.upper() or "(none)" in r.stdout
+
+
+def test_submit_runs_entrypoint():
+    r = run_cli("submit", "--", sys.executable, "-c", "print('hello-from-job')")
+    assert r.returncode == 0, r.stderr
+    assert "hello-from-job" in r.stdout
+    assert "SUCCEEDED" in r.stderr
+
+
+def test_submit_failure_exit_code():
+    r = run_cli("submit", "--", sys.executable, "-c", "raise SystemExit(3)")
+    assert r.returncode == 1
+    assert "FAILED" in r.stderr
+
+
+def test_status_snapshot(tmp_path):
+    snap = str(tmp_path / "cp.snap")
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import ray_tpu\n"
+        "from ray_tpu.core import persistence\n"
+        "rt = ray_tpu.init(num_cpus=2, num_tpus=0)\n"
+        "rt.control_plane.kv_put('k', b'v')\n"
+        "persistence.write_snapshot(rt, %r)\n" % (REPO, snap)
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=60)
+    r = run_cli("status", "--snapshot", snap)
+    assert r.returncode == 0, r.stderr
+    assert "kv entries:    1" in r.stdout
+    r = run_cli("list", "jobs", "--snapshot", snap)
+    assert r.returncode == 0, r.stderr
+
+
+def test_timeline_merges_session_dumps(tmp_path):
+    evdir = str(tmp_path / "events")
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2, num_tpus=0,"
+        " system_config={'event_log_dir': %r})\n"
+        "@ray_tpu.remote\n"
+        "def f(): return 1\n"
+        "ray_tpu.get(f.remote())\n"
+        "ray_tpu.shutdown()\n" % (REPO, evdir)
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=120)
+    out = str(tmp_path / "merged.json")
+    r = run_cli("timeline", out, "--events-dir", evdir)
+    assert r.returncode == 0, r.stderr
+    doc = json.load(open(out))
+    assert any(e["cat"] == "task" for e in doc["traceEvents"])
