@@ -34,3 +34,13 @@ from .core.task_spec import (  # noqa: F401
 )
 
 __version__ = "0.1.0"
+
+
+def timeline(path: str) -> int:
+    """Export the task-event timeline as chrome-trace JSON (open in
+    Perfetto / chrome://tracing). Returns the number of events written.
+    Reference analogue: ``ray timeline``. See ray_tpu.util.timeline for
+    app spans (`span`) and device traces (`trace_jax`)."""
+    from .util import timeline as _tl
+
+    return _tl.export(path)

@@ -42,6 +42,12 @@ from .task_spec import (
 logger = get_logger("core_worker")
 
 
+def _timeline_now_us() -> float:
+    from ..util import timeline
+
+    return timeline._now_us()
+
+
 class RayTaskError(Exception):
     """Wraps an application exception raised inside a task; re-raised on get."""
 
@@ -279,6 +285,7 @@ class Runtime:
                 "state": "PENDING",
                 "kind": spec.kind.value,
                 "attempt": spec.attempt,
+                "ts_submit": _timeline_now_us(),
             }
         pending = _PendingTask(
             spec, retries_left=retries, retry_exceptions=spec.options.retry_exceptions
@@ -316,6 +323,7 @@ class Runtime:
                 "state": "PENDING",
                 "kind": spec.kind.value,
                 "attempt": 0,
+                "ts_submit": _timeline_now_us(),
             }
         self._enqueue_pending(_PendingTask(spec, retries_left=0, retry_exceptions=False))
         return info
@@ -350,6 +358,7 @@ class Runtime:
                 "state": "PENDING",
                 "kind": spec.kind.value,
                 "attempt": 0,
+                "ts_submit": _timeline_now_us(),
             }
         retries = options.max_task_retries
         self._enqueue_pending(_PendingTask(spec, retries_left=retries, retry_exceptions=False))
@@ -719,9 +728,39 @@ class Runtime:
                 fut.event.set()
 
     def _mark_task(self, task_id: TaskID, state: str) -> None:
+        from ..util import timeline
+
+        emit = None
         with self._lock:
-            if task_id in self._task_table:
-                self._task_table[task_id]["state"] = state
+            entry = self._task_table.get(task_id)
+            if entry is None:
+                return
+            entry["state"] = state
+            now = timeline._now_us()
+            if state == "RUNNING":
+                entry["ts_start"] = now
+            elif state in ("FINISHED", "FAILED", "RETRYING"):
+                ts_start = entry.get("ts_start")
+                ts_submit = entry.get("ts_submit")
+                if ts_start is not None:
+                    emit = (entry["name"], ts_submit, ts_start, now, state)
+                if state == "RETRYING":
+                    # next attempt gets its own queued/task spans
+                    entry["ts_submit"] = now
+                    entry["ts_start"] = None
+        if emit is not None:
+            name, ts_submit, ts_start, ts_end, final = emit
+            if ts_submit is not None and ts_start > ts_submit:
+                timeline.record(
+                    f"{name} (queued)", "X", cat="queue",
+                    ts_us=ts_submit, dur_us=ts_start - ts_submit,
+                    pid="tasks", tid=name.split(".")[0],
+                )
+            timeline.record(
+                name, "X", cat="task", ts_us=ts_start,
+                dur_us=ts_end - ts_start, pid="tasks",
+                tid=name.split(".")[0], args={"outcome": final},
+            )
 
     # --------------------------------------------------------- reconstruction
     def _try_reconstruct(self, object_id: ObjectID) -> bool:
@@ -765,6 +804,20 @@ class Runtime:
     # -------------------------------------------------------------- shutdown
     def shutdown(self) -> None:
         self.is_shutdown = True
+        if config.event_log_dir:
+            # durable task timeline for `ray-tpu timeline --events-dir`
+            try:
+                import os as _os
+
+                from ..util import timeline as _tl
+
+                _os.makedirs(config.event_log_dir, exist_ok=True)
+                _tl.export(_os.path.join(
+                    config.event_log_dir,
+                    f"timeline_{_os.getpid()}_{int(time.time())}.json",
+                ))
+            except Exception:
+                logger.debug("timeline export on shutdown failed", exc_info=True)
         writer = getattr(self, "_snapshot_writer", None)
         if writer is not None:
             writer.stop(final_write=True)

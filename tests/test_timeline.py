@@ -1,0 +1,71 @@
+"""Task-event timeline tests (reference: gcs_task_manager + `ray timeline`,
+SURVEY §5.1)."""
+
+import json
+import os
+
+import ray_tpu
+from ray_tpu.util import timeline
+
+
+class TestTimeline:
+    def test_task_events_export_chrome_trace(self, ray_start_regular, tmp_path):
+        timeline.clear()
+
+        @ray_tpu.remote
+        def work(x):
+            return x * 2
+
+        assert ray_tpu.get([work.remote(i) for i in range(4)]) == [0, 2, 4, 6]
+        out = str(tmp_path / "trace.json")
+        n = ray_tpu.timeline(out)
+        assert n > 0
+        doc = json.load(open(out))
+        evs = doc["traceEvents"]
+        tasks = [e for e in evs if e["cat"] == "task" and e["name"].endswith("work")]
+        assert len(tasks) == 4
+        for e in tasks:
+            assert e["ph"] == "X" and e["dur"] >= 0 and e["args"]["outcome"] == "FINISHED"
+        # queue-delay spans accompany the runs
+        assert any(e["cat"] == "queue" for e in evs)
+
+    def test_app_spans_and_failures(self, ray_start_regular, tmp_path):
+        timeline.clear()
+
+        with timeline.span("train_step", args={"step": 1}):
+            pass
+
+        @ray_tpu.remote(max_retries=0)
+        def boom():
+            raise ValueError("x")
+
+        try:
+            ray_tpu.get(boom.remote())
+        except Exception:
+            pass
+        out = str(tmp_path / "trace.json")
+        ray_tpu.timeline(out)
+        evs = json.load(open(out))["traceEvents"]
+        assert any(e["name"] == "train_step" and e["cat"] == "app" for e in evs)
+        assert any(e.get("args", {}).get("outcome") == "FAILED" for e in evs)
+
+    def test_train_reports_marked(self, ray_start_regular, tmp_path):
+        timeline.clear()
+        from ray_tpu.train import JaxTrainer, RunConfig
+
+        def train_func(config):
+            from ray_tpu import train
+
+            for step in range(3):
+                train.report({"step": step})
+
+        JaxTrainer(
+            train_func,
+            run_config=RunConfig(name="tl", storage_path=str(tmp_path)),
+        ).fit()
+        out = str(tmp_path / "trace.json")
+        ray_tpu.timeline(out)
+        evs = json.load(open(out))["traceEvents"]
+        marks = [e for e in evs if e["cat"] == "train"]
+        assert len(marks) == 3
+        assert marks[0]["args"]["step"] == 0
