@@ -260,7 +260,7 @@ def _block(x, lp, cfg, rope_tables, positions, mesh=None):
 # ---------------------------------------------------------------------------
 
 
-def _embed_lookup(table: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
+def _embed_lookup(table: jax.Array, tokens: jax.Array, dtype, mesh=None) -> jax.Array:
     """Embedding lookup, mesh-aware.
 
     When the active mesh shards the table (tp on vocab / fsdp on embed),
@@ -271,7 +271,8 @@ def _embed_lookup(table: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
     dot, which GSPMD knows how to shard on both operands), keeps the
     lookup on the MXU, and makes the backward a matmul instead of a
     scatter-add. On unsharded meshes the gather is cheaper — keep it."""
-    mesh = _current_mesh()
+    if mesh is None:
+        mesh = _current_mesh()  # callers outside a mesh context pass theirs
     # vocab->tp, embed->fsdp are the only rules that shard the table
     table_sharded = mesh is not None and any(
         mesh.shape.get(a, 1) > 1 for a in ("tp", "fsdp")

@@ -11,8 +11,11 @@ mode; the default branch is the XLA reference implementation.
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
+
+_interp_override = threading.local()
 
 
 def _forced() -> "bool | None":
@@ -34,18 +37,41 @@ def use_pallas() -> bool:
 
 
 def interpret_mode() -> bool:
-    """Pallas interpret mode: on whenever we're not on a real TPU."""
+    """Pallas interpret mode for the branch currently being traced.
+
+    platform_dispatch sets a per-branch override (TPU branch: compiled;
+    any other platform: interpret) — the decision must follow the LOWERING
+    platform, not the process default backend, because one process can
+    trace for both a real TPU and a virtual CPU mesh."""
+    override = getattr(_interp_override, "value", None)
+    if override is not None:
+        return override
     return jax.default_backend() != "tpu"
+
+
+def _with_interp(fn, interpret: bool):
+    def run(*args):
+        prev = getattr(_interp_override, "value", None)
+        _interp_override.value = interpret
+        try:
+            return fn(*args)
+        finally:
+            _interp_override.value = prev
+
+    return run
 
 
 def platform_dispatch(pallas_fn, xla_fn, *args):
     """Run `pallas_fn(*args)` when lowering for TPU, `xla_fn(*args)` on any
     other platform. Both must return identical shapes/dtypes/pytrees.
     RAY_TPU_FORCE_PALLAS overrides (1 = pallas everywhere, interpret mode
-    off-TPU; 0 = XLA everywhere)."""
+    on non-TPU lowerings; 0 = XLA everywhere)."""
     forced = _forced()
-    if forced is True:
-        return pallas_fn(*args)
     if forced is False:
         return xla_fn(*args)
-    return jax.lax.platform_dependent(*args, tpu=pallas_fn, default=xla_fn)
+    tpu_branch = _with_interp(pallas_fn, False)
+    if forced is True:
+        return jax.lax.platform_dependent(
+            *args, tpu=tpu_branch, default=_with_interp(pallas_fn, True)
+        )
+    return jax.lax.platform_dependent(*args, tpu=tpu_branch, default=xla_fn)

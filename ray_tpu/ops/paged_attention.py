@@ -172,6 +172,8 @@ def paged_attention_decode(
     lengths: jax.Array,
     scale: float | None = None,
     force_xla: bool = False,
+    mesh=None,
+    tp_axis: str = "tp",
 ) -> jax.Array:
     """One decode step of attention over a paged KV cache.
 
@@ -180,23 +182,51 @@ def paged_attention_decode(
       k_pages/v_pages: [KVH, num_pages, page_size, D].
       page_table: [B, pages_per_seq] int32 page ids (unused tail arbitrary).
       lengths: [B] int32 valid context length per sequence.
-      force_xla: skip the Pallas kernel (callers running under GSPMD
-        sharding, where the single-device pallas_call cannot partition).
+      force_xla: skip the Pallas kernel entirely (tests/debug).
+      mesh/tp_axis: tensor-parallel serving. A bare pallas_call cannot be
+        partitioned by GSPMD, so under tp>1 the kernel is wrapped in
+        shard_map over the tp axis: each shard runs the same kernel on its
+        contiguous block of q heads and kv heads (page pool sharded on the
+        KVH dim — requires tp | KVH, which the engine enforces). The
+        page_table/lengths scalars replicate.
     Returns [B, H, D].
     """
     D = q.shape[-1]
+    KVH = k_pages.shape[0]
     if scale is None:
         scale = D**-0.5
-    if force_xla or not (
-        use_pallas() and D % _LANES == 0 and q.shape[1] % k_pages.shape[0] == 0
-    ):
-        return _paged_reference(q, k_pages, v_pages, page_table, lengths, scale)
-    return platform_dispatch(
-        lambda *a: _paged_pallas(*a, scale),
-        lambda *a: _paged_reference(*a, scale),
-        q,
-        k_pages,
-        v_pages,
-        page_table,
-        lengths,
+
+    def dispatch(q, kp, vp, pt, ln):
+        return platform_dispatch(
+            lambda *a: _paged_pallas(*a, scale),
+            lambda *a: _paged_reference(*a, scale),
+            q, kp, vp, pt, ln,
+        )
+
+    tp = int(mesh.shape.get(tp_axis, 1)) if mesh is not None else 1
+    # tp | KVH is the only TP constraint: H = g*KVH makes H % tp == 0 follow
+    kernel_ok = (
+        use_pallas()
+        and D % _LANES == 0
+        and q.shape[1] % KVH == 0
+        and (tp == 1 or KVH % tp == 0)
     )
+    if force_xla or not kernel_ok:
+        return _paged_reference(q, k_pages, v_pages, page_table, lengths, scale)
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        return jax.shard_map(
+            dispatch,
+            mesh=mesh,
+            in_specs=(
+                P(None, tp_axis, None),        # q: heads sharded
+                P(tp_axis), P(tp_axis),        # page pools: KVH sharded
+                P(), P(),                      # table/lengths replicated
+            ),
+            out_specs=P(None, tp_axis, None),
+            # no collectives in the body; pallas_call outputs don't carry
+            # vma annotations, so the varying-axes checker can't see through
+            check_vma=False,
+        )(q, k_pages, v_pages, page_table, lengths)
+    return dispatch(q, k_pages, v_pages, page_table, lengths)

@@ -20,10 +20,10 @@ step boundary (cheap) and carries on batching.
 
 Tensor parallelism: pass a mesh with a "tp" axis. Params shard by the
 model's logical-axis rules (q heads and kv heads over tp), the page pool
-shards over its kv-head dim, and XLA partitions the compiled step —
-attention then uses the XLA paged path (the Pallas kernel is
-single-device; sharding it via shard_map is perf work, not a semantics
-change).
+shards over its kv-head dim, and XLA partitions the compiled step.
+Paged attention runs the Pallas kernel inside shard_map over the tp
+axis (each shard owns a contiguous block of q/kv heads and its slice of
+the page pool), so TP serving keeps the kernel path.
 """
 
 from __future__ import annotations
@@ -42,7 +42,13 @@ import numpy as np
 
 from ..core.logging import get_logger
 from ..models import ModelConfig
-from ..models.transformer import _dense_ffn, _moe_ffn, _norm, prefill
+from ..models.transformer import (
+    _dense_ffn,
+    _embed_lookup,
+    _moe_ffn,
+    _norm,
+    prefill,
+)
 from ..ops import apply_rope, paged_attention_decode, rope_frequencies
 
 logger = get_logger("serve.engine")
@@ -197,13 +203,18 @@ class InferenceEngine:
         completion)."""
         cfg, ecfg = self.cfg, self.ecfg
         ps = ecfg.page_size
-        force_xla = self._tp > 1  # pallas_call cannot partition under GSPMD
+        # tp>1: the Pallas kernel runs inside shard_map over the tp axis
+        # (paged_attention_decode handles the wrap) instead of falling back
+        # to the XLA reference path
+        tp_mesh = self.mesh if self._tp > 1 else None
 
         def decode(params, k_pages, v_pages, tokens, positions, page_tables, temps, key):
             """tokens/positions [B]; page_tables [B, pages_per_seq]."""
             dtype = jnp.dtype(cfg.dtype)
             B = tokens.shape[0]
-            x = params["embed"][tokens][:, None].astype(dtype)  # [B,1,D]
+            x = _embed_lookup(
+                params["embed"], tokens[:, None], dtype, mesh=self.mesh
+            )  # [B,1,D]; one-hot matmul form when the table is sharded
             if cfg.positional == "learned":
                 x = x + params["pos_emb"][positions][:, None].astype(dtype)
                 rope_tables = None
@@ -233,7 +244,7 @@ class InferenceEngine:
                 )
                 o = paged_attention_decode(
                     q[:, 0], kp, vp, page_tables, positions + 1,
-                    force_xla=force_xla,
+                    mesh=tp_mesh,
                 )
                 o = jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(dtype))[:, None]
                 x = x + o
