@@ -105,6 +105,14 @@ def init(
         rt._snapshot_writer = SnapshotWriter(
             rt, config.control_plane_snapshot_path
         )
+    if config.control_plane_rpc_port >= 0:
+        from .core.rpc import serve_control_plane
+
+        rt._cp_server = serve_control_plane(
+            rt.control_plane,
+            host=config.control_plane_rpc_host,
+            port=config.control_plane_rpc_port,
+        )
     return rt
 
 

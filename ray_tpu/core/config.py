@@ -91,6 +91,18 @@ declare("metrics_export_port", 0, "Prometheus port; 0 = disabled.")
 declare("event_log_dir", "", "Structured event-log directory; empty = session dir.")
 declare("task_events_max_buffer", 10_000, "Ring-buffer size for task events.")
 
+declare(
+    "control_plane_rpc_host", "127.0.0.1",
+    "Bind address for the control-plane RPC server; set 0.0.0.0 (or a "
+    "specific interface) for cross-host attach.",
+)
+declare(
+    "control_plane_rpc_port", -1,
+    "Serve this runtime's control plane over TCP (core/rpc.py) so other "
+    "processes/hosts and the CLI can attach: -1 = off, 0 = ephemeral port "
+    "(logged), >0 = fixed port.",
+)
+
 # Control-plane persistence (GCS-Redis analogue, file-backed)
 declare(
     "control_plane_snapshot_path", "",

@@ -822,6 +822,10 @@ class Runtime:
         if writer is not None:
             writer.stop(final_write=True)
             self._snapshot_writer = None
+        cp_server = getattr(self, "_cp_server", None)
+        if cp_server is not None:
+            cp_server.stop()
+            self._cp_server = None
         self._kick_scheduler()
         self.control_plane.finish_job(self.job_id)
         with self._lock:

@@ -1,0 +1,223 @@
+"""Control-plane RPC: the single-authority tables served over TCP.
+
+Reference analogue: `src/ray/rpc/gcs_server/` (GcsRpcServer) and
+`gcs_client/` — every daemon talks to the GCS over gRPC. Here the same
+shape: `serve_control_plane` exposes a ControlPlane's public methods on a
+socket, `RemoteControlPlane` is a drop-in client with the same duck-typed
+surface, so a Runtime on another host (or another OS process on the same
+host) can share one authority. Pubsub crosses the wire as pushed EVENT
+frames feeding the client's local Pubsub — subscribers are oblivious.
+
+Threading model: one handler thread per connection (control-plane call
+rates are low; no need for an event loop), one push thread per subscribed
+client. The client proxy serializes request/response pairs over one
+socket with a lock and routes pushed events to its Pubsub from a reader
+thread.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, Optional, Set
+
+from .logging import get_logger
+from .wire import MSG_EVENT, MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
+
+logger = get_logger("rpc")
+
+# the served surface (N1's public API): anything else is rejected
+_ALLOWED_METHODS: Set[str] = {
+    "register_node", "mark_node_dead", "heartbeat", "alive_nodes",
+    "get_node", "all_nodes",
+    "register_actor", "update_actor", "get_actor", "get_named_actor",
+    "list_actors",
+    "register_job", "finish_job", "list_jobs",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
+}
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "ControlPlaneServer" = self.server  # type: ignore[assignment]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_lock = threading.Lock()
+        unsubscribes = []
+        try:
+            while True:
+                msg_type, req = recv_msg(sock)
+                if msg_type != MSG_REQUEST:
+                    raise WireError(f"unexpected message type {msg_type}")
+                method = req.get("method", "")
+                if method == "subscribe":
+                    # push this channel's events to the client as EVENT frames
+                    channel = req["args"][0]
+
+                    def push(message, _ch=channel):
+                        try:
+                            with send_lock:
+                                send_msg(sock, MSG_EVENT,
+                                         {"channel": _ch, "message": message})
+                        except OSError:
+                            pass  # client gone; reaped on next request
+
+                    unsubscribes.append(
+                        server.control_plane.pubsub.subscribe(channel, push)
+                    )
+                    resp = {"id": req["id"], "ok": True, "value": True}
+                elif method not in _ALLOWED_METHODS:
+                    resp = {"id": req["id"], "ok": False,
+                            "error": f"method {method!r} not served", "exc": None}
+                else:
+                    try:
+                        value = getattr(server.control_plane, method)(
+                            *req.get("args", ()), **req.get("kwargs", {})
+                        )
+                        resp = {"id": req["id"], "ok": True, "value": value}
+                    except Exception as e:  # noqa: BLE001 — serialized to caller
+                        resp = {"id": req["id"], "ok": False,
+                                "error": repr(e), "exc": e}
+                try:
+                    with send_lock:
+                        send_msg(sock, MSG_RESPONSE, resp)
+                except (TypeError, ValueError, AttributeError) as e:
+                    # unpicklable value/exception: degrade to a string error
+                    # rather than tearing down the connection
+                    with send_lock:
+                        send_msg(sock, MSG_RESPONSE, {
+                            "id": req["id"], "ok": False,
+                            "error": f"unserializable response: {e!r}",
+                            "exc": None,
+                        })
+        except (WireError, OSError):
+            pass  # client disconnected
+        finally:
+            for unsub in unsubscribes:
+                try:
+                    unsub()
+                except Exception:
+                    pass
+
+
+class ControlPlaneServer(socketserver.ThreadingTCPServer):
+    """Serves one ControlPlane on host:port (0 = ephemeral)."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, control_plane, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.control_plane = control_plane
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="cp-rpc-server"
+        )
+        self._thread.start()
+        logger.info("control-plane RPC on %s:%d", *self.server_address)
+
+    @property
+    def address(self) -> str:
+        host, port = self.server_address
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+def serve_control_plane(control_plane, host: str = "127.0.0.1",
+                        port: int = 0) -> ControlPlaneServer:
+    """host: bind address — 127.0.0.1 for same-host attach (default),
+    0.0.0.0 (config control_plane_rpc_host) for cross-host."""
+    return ControlPlaneServer(control_plane, host, port)
+
+
+class RemoteControlPlane:
+    """Client proxy with ControlPlane's duck-typed surface.
+
+    Method calls serialize over one socket; `pubsub.subscribe(channel, cb)`
+    transparently registers a server-side push and dispatches EVENT frames
+    from a reader thread into a local Pubsub."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        from .control_plane import Pubsub
+
+        host, _, port = address.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)), connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._replies: Dict[int, Any] = {}
+        self._reply_cv = threading.Condition()
+        self.pubsub = Pubsub()
+        self._subscribed: Set[str] = set()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="cp-rpc-client"
+        )
+        self._reader.start()
+
+    # -- plumbing -----------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                msg_type, payload = recv_msg(self._sock)
+                if msg_type == MSG_EVENT:
+                    self.pubsub.publish(payload["channel"], payload["message"])
+                elif msg_type == MSG_RESPONSE:
+                    with self._reply_cv:
+                        self._replies[payload["id"]] = payload
+                        self._reply_cv.notify_all()
+        except Exception:  # noqa: BLE001 — ANY reader death must wake waiters
+            with self._reply_cv:
+                self._replies[-1] = None  # poison: wake waiters
+                self._closed.set()
+                self._reply_cv.notify_all()
+
+    def _call(self, method: str, *args, **kwargs) -> Any:
+        with self._lock:
+            self._next_id += 1
+            req_id = self._next_id
+            send_msg(self._sock, MSG_REQUEST,
+                     {"id": req_id, "method": method,
+                      "args": args, "kwargs": kwargs})
+        with self._reply_cv:
+            while req_id not in self._replies:
+                if self._closed.is_set():
+                    raise WireError("control-plane connection lost")
+                self._reply_cv.wait(timeout=1.0)
+            resp = self._replies.pop(req_id)
+        if resp["ok"]:
+            return resp["value"]
+        if resp.get("exc") is not None:
+            raise resp["exc"]
+        raise RuntimeError(resp["error"])
+
+    def subscribe(self, channel: str, callback) -> Any:
+        """Subscribe via the local pubsub, lazily registering the remote
+        push for this channel."""
+        if channel not in self._subscribed:
+            self._call("subscribe", channel)
+            self._subscribed.add(channel)
+        return self.pubsub.subscribe(channel, callback)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in _ALLOWED_METHODS:
+            raise AttributeError(f"{name!r} is not part of the served surface")
+
+        def call(*args, **kwargs):
+            return self._call(name, *args, **kwargs)
+
+        call.__name__ = name
+        return call

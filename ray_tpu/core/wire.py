@@ -1,0 +1,74 @@
+"""Wire protocol: framed, versioned messages for cross-process control RPC.
+
+Reference analogue: `src/ray/protobuf/*.proto` + the gRPC plumbing in
+`src/ray/rpc/` — the reference serializes every control-plane surface so
+daemons on different hosts interoperate. TPU-native scope: the DATA plane
+here is XLA collectives over ICI (jax.distributed), which needs no runtime
+wire format; what must serialize is the CONTROL plane (node/actor/job/KV
+tables, object locations). This module is that wire format.
+
+Frame layout (all integers big-endian):
+
+    [4B length] [1B version] [1B msg type] [length-6 bytes payload]
+
+Payload is pickle protocol 5 of a plain dict (schema per message type
+below). Pickle-over-TCP is acceptable here for the same reason the
+reference trusts protobuf-over-gRPC: the control plane is an internal,
+mutually-trusted surface, never exposed to user traffic.
+
+Message types:
+    REQUEST  {"id": int, "method": str, "args": tuple, "kwargs": dict}
+    RESPONSE {"id": int, "ok": bool, "value": Any} |
+             {"id": int, "ok": False, "error": str, "exc": Exception}
+    EVENT    {"channel": str, "message": Any}   (server -> client push)
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Tuple
+
+WIRE_VERSION = 1
+
+MSG_REQUEST = 1
+MSG_RESPONSE = 2
+MSG_EVENT = 3
+
+_HEADER = struct.Struct(">IBB")  # length, version, type
+_MAX_FRAME = 256 << 20  # 256 MB control message ceiling
+
+
+class WireError(ConnectionError):
+    pass
+
+
+def send_msg(sock: socket.socket, msg_type: int, payload: Any) -> None:
+    body = pickle.dumps(payload, protocol=5)
+    if len(body) + 2 > _MAX_FRAME:
+        raise WireError(f"frame too large: {len(body)} bytes")
+    sock.sendall(_HEADER.pack(len(body) + 2, WIRE_VERSION, msg_type) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise WireError("connection closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[int, Any]:
+    """-> (msg_type, payload). Raises WireError on close/corruption."""
+    header = _recv_exact(sock, _HEADER.size)
+    length, version, msg_type = _HEADER.unpack(header)
+    if version != WIRE_VERSION:
+        raise WireError(f"wire version {version} != {WIRE_VERSION}")
+    if length < 2 or length > _MAX_FRAME:
+        raise WireError(f"bad frame length {length}")
+    body = _recv_exact(sock, length - 2)
+    return msg_type, pickle.loads(body)

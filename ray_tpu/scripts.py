@@ -33,7 +33,35 @@ def _print_rows(rows: List[Dict[str, Any]], columns: List[str]) -> None:
         print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
 
 
+def _sum_resources(nodes) -> Dict[str, float]:
+    acc: Dict[str, float] = {}
+    for n in nodes:
+        for k, v in n.resources_total.items():
+            acc[k] = acc.get(k, 0.0) + v
+    return acc
+
+
+def _remote_cp(address: str):
+    from ray_tpu.core.rpc import RemoteControlPlane
+
+    return RemoteControlPlane(address)
+
+
 def cmd_status(args) -> int:
+    if args.address:
+        cp = _remote_cp(args.address)
+        nodes = cp.alive_nodes()
+        actors = cp.list_actors()
+        jobs = cp.list_jobs()
+        print(json.dumps({
+            "address": args.address,
+            "nodes_alive": len(nodes),
+            "actors": len(actors),
+            "jobs": len(jobs),
+            "cluster_resources": _sum_resources(nodes),
+        }, indent=2, default=str))
+        cp.close()
+        return 0
     if args.snapshot:
         from ray_tpu.core import persistence
 
@@ -56,6 +84,26 @@ def cmd_status(args) -> int:
 
 
 def cmd_list(args) -> int:
+    if args.address:
+        cp = _remote_cp(args.address)
+        if args.what == "nodes":
+            rows = [{"node_id": n.node_id.hex()[:16], "state": n.state.value,
+                     "resources": n.resources_total} for n in cp.all_nodes()]
+            _print_rows(rows, ["node_id", "state", "resources"])
+        elif args.what == "actors":
+            rows = [{"actor_id": a.actor_id.hex()[:16], "name": a.name,
+                     "class": a.class_name, "state": a.state.value}
+                    for a in cp.list_actors()]
+            _print_rows(rows, ["actor_id", "name", "class", "state"])
+        elif args.what == "jobs":
+            rows = [{"job_id": j.hex()[:16], **{k: v for k, v in m.items()
+                     if isinstance(v, (str, int, float))}}
+                    for j, m in cp.list_jobs().items()]
+            _print_rows(rows, ["job_id", "state"])
+        else:
+            print("objects are node-local; not served over the control plane")
+        cp.close()
+        return 0
     if args.snapshot:
         from ray_tpu.core import persistence
 
@@ -113,15 +161,19 @@ def cmd_start(args) -> int:
     import ray_tpu
     from ray_tpu.util import state
 
-    system_config: Dict[str, Any] = {}
+    system_config: Dict[str, Any] = {"control_plane_rpc_port": args.rpc_port}
     if args.snapshot:
         system_config["control_plane_snapshot_path"] = args.snapshot
     rt = ray_tpu.init(
-        system_config=system_config or None,
+        system_config=system_config,
         resume_from=args.resume_from,
     )
     port = state.start_metrics_server(port=args.metrics_port)
     print(f"ray-tpu session up: metrics http://127.0.0.1:{port}/metrics")
+    cp_server = getattr(rt, "_cp_server", None)
+    if cp_server is not None:
+        print(f"  control-plane RPC: {cp_server.address} "
+              f"(attach: ray-tpu status --address {cp_server.address})")
     res = rt.control_plane.alive_nodes()
     for n in res:
         print(f"  node {n.node_id.hex()[:8]}: {n.resources_total}")
@@ -193,11 +245,14 @@ def main(argv=None) -> int:
 
     ps = sub.add_parser("status", help="runtime or snapshot summary")
     ps.add_argument("--snapshot", help="read a control-plane snapshot file")
+    ps.add_argument("--address", help="attach to a live runtime's control-plane "
+                    "RPC (system_config control_plane_rpc_port)")
     ps.set_defaults(fn=cmd_status)
 
     pl = sub.add_parser("list", help="list nodes/actors/jobs/objects")
     pl.add_argument("what", choices=["nodes", "actors", "jobs", "objects"])
     pl.add_argument("--snapshot", help="read a control-plane snapshot file")
+    pl.add_argument("--address", help="attach to a live runtime's control-plane RPC")
     pl.add_argument("--limit", type=int, default=100)
     pl.set_defaults(fn=cmd_list)
 
@@ -211,6 +266,8 @@ def main(argv=None) -> int:
     pst.add_argument("--snapshot", help="control-plane snapshot path to write")
     pst.add_argument("--resume-from", help="snapshot to restore at boot")
     pst.add_argument("--metrics-port", type=int, default=0)
+    pst.add_argument("--rpc-port", type=int, default=0,
+                     help="control-plane RPC port (0 = ephemeral)")
     pst.add_argument("--serve-app", help="module:attr of a serve Application")
     pst.set_defaults(fn=cmd_start)
 
