@@ -285,6 +285,9 @@ class NodeAgent:
         model); device tasks and actors stay on threads in the device-owning
         process (node_agent docstring). Tasks that can't cross the process
         boundary (unpicklable closures) fall back to in-process execution."""
+        from .runtime_env import validate
+
+        renv = validate(spec.options.runtime_env)
         if (
             spec.kind is TaskKind.NORMAL
             and config.worker_processes > 0
@@ -305,8 +308,12 @@ class NodeAgent:
                     return pool.run(
                         func, tuple(args), dict(kwargs),
                         sealed=spec.options.num_returns == 1,
+                        runtime_env=renv,
                     )
                 except TaskNotSerializableError:
+                    if renv:
+                        # isolation was REQUESTED: never silently run without
+                        raise
                     _pool_fallback_counter.inc(tags={"task": spec.name[:40]})
                     logger.debug(
                         "task %s not serializable; executing in-process",
@@ -314,6 +321,15 @@ class NodeAgent:
                     )
                 except WorkerProcessCrash as e:
                     raise WorkerCrashedError(str(e)) from e
+        if renv:
+            from .runtime_env import RuntimeEnvError
+
+            raise RuntimeEnvError(
+                f"task {spec.name} has a runtime_env but would execute "
+                "in-process (device task, actor, or worker_processes=0): "
+                "env isolation requires a worker process. Use job-level "
+                "runtime_env for device work, or drop the constraint."
+            )
         return func(*args, **kwargs)
 
     def _ensure_pool(self):

@@ -233,8 +233,11 @@ def _worker_main(store_name: str, req_q, resp_q) -> None:
             return
         task_tag, payload, buffer_ids, inline = item
         try:
-            fn, args, kwargs = _load(store, payload, buffer_ids, inline)
-            out = fn(*args, **kwargs)
+            fn, args, kwargs, renv = _load(store, payload, buffer_ids, inline)
+            from .runtime_env import applied
+
+            with applied(renv):
+                out = fn(*args, **kwargs)
             r_payload, r_bufs, r_inline = _dump(store, out, use_cloudpickle=False)
             resp_q.put((task_tag, True, r_payload, r_bufs, r_inline))
         except BaseException as e:  # noqa: BLE001 — user task may raise anything
@@ -286,7 +289,8 @@ class ProcessPool:
     # ------------------------------------------------------------------ api
 
     def run(self, fn: Callable, args: tuple, kwargs: dict,
-            timeout: Optional[float] = None, sealed: bool = False) -> Any:
+            timeout: Optional[float] = None, sealed: bool = False,
+            runtime_env: Optional[dict] = None) -> Any:
         """Execute fn(*args, **kwargs) in a worker process; blocks the calling
         thread. Raises WorkerProcessCrash if the worker dies, or the task's
         own exception. sealed=True returns the worker's pickled result as a
@@ -306,7 +310,7 @@ class ProcessPool:
         with self._submit_lock:
             if self._closed.is_set():
                 raise WorkerProcessCrash("process pool is closed")
-            self._tasks.put((fn, args, kwargs, complete, sealed))
+            self._tasks.put((fn, args, kwargs, complete, sealed, runtime_env))
         if not done.wait(timeout):
             raise TimeoutError("process-pool task timed out")
         if box[0]:
@@ -363,13 +367,13 @@ class ProcessPool:
             item = self._tasks.get()
             if item is None:
                 break
-            fn, args, kwargs, complete, sealed = item
+            fn, args, kwargs, complete, sealed, renv = item
             if worker is None or not worker.proc.is_alive():
                 worker = self._spawn()
             tag = uuid.uuid4().hex
             try:
                 payload, buffer_ids, inline = _dump(
-                    self.store, (fn, args, kwargs), use_cloudpickle=True
+                    self.store, (fn, args, kwargs, renv), use_cloudpickle=True
                 )
             except TaskNotSerializableError as e:
                 # genuinely unpicklable task (see _dump's phase-based

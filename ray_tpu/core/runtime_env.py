@@ -1,0 +1,93 @@
+"""Runtime environments: per-task dependency/environment isolation.
+
+Reference analogue: `python/ray/_private/runtime_env/` (env_vars,
+working_dir, py_modules plugins applied when the raylet starts a worker
+for the task). TPU-native scope and its honest limits:
+
+- **CPU pool tasks**: full support. The runtime_env ships with the task
+  payload; the worker process applies env_vars / working_dir (chdir +
+  sys.path) / py_modules around the call and restores afterwards —
+  workers execute tasks serially, so scoped mutation is race-free.
+- **Jobs** (`job_submission`): env_vars + working_dir on the entrypoint
+  subprocess (already supported there; this module is the shared schema).
+- **Device tasks and actors**: REJECTED with a clear error. They execute
+  in the device-owning process by design (node_agent docstring); mutating
+  that process's env/cwd would leak across every concurrent task. The
+  reference can isolate these because every actor gets its own worker
+  process — that is the documented gap, not silently dropped config.
+
+Schema: {"env_vars": {str: str}, "working_dir": str, "py_modules": [str]}.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Any, Dict, Optional
+
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules"}
+
+
+class RuntimeEnvError(RuntimeError):
+    pass
+
+
+def validate(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not renv:
+        return None
+    unknown = set(renv) - _KNOWN_KEYS
+    if unknown:
+        raise RuntimeEnvError(
+            f"unknown runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(_KNOWN_KEYS)}"
+        )
+    wd = renv.get("working_dir")
+    if wd and not os.path.isdir(wd):
+        raise RuntimeEnvError(f"runtime_env working_dir does not exist: {wd}")
+    for p in renv.get("py_modules") or []:
+        if not os.path.exists(p):
+            raise RuntimeEnvError(f"runtime_env py_module path missing: {p}")
+    return renv
+
+
+@contextlib.contextmanager
+def applied(renv: Optional[Dict[str, Any]]):
+    """Apply a runtime_env for the duration of one task, then restore.
+    Only safe where the process runs tasks serially (pool workers)."""
+    if not renv:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    for k, v in (renv.get("env_vars") or {}).items():
+        saved_env[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    added_paths = []
+    saved_cwd = None
+    wd = renv.get("working_dir")
+    if wd:
+        saved_cwd = os.getcwd()
+        os.chdir(wd)
+        sys.path.insert(0, wd)
+        added_paths.append(wd)
+    for p in renv.get("py_modules") or []:
+        sys.path.insert(0, p)
+        added_paths.append(p)
+    try:
+        yield
+    finally:
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if saved_cwd is not None:
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old

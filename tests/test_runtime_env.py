@@ -1,0 +1,81 @@
+"""Runtime environments (P7; reference: python/ray/_private/runtime_env/):
+per-task env isolation applied in pool workers, strict rejection where
+isolation is impossible."""
+
+import os
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.runtime_env import RuntimeEnvError
+
+
+@pytest.fixture
+def rt():
+    r = ray_tpu.init(num_cpus=4, num_tpus=0, system_config={"worker_processes": 2})
+    yield r
+    ray_tpu.shutdown()
+
+
+class TestRuntimeEnv:
+    def test_env_vars_applied_in_worker(self, rt):
+        @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "on"}})
+        def read():
+            return os.environ.get("MY_FLAG")
+
+        assert ray_tpu.get(read.remote()) == "on"
+
+        @ray_tpu.remote
+        def read_plain():
+            return os.environ.get("MY_FLAG")
+
+        # restored after the task: the same worker does not leak the var
+        assert ray_tpu.get(read_plain.remote()) is None
+
+    def test_working_dir_and_py_modules(self, rt, tmp_path):
+        mod_dir = tmp_path / "libs"
+        mod_dir.mkdir()
+        (mod_dir / "specialmod.py").write_text("VALUE = 41\n")
+        wd = tmp_path / "wd"
+        wd.mkdir()
+        (wd / "data.txt").write_text("payload")
+
+        @ray_tpu.remote(runtime_env={
+            "working_dir": str(wd), "py_modules": [str(mod_dir)]})
+        def use():
+            import specialmod
+
+            return specialmod.VALUE + 1, open("data.txt").read()
+
+        assert ray_tpu.get(use.remote()) == (42, "payload")
+
+    def test_unknown_key_rejected(self, rt):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def f():
+            return 1
+
+        with pytest.raises(Exception, match="unknown runtime_env"):
+            ray_tpu.get(f.remote())
+
+    def test_unpicklable_task_with_env_fails_loudly(self, rt):
+        lock = threading.Lock()  # forces the in-process fallback path
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"X": "1"}})
+        def f():
+            return lock.locked()
+
+        with pytest.raises(Exception):
+            ray_tpu.get(f.remote())
+
+    def test_device_task_with_env_rejected(self):
+        r = ray_tpu.init(num_cpus=2, num_tpus=1)
+        try:
+            @ray_tpu.remote(num_tpus=1, runtime_env={"env_vars": {"X": "1"}})
+            def dev():
+                return 1
+
+            with pytest.raises(Exception, match="runtime_env"):
+                ray_tpu.get(dev.remote())
+        finally:
+            ray_tpu.shutdown()
