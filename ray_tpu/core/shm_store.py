@@ -20,7 +20,11 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "_shm", "libshm_store.so")
+# RAY_TPU_SHM_LIB: alternate build, e.g. the TSAN/ASAN .so from
+# `make -C ray_tpu/core/_shm tsan` (see that Makefile, SURVEY §5.2)
+_SO = os.environ.get(
+    "RAY_TPU_SHM_LIB", os.path.join(_DIR, "_shm", "libshm_store.so")
+)
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
