@@ -8,12 +8,21 @@ and axis bookkeeping: pick a mesh shape that maps logical parallelism axes
 onto the physical ICI torus, and hand everything else to pjit/XLA.
 
 Canonical axis order (outer → inner, DCN-most → ICI-most):
-    pp   pipeline stages (can span slices / DCN)
+    dcn_dp  data parallel ACROSS slices: the one gradient all-reduce per
+            step is the only traffic that crosses DCN (multislice recipe)
+    dcn_pp  pipeline stages across slices: activations cross DCN once per
+            microbatch boundary — the other DCN-tolerant axis
+    pp   pipeline stages (within a slice)
     dp   pure data parallel (replicated params)
     fsdp data parallel with sharded params/opt-state (ZeRO-3 equivalent)
     ep   expert parallel (MoE)
     sp   sequence/context parallel (ring attention)
     tp   tensor parallel (innermost: highest-bandwidth ICI)
+
+Multi-slice: ``build_hybrid_mesh`` places the dcn_* axes over slice
+boundaries (jax mesh_utils' hybrid mesh on real hardware, slice-major
+reshape on virtual devices), so every non-dcn axis's collectives stay on
+ICI inside a slice by construction.
 """
 
 from __future__ import annotations
@@ -27,7 +36,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("dcn_dp", "dcn_pp", "pp", "dp", "fsdp", "ep", "sp", "tp")
+DCN_AXES = ("dcn_dp", "dcn_pp")
 
 
 @dataclass(frozen=True)
@@ -136,6 +146,62 @@ class MeshRegistry:
 
 
 registry = MeshRegistry()
+
+
+def build_hybrid_mesh(
+    num_slices: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Multi-slice mesh: dcn_* axes over slice boundaries, everything else
+    within a slice (collectives on ICI by construction).
+
+    On real multi-slice TPU hardware (devices carry slice_index), uses
+    mesh_utils.create_hybrid_device_mesh with same-length shape vectors:
+    mesh axis i gets its ICI extent from mesh_shape[i] and its DCN extent
+    from dcn_mesh_shape[i], so the dcn_* axes (and only they) vary across
+    slices. On virtual/single-slice device sets, slices are consecutive
+    equal blocks of the device list — same axis semantics, testable on a
+    CPU mesh.
+    """
+    spec = MeshSpec.create(**axis_sizes)
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if len(devices) % num_slices:
+        raise ValueError(f"{len(devices)} devices not divisible into {num_slices} slices")
+    per_slice = len(devices) // num_slices
+    dcn = {a: s for a, s in spec.axes if a in DCN_AXES}
+    dcn_total = math.prod(dcn.values()) if dcn else 1
+    if dcn_total != num_slices:
+        raise ValueError(
+            f"dcn axes {dcn} cover {dcn_total} slices, have {num_slices}"
+        )
+    ici_spec = MeshSpec(
+        tuple((a, s) for a, s in spec.axes if a not in DCN_AXES)
+        or (("dp", -1),)
+    ).resolve(per_slice)
+    dcn_names = tuple(a for a in DCN_AXES if a in dcn)
+    names = dcn_names + ici_spec.names
+    final_shape = tuple(dcn[a] for a in dcn_names) + ici_spec.shape
+
+    real_multislice = all(
+        getattr(d, "slice_index", None) is not None for d in devices
+    ) and len({getattr(d, "slice_index", 0) for d in devices}) == num_slices
+    if real_multislice:
+        from jax.experimental import mesh_utils
+
+        # same-length vectors (the create_hybrid_device_mesh contract):
+        # dcn axes get ICI extent 1; ici axes get DCN extent 1
+        mesh_shape = (1,) * len(dcn_names) + ici_spec.shape
+        dcn_mesh_shape = tuple(dcn[a] for a in dcn_names) + (1,) * len(ici_spec.shape)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_mesh_shape, devices=devices
+        )
+    else:
+        # virtual devices: slice-major consecutive blocks
+        dev_array = np.array(devices).reshape(final_shape)
+    return Mesh(dev_array.reshape(final_shape), names)
 
 
 def get_mesh(name: str = "default") -> Mesh:

@@ -24,7 +24,7 @@ Rules = Dict[str, MeshAxes]
 #   batch over all data axes; params sharded over fsdp (ZeRO-3) and tp;
 #   sequence over sp for long-context; experts over ep.
 DEFAULT_RULES: Rules = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn_dp", "dp", "fsdp"),
     "seq": "sp",
     "embed": "fsdp",
     "heads": "tp",
@@ -33,7 +33,7 @@ DEFAULT_RULES: Rules = {
     "vocab": "tp",
     "expert": "ep",
     "expert_mlp": "tp",
-    "stage": "pp",
+    "stage": ("dcn_pp", "pp"),
     "norm": None,
 }
 

@@ -45,7 +45,9 @@ class TestMesh:
 
 class TestShardingRules:
     def test_spec_for(self):
-        assert spec_for(("batch", None, "mlp")) == PartitionSpec(("dp", "fsdp"), None, "tp")
+        assert spec_for(("batch", None, "mlp")) == PartitionSpec(
+            ("dcn_dp", "dp", "fsdp"), None, "tp"
+        )
 
     def test_mesh_filtering(self, cpu_mesh_devices):
         mesh = build_mesh(devices=cpu_mesh_devices, dp=8)  # no tp axis
@@ -159,3 +161,44 @@ class TestMoE:
         )
         assert out.shape == (T, D)
         assert bool(jnp.all(jnp.isfinite(out)))
+
+
+class TestHybridMesh:
+    """Multi-slice DCN axes (SURVEY 2.4-CP): dcn_dp spans slice boundaries,
+    everything else stays within a slice (ICI by construction)."""
+
+    def test_hybrid_mesh_axes_and_layout(self):
+        from ray_tpu.comm.mesh import build_hybrid_mesh
+
+        cpus = jax.devices("cpu")[:8]
+        mesh = build_hybrid_mesh(num_slices=2, devices=cpus, dcn_dp=2, fsdp=2, tp=2)
+        assert mesh.axis_names == ("dcn_dp", "fsdp", "tp")
+        assert mesh.devices.shape == (2, 2, 2)
+        # slice-major: all devices of dcn_dp index 0 form one contiguous slice
+        slice0 = {d.id for d in mesh.devices[0].flat}
+        slice1 = {d.id for d in mesh.devices[1].flat}
+        assert slice0 == {d.id for d in cpus[:4]}
+        assert slice1 == {d.id for d in cpus[4:]}
+
+    def test_sharded_train_step_over_dcn_dp(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import ray_tpu.train.lm as lm
+        from ray_tpu.comm.mesh import build_hybrid_mesh, set_mesh
+        from ray_tpu.models import get_config
+
+        cpus = jax.devices("cpu")[:8]
+        mesh = build_hybrid_mesh(num_slices=2, devices=cpus, dcn_dp=2, fsdp=2, tp=2)
+        set_mesh(mesh)
+        cfg = get_config("tiny-llama")
+        opt = lm.make_optimizer(total_steps=5)
+        state, _ = lm.init_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+        with mesh:
+            step = jax.jit(lm.make_train_step(cfg, opt), donate_argnums=0)
+            data = {k: jax.device_put(v, NamedSharding(mesh, P()))
+                    for k, v in lm.synthetic_batch(cfg, 8, 64).items()}
+            losses = []
+            for _ in range(3):
+                state, m = step(state, data)
+                losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]  # training progresses over dcn_dp x fsdp x tp
