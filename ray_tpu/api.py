@@ -259,6 +259,12 @@ class ActorMethod:
             raise TypeError(f"unsupported actor-method options: {sorted(kwargs)}")
         return ActorMethod(self._handle, self._name, num_returns)
 
+    def bind(self, *args):
+        """Bind into a compiled graph (see ray_tpu.dag)."""
+        from .dag import MethodNode
+
+        return MethodNode(self._handle, self._name, args)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str, max_task_retries: int = 0):

@@ -120,6 +120,16 @@ class _ActorRunner:
             item = self.mailbox.get()
             if item is None:
                 return
+            if item[0] == "__direct__":
+                # compiled-graph fast path (ray_tpu.dag): a pre-bound
+                # closure runs on the actor's lane with its instance,
+                # skipping spec/scheduling/store — actor-serial semantics
+                # are preserved because it's the same mailbox.
+                try:
+                    item[1](self.instance)
+                except Exception:  # noqa: BLE001 — closure handles user errors
+                    logger.exception("direct actor submit failed")
+                continue
             spec, release = item
             run_one(self, spec, release)
 
@@ -451,6 +461,15 @@ class NodeAgent:
         finally:
             with self._lock:
                 self._running.pop(spec.task_id, None)
+
+    def submit_direct(self, actor_id: ActorID, fn: Callable[[Any], None]) -> None:
+        """Enqueue fn(instance) on the actor's mailbox (compiled-graph path).
+        Raises if the actor is not alive here."""
+        with self._lock:
+            runner = self._actors.get(actor_id)
+        if runner is None or runner.dead:
+            raise WorkerCrashedError(f"actor {actor_id} is not alive on this node")
+        runner.mailbox.put(("__direct__", fn))
 
     def kill_actor(self, actor_id: ActorID, cause: str = "killed") -> bool:
         with self._lock:
