@@ -12,15 +12,39 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual CPU mesh regardless of ambient platform config: a real
+# chip behind a tunnel turns every small jitted call into a network round
+# trip and the suite is designed for the fake-mesh tier. Opt back into a
+# real platform with RAY_TPU_TEST_PLATFORM=axon (etc.).
+os.environ["JAX_PLATFORMS"] = os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu")
+# the axon sitecustomize force-registers a TPU platform when this is set,
+# overriding JAX_PLATFORMS=cpu (see test_bootstrap_multiproc.py)
+if os.environ["JAX_PLATFORMS"] == "cpu":
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
 
 import pytest  # noqa: E402
 
 import jax  # noqa: E402
 
+# The env var alone is NOT enough: the axon sitecustomize imports jax at
+# interpreter start, so jax snapshotted JAX_PLATFORMS before this file ran.
+# config.update is the post-import override.
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 # exact f32 matmuls so numerical tests compare real math, not rounding modes
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _mesh_registry_isolation():
+    """A mesh one test registers as the process default must not leak into
+    the next test's computations (constrain() falls back to the registry —
+    a stale 8-device mesh poisons single-device forwards)."""
+    yield
+    from ray_tpu.comm.mesh import registry
+
+    registry.clear()
 
 
 @pytest.fixture
