@@ -163,13 +163,22 @@ class CompiledDAG:
         # actor creation is async, so wait for placement first
         import time as _time
 
+        from .core.control_plane import ActorState
+
         self._agents = {}
         for node in self._nodes:
             deadline = _time.monotonic() + 30.0
             while True:
                 info = self._rt.control_plane.get_actor(node.handle._actor_id)
-                if info is not None and info.node_id is not None:
+                # wait for ALIVE, not just placement: node_id is recorded at
+                # STARTING (scheduling time), but the agent's runner only
+                # exists once __init__ finishes — submit_direct against a
+                # STARTING actor raises "not alive on this node"
+                if (info is not None and info.node_id is not None
+                        and info.state is ActorState.ALIVE):
                     break
+                if info is not None and info.state is ActorState.DEAD:
+                    raise ValueError(f"actor for {node.method} is dead")
                 if _time.monotonic() > deadline:
                     raise ValueError(
                         f"actor for {node.method} never became alive"
