@@ -2,8 +2,18 @@
 EnvRunner sampling actors + jitted learner updates; PPO for control, GRPO
 for LLM RLHF (BASELINE workload #5)."""
 
+from .dqn import DQN, DQNConfig  # noqa: F401
 from .env import CartPole, Env, GymWrapper  # noqa: F401
 from .env_runner import EnvRunner, EnvRunnerGroup  # noqa: F401
 from .grpo import GRPO, GRPOConfig  # noqa: F401
 from .module import init_mlp_module, mlp_forward, mlp_forward_np  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiCartPole,
+)
+from .offline import BC, BCConfig, load_offline_dataset, rollouts_to_dataset, save_rollouts  # noqa: F401
 from .ppo import PPO, PPOConfig, compute_gae  # noqa: F401
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer, SumTree  # noqa: F401

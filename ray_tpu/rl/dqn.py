@@ -1,0 +1,165 @@
+"""DQN (reference: `rllib/algorithms/dqn/` — double-DQN target, epsilon
+-greedy collection, optional prioritized replay).
+
+Same EnvRunnerGroup as PPO does the sampling (epsilon-greedy over the
+module's logits read as Q-values); the learner update is one jitted
+function, so on TPU it shards over the gang mesh like any train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.logging import get_logger
+from .env_runner import EnvRunnerGroup
+from .module import init_mlp_module, mlp_forward, mlp_forward_np
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+logger = get_logger("rl.dqn")
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env_fn: Callable[[], Any] = None
+    num_env_runners: int = 1
+    rollout_steps_per_runner: int = 256
+    buffer_capacity: int = 50_000
+    learning_starts: int = 512
+    lr: float = 1e-3
+    gamma: float = 0.99
+    batch_size: int = 64
+    sgd_steps_per_iter: int = 64
+    target_update_freq: int = 500  # in gradient steps
+    double_dqn: bool = True
+    prioritized: bool = False
+    prio_alpha: float = 0.6
+    prio_beta: float = 0.4
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 5_000  # in env steps
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        assert config.env_fn is not None, "DQNConfig.env_fn required"
+        self.config = config
+        env = config.env_fn()
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_mlp_module(
+            key, env.observation_size, env.num_actions, config.hidden
+        )
+        self.target_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        if config.prioritized:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, config.prio_alpha, config.prio_beta,
+                seed=config.seed,
+            )
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.runners = EnvRunnerGroup(
+            config.env_fn, mlp_forward_np, config.num_env_runners, config.seed
+        )
+        self._update = self._build_update()
+        self.iteration = 0
+        self.env_steps = 0
+        self.grad_steps = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        cfg = self.config
+
+        def loss_fn(params, target_params, batch):
+            q, _ = mlp_forward(params, batch["obs"])
+            q_a = jnp.take_along_axis(q, batch["actions"][:, None], axis=-1)[:, 0]
+            next_q_t, _ = mlp_forward(target_params, batch["next_obs"])
+            if cfg.double_dqn:
+                next_q_o, _ = mlp_forward(params, batch["next_obs"])
+                next_a = jnp.argmax(next_q_o, axis=-1)
+                next_v = jnp.take_along_axis(next_q_t, next_a[:, None], axis=-1)[:, 0]
+            else:
+                next_v = jnp.max(next_q_t, axis=-1)
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = batch["rewards"] + cfg.gamma * nonterminal * next_v
+            td = q_a - jax.lax.stop_gradient(target)
+            loss = jnp.mean(batch["weights"] * optax.huber_loss(td))
+            return loss, td
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        return update
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: epsilon-greedy rollouts -> buffer -> SGD steps."""
+        cfg = self.config
+        rollouts = self.runners.sample(
+            cfg.rollout_steps_per_runner, self.params, epsilon=self.epsilon
+        )
+        if not rollouts:
+            raise RuntimeError("all env runners failed")
+        ep_returns: List[float] = []
+        for ro in rollouts:
+            self.buffer.add_batch({
+                "obs": ro["obs"], "actions": ro["actions"],
+                "rewards": ro["rewards"], "dones": ro["dones"],
+                "next_obs": ro["next_obs"],
+            })
+            self.env_steps += len(ro["obs"])
+            ep_returns.extend(ro["episode_returns"].tolist())
+
+        losses = []
+        if len(self.buffer) >= max(cfg.learning_starts, cfg.batch_size):
+            for _ in range(cfg.sgd_steps_per_iter):
+                if cfg.prioritized:
+                    batch, idx, weights = self.buffer.sample(cfg.batch_size)
+                else:
+                    batch = self.buffer.sample(cfg.batch_size)
+                    idx, weights = None, np.ones(cfg.batch_size, np.float32)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                jb["weights"] = jnp.asarray(weights)
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, jb
+                )
+                if cfg.prioritized:
+                    self.buffer.update_priorities(idx, np.asarray(td))
+                self.grad_steps += 1
+                if self.grad_steps % cfg.target_update_freq == 0:
+                    self.target_params = self.params
+                losses.append(float(loss))
+
+        self.iteration += 1
+        self._recent_returns.extend(ep_returns)
+        self._recent_returns = self._recent_returns[-100:]
+        return {
+            "training_iteration": self.iteration,
+            "env_steps": self.env_steps,
+            "grad_steps": self.grad_steps,
+            "epsilon": self.epsilon,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "buffer_size": len(self.buffer),
+            "episodes_this_iter": len(ep_returns),
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else 0.0,
+        }

@@ -39,21 +39,33 @@ class EnvRunner:
         self.params = jax.tree.map(np.asarray, params)
         return True
 
-    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+    def sample(
+        self, num_steps: int, epsilon: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
+        """Roll out num_steps. Default exploration samples from
+        softmax(logits) (on-policy: PPO); epsilon-greedy over the logits
+        (read as Q-values) when `epsilon` is given (off-policy: DQN)."""
         assert self.params is not None, "set_weights before sample"
         obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
+        next_l = []
         completed = []
         for _ in range(num_steps):
             logits, value = self.forward(self.params, self._obs[None])
             logits = np.asarray(logits[0], np.float64)
             p = np.exp(logits - logits.max())
             p /= p.sum()
-            a = int(self.rng.choice(len(p), p=p))
+            if epsilon is None:
+                a = int(self.rng.choice(len(p), p=p))
+            elif self.rng.random() < epsilon:
+                a = int(self.rng.integers(len(p)))
+            else:
+                a = int(np.argmax(logits))
             obs_l.append(self._obs)
             act_l.append(a)
             logp_l.append(np.log(p[a] + 1e-12))
             val_l.append(float(value[0]))
             nxt, r, term, trunc, _ = self.env.step(a)
+            next_l.append(np.asarray(nxt, np.float32))
             self._ep_return += r
             rew_l.append(r)
             done_l.append(term or trunc)
@@ -71,6 +83,7 @@ class EnvRunner:
             "actions": np.asarray(act_l, np.int32),
             "rewards": np.asarray(rew_l, np.float32),
             "dones": np.asarray(done_l, np.bool_),
+            "next_obs": np.asarray(next_l, np.float32),
             "logp": np.asarray(logp_l, np.float32),
             "values": np.asarray(val_l, np.float32),
             "bootstrap_value": float(tail_v[0]),
@@ -107,10 +120,12 @@ class EnvRunnerGroup:
                 logger.warning("env runner %d dead on sync (%s); restarting", i, e)
                 self._restart(i, params)
 
-    def sample(self, steps_per_runner: int, params=None) -> List[Dict[str, np.ndarray]]:
+    def sample(
+        self, steps_per_runner: int, params=None, epsilon: Optional[float] = None
+    ) -> List[Dict[str, np.ndarray]]:
         if params is not None:
             self.sync_weights(params)
-        refs = [r.sample.remote(steps_per_runner) for r in self.runners]
+        refs = [r.sample.remote(steps_per_runner, epsilon) for r in self.runners]
         out: List[Dict[str, np.ndarray]] = []
         for i, ref in enumerate(refs):
             try:

@@ -1,0 +1,104 @@
+"""Offline RL data path (reference: `rllib/offline/` — offline data via
+Ray Data) + behavior cloning (`rllib/algorithms/bc/`).
+
+Rollouts are persisted through `ray_tpu.data` (parquet columns per
+transition), so offline training streams the same Dataset machinery as
+any other ingest: read_parquet -> iter_batches -> jitted update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import data as rt_data
+from .module import init_mlp_module, mlp_forward
+
+
+def rollouts_to_dataset(rollouts: Iterable[Dict[str, np.ndarray]]):
+    """Flat rollouts (EnvRunner.sample output) -> row-wise Dataset of
+    {obs, action, reward, done, next_obs} transitions."""
+    rows: List[Dict[str, Any]] = []
+    for ro in rollouts:
+        for t in range(len(ro["obs"])):
+            rows.append({
+                "obs": np.asarray(ro["obs"][t], np.float32),
+                "action": int(ro["actions"][t]),
+                "reward": float(ro["rewards"][t]),
+                "done": bool(ro["dones"][t]),
+                "next_obs": np.asarray(ro["next_obs"][t], np.float32),
+            })
+    return rt_data.from_items(rows)
+
+
+def save_rollouts(rollouts: Iterable[Dict[str, np.ndarray]], path: str) -> None:
+    """Persist rollouts as parquet (obs vectors as arrow list columns)."""
+    rollouts_to_dataset(rollouts).write_parquet(path)
+
+
+def load_offline_dataset(path: str):
+    """Read transitions back; obs columns restored to float32 arrays."""
+    ds = rt_data.read_parquet(path)
+    return ds.map(lambda r: {**r, "obs": np.asarray(r["obs"], np.float32),
+                             "next_obs": np.asarray(r["next_obs"], np.float32)})
+
+
+@dataclasses.dataclass
+class BCConfig:
+    obs_size: int = 4
+    num_actions: int = 2
+    lr: float = 1e-3
+    batch_size: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+
+class BC:
+    """Behavior cloning: cross-entropy on (obs, action) pairs from an
+    offline Dataset."""
+
+    def __init__(self, config: BCConfig):
+        self.config = config
+        self.params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), config.obs_size,
+            config.num_actions, config.hidden,
+        )
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(params, obs, actions):
+            logits, _ = mlp_forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+            return jnp.mean(nll)
+
+        @jax.jit
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = update
+
+    def train_epoch(self, dataset) -> Dict[str, float]:
+        """One pass over the offline dataset; returns mean loss + accuracy."""
+        losses: List[float] = []
+        correct = 0
+        total = 0
+        for batch in dataset.iter_batches(batch_size=self.config.batch_size):
+            obs = jnp.asarray(np.asarray(batch["obs"], np.float32))
+            actions = jnp.asarray(np.asarray(batch["action"], np.int32))
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, obs, actions
+            )
+            losses.append(float(loss))
+            logits, _ = mlp_forward(self.params, obs)
+            correct += int(jnp.sum(jnp.argmax(logits, -1) == actions))
+            total += len(actions)
+        return {"loss": float(np.mean(losses)), "accuracy": correct / max(1, total)}
