@@ -5,8 +5,9 @@ windows; `iter_device_batches` double-buffers host→HBM transfers so TPU
 steps never stall on input.
 """
 
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum  # noqa: F401
 from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
-from .dataset import Dataset  # noqa: F401
+from .dataset import Dataset, GroupedData  # noqa: F401
 from .iterator import DataIterator  # noqa: F401
 from .read_api import (  # noqa: F401
     from_items,

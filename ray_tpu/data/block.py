@@ -119,7 +119,13 @@ class BlockAccessor:
             import pyarrow as pa
 
             if acc.is_tabular:
-                return pa.table({k: pa.array(np.asarray(v)) for k, v in block.items()})
+                cols = {}
+                for k, v in block.items():
+                    a = np.asarray(v)
+                    # multi-dim columns go through list-of-lists (arrow has
+                    # no native ndarray column; round-trips as list<item>)
+                    cols[k] = pa.array(a.tolist() if a.ndim > 1 else a)
+                return pa.table(cols)
             raise ValueError("pyarrow batches need tabular data")
         raise ValueError(f"unknown batch_format {batch_format!r}")
 

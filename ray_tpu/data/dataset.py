@@ -16,7 +16,9 @@ from .. import api
 from .block import BlockAccessor, BlockMetadata
 from .executor import StreamingExecutor
 from .iterator import DataIterator
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
 from .logical import (
+    Aggregate,
     Filter,
     FlatMap,
     InputData,
@@ -28,6 +30,8 @@ from .logical import (
     Read,
     Repartition,
     Sort,
+    Union,
+    Zip,
 )
 
 
@@ -67,6 +71,44 @@ class Dataset:
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return Dataset(self._plan.with_op(Repartition("repartition", num_blocks)))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Lazy concatenation: streams this dataset's blocks, then each
+        other's (reference: `Dataset.union`)."""
+        plans = [self._plan] + [o._plan for o in others]
+        return Dataset(LogicalPlan([Union("union", plans=plans)]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise positional join; duplicate columns from `other` get
+        a `_1` suffix (reference: `Dataset.zip`)."""
+        return Dataset(self._plan.with_op(Zip("zip", other=other._plan)))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def aggregate(self, *fns: AggregateFn) -> Dict[str, Any]:
+        """Global aggregation -> {out_name: value} (reference:
+        `Dataset.aggregate`)."""
+        ds = Dataset(self._plan.with_op(Aggregate("aggregate", key=None, fns=fns)))
+        rows = ds.take_all()
+        if not rows:
+            return {}
+        return {k: v for k, v in rows[0].items()}
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(Std(on, ddof)).get(f"std({on})")
 
     def sort(self, key: Optional[str] = None, descending: bool = False) -> "Dataset":
         return Dataset(self._plan.with_op(Sort("sort", key, descending)))
@@ -177,6 +219,80 @@ class Dataset:
             df = BlockAccessor.batch_of(api.get(ref), "pandas")
             df.to_csv(os.path.join(path, f"part-{i:05d}.csv"), index=False)
 
+    def write_json(self, path: str) -> None:
+        """JSONL, one file per block (reference: `Dataset.write_json`)."""
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+        def plain(v):
+            if isinstance(v, np.generic):
+                return v.item()
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            return v
+
+        for i, ref in enumerate(self._stream_refs()):
+            acc = BlockAccessor(api.get(ref))
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for row in acc.iter_rows():
+                    if isinstance(row, dict):
+                        row = {k: plain(v) for k, v in row.items()}
+                    f.write(json.dumps(row) + "\n")
+
     def __repr__(self):
         ops = " -> ".join(op.name for op in self._plan.operators)
         return f"Dataset({ops})"
+
+
+class GroupedData:
+    """Keyed aggregation surface (reference: `grouped_data.py ::
+    GroupedData`). Result is a Dataset with one row per group, sorted by
+    the group key."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *fns: AggregateFn) -> Dataset:
+        return Dataset(
+            self._ds._plan.with_op(Aggregate("groupby", key=self._key, fns=fns))
+        )
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str, ddof: int = 1) -> Dataset:
+        return self.aggregate(Std(on, ddof))
+
+    def map_groups(self, fn: Callable[[Any], Any]) -> Dataset:
+        """Apply fn to each group's batch (columnar dict) and concat the
+        results (reference: `GroupedData.map_groups`). Runs after a sort
+        barrier so each group is contiguous."""
+        key = self._key
+
+        def apply(batch):
+            keys = np.asarray(batch[key])
+            uniq = np.unique(keys)
+            outs = []
+            for g in uniq:
+                idx = np.nonzero(keys == g)[0]
+                piece = {k: np.asarray(v)[idx] for k, v in batch.items()}
+                outs.append(BlockAccessor.normalize(fn(piece)))
+            return BlockAccessor.concat(outs)
+
+        sorted_ds = self._ds.sort(key)
+        return sorted_ds.map_batches(apply, batch_size=None)

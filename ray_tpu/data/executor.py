@@ -18,7 +18,9 @@ import numpy as np
 from .. import api
 from ..core.logging import get_logger
 from .block import Block, BlockAccessor
+from .aggregate import finalize, merge_partials, partial_aggregate
 from .logical import (
+    Aggregate,
     InputData,
     Limit,
     LogicalPlan,
@@ -26,6 +28,8 @@ from .logical import (
     Read,
     Repartition,
     Sort,
+    Union,
+    Zip,
     fuse,
 )
 
@@ -72,6 +76,32 @@ def _sort_block(block: Block, key: Optional[str], descending: bool) -> Block:
 
 
 @api.remote
+def _partial_agg(block: Block, key, fns):
+    return partial_aggregate(block, key, list(fns))
+
+
+@api.remote
+def _combine_agg(key, fns, *partials):
+    return finalize(merge_partials(list(partials), list(fns)), key, list(fns))
+
+
+@api.remote
+def _zip_blocks(left: Block, right: Block) -> Block:
+    la, ra = BlockAccessor(left), BlockAccessor(right)
+    if la.num_rows() != ra.num_rows():
+        raise ValueError(
+            f"zip row mismatch: {la.num_rows()} vs {ra.num_rows()}"
+        )
+    if not (la.is_tabular and ra.is_tabular):
+        raise TypeError("zip needs tabular blocks on both sides")
+    out = {k: np.asarray(v) for k, v in left.items()}
+    for k, v in right.items():
+        name = k if k not in out else f"{k}_1"  # reference disambiguation
+        out[name] = np.asarray(v)
+    return out
+
+
+@api.remote
 def _block_meta(block: Block):
     m = BlockAccessor(block).metadata()
     return (m.num_rows, m.size_bytes, m.schema)
@@ -109,6 +139,11 @@ class StreamingExecutor:
             stream: Iterator[Any] = gen()
         elif isinstance(source, InputData):
             stream = iter(list(source.blocks))
+        elif isinstance(source, Union):
+            def gen_union():
+                for plan in source.plans:
+                    yield from StreamingExecutor(plan, self.max_in_flight).execute()
+            stream = gen_union()
         else:
             raise TypeError(f"bad source {source}")
 
@@ -123,6 +158,10 @@ class StreamingExecutor:
                 stream = self._sort(stream, seg)
             elif isinstance(seg, Limit):
                 stream = self._limit(stream, seg.limit)
+            elif isinstance(seg, Aggregate):
+                stream = self._aggregate(stream, seg)
+            elif isinstance(seg, Zip):
+                stream = self._zip(stream, seg)
             else:
                 raise TypeError(f"bad segment {seg}")
         return stream
@@ -221,6 +260,25 @@ class StreamingExecutor:
         refs = list(upstream)
         merged = _concat_blocks.remote(*refs)
         return iter([_sort_block.remote(merged, op.key, op.descending)])
+
+    def _aggregate(self, upstream: Iterator[Any], op: Aggregate) -> Iterator[Any]:
+        """Tree: per-block partial states (parallel) -> one combine task."""
+        fns = tuple(op.fns)
+        partials = [_partial_agg.remote(ref, op.key, fns) for ref in upstream]
+        if not partials:
+            return iter([])
+        return iter([_combine_agg.remote(op.key, fns, *partials)])
+
+    def _zip(self, upstream: Iterator[Any], op: Zip) -> Iterator[Any]:
+        """Positional zip: both sides collapse to one block each, then a
+        column merge (reference zips aligned block pairs; a single pair is
+        the faithful degenerate case for in-memory scale)."""
+        left = _concat_blocks.remote(*list(upstream))
+        right_refs = list(
+            StreamingExecutor(op.other, self.max_in_flight).execute()
+        )
+        right = _concat_blocks.remote(*right_refs)
+        return iter([_zip_blocks.remote(left, right)])
 
 
 def _take_rows(n: int):

@@ -81,6 +81,31 @@ class Sort(Operator):
 
 
 @dataclasses.dataclass
+class Aggregate(Operator):
+    """Groupby/global aggregation barrier (reference: `Dataset.groupby` +
+    `aggregate.py`); key=None aggregates the whole dataset to one row."""
+
+    key: Optional[str] = None
+    fns: Sequence[Any] = ()
+
+
+@dataclasses.dataclass
+class Union(Operator):
+    """Source combinator: streams this plan's blocks, then each other
+    plan's (reference: `Dataset.union`)."""
+
+    plans: Sequence["LogicalPlan"] = ()
+
+
+@dataclasses.dataclass
+class Zip(Operator):
+    """Barrier: column-wise join with another dataset by row position
+    (reference: `Dataset.zip`)."""
+
+    other: "LogicalPlan" = None
+
+
+@dataclasses.dataclass
 class LogicalPlan:
     operators: List[Operator] = dataclasses.field(default_factory=list)
 

@@ -1,6 +1,8 @@
 """Data library tests: plan fusion, streaming execution, shuffles,
 iteration, splits, file IO, and device prefetch."""
 
+import builtins
+
 import numpy as np
 import pytest
 
@@ -189,3 +191,90 @@ class TestIO:
     def test_from_numpy(self):
         ds = data.from_numpy({"x": np.arange(10)})
         assert ds.count() == 10
+
+
+class TestAggregates:
+    def test_global_aggregates(self, ray_start_regular):
+        ds = data.from_items(
+            [{"x": float(i), "g": i % 3} for i in range(12)], parallelism=4
+        )
+        assert ds.sum("x") == sum(float(i) for i in range(12))
+        assert ds.min("x") == 0.0
+        assert ds.max("x") == 11.0
+        assert abs(ds.mean("x") - 5.5) < 1e-9
+        assert abs(ds.std("x") - np.std(np.arange(12.0), ddof=1)) < 1e-9
+
+    def test_groupby_aggregate_matches_numpy(self, ray_start_regular):
+        ds = data.from_items(
+            [{"x": float(i), "g": i % 3} for i in range(12)], parallelism=4
+        )
+        rows = ds.groupby("g").aggregate(
+            data.Count(), data.Sum("x"), data.Mean("x")
+        ).take_all()
+        assert [r["g"] for r in rows] == [0, 1, 2]
+        for r in rows:
+            vals = np.array([float(i) for i in range(12) if i % 3 == r["g"]])
+            assert r["count()"] == len(vals)
+            assert r["sum(x)"] == vals.sum()
+            assert abs(r["mean(x)"] - vals.mean()) < 1e-9
+
+    def test_groupby_partial_merge_exact_std(self, ray_start_regular):
+        # group split across blocks: moment merge must be exact
+        vals = np.arange(40.0)
+        ds = data.from_items([{"x": v, "g": 0} for v in vals], parallelism=8)
+        row = ds.groupby("g").std("x").take_all()[0]
+        assert abs(row["std(x)"] - np.std(vals, ddof=1)) < 1e-9
+
+    def test_map_groups(self, ray_start_regular):
+        ds = data.from_items(
+            [{"x": float(i), "g": i % 2} for i in range(10)], parallelism=3
+        )
+        out = ds.groupby("g").map_groups(
+            lambda batch: {"g": batch["g"][:1], "n": np.array([len(batch["x"])])}
+        ).take_all()
+        assert sorted((int(r["g"]), int(r["n"])) for r in out) == [(0, 5), (1, 5)]
+
+
+class TestUnionZip:
+    def test_union_streams_both(self, ray_start_regular):
+        a = data.range(5, parallelism=2)
+        b = data.range(3, parallelism=2).map(lambda r: {"id": r["id"] + 100})
+        u = a.union(b)
+        ids = sorted(int(r["id"]) for r in u.take_all())
+        assert ids == [0, 1, 2, 3, 4, 100, 101, 102]
+
+    def test_union_then_transform(self, ray_start_regular):
+        u = data.range(4).union(data.range(4))
+        assert u.map(lambda r: {"id": r["id"] * 2}).count() == 8
+
+    def test_zip_merges_columns(self, ray_start_regular):
+        a = data.from_numpy({"x": np.arange(6)})
+        b = data.from_numpy({"y": np.arange(6) * 10})
+        rows = a.zip(b).take_all()
+        assert all(int(r["y"]) == int(r["x"]) * 10 for r in rows)
+
+    def test_zip_duplicate_column_suffix(self, ray_start_regular):
+        a = data.from_numpy({"x": np.arange(4)})
+        b = data.from_numpy({"x": np.arange(4) + 1})
+        rows = a.zip(b).take_all()
+        assert all(int(r["x_1"]) == int(r["x"]) + 1 for r in rows)
+
+    def test_zip_length_mismatch_raises(self, ray_start_regular):
+        import ray_tpu
+
+        a = data.from_numpy({"x": np.arange(4)})
+        b = data.from_numpy({"y": np.arange(5)})
+        with pytest.raises(ray_tpu.RayTaskError):
+            a.zip(b).take_all()
+
+
+class TestWriteJson:
+    def test_roundtrip(self, ray_start_regular, tmp_path):
+        p = str(tmp_path / "out")
+        data.from_items(
+            [{"a": i, "v": [i, i + 1]} for i in range(6)], parallelism=2
+        ).write_json(p)
+        back = data.read_json(p)
+        rows = sorted(back.take_all(), key=lambda r: r["a"])
+        assert [r["a"] for r in rows] == list(builtins.range(6))
+        assert list(rows[2]["v"]) == [2, 3]
