@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.logging import get_logger
+from ..core.metrics import Counter, Gauge, Histogram
 from ..models import ModelConfig
 from ..models.transformer import (
     _dense_ffn,
@@ -52,6 +53,18 @@ from ..models.transformer import (
 from ..ops import apply_rope, paged_attention_decode, rope_frequencies
 
 logger = get_logger("serve.engine")
+
+# Prometheus plane (reference: serve's autoscaling/ongoing-request metrics
+# + vLLM's engine stats): scraped via util.state.start_metrics_server.
+_m_requests = Counter("serve_requests_finished",
+                      "Engine requests finished, by finish_reason.")
+_m_running = Gauge("serve_requests_running",
+                   "Requests currently admitted to decode slots.")
+_m_tokens = Counter("serve_tokens_generated", "Tokens emitted by the engine.")
+_m_ttft = Histogram(
+    "serve_ttft_seconds", "Time to first token.",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
 
 
 @dataclasses.dataclass
@@ -291,13 +304,28 @@ class InferenceEngine:
 
         def for_span(n_steps: int):
             if n_steps not in cache:
-                cache[n_steps] = jax.jit(
+                cache[n_steps] = self._under_mesh(jax.jit(
                     functools.partial(decode_span, n_steps=n_steps),
                     donate_argnums=(1, 2),
-                )
+                ))
             return cache[n_steps]
 
         return for_span
+
+    def _under_mesh(self, fn):
+        """Trace/execute under THIS engine's mesh context, so in-jit
+        sharding constraints resolve against it — never against whatever
+        mesh some other component registered as the process default
+        (parallel/sharding.py:_current_mesh falls back to the registry)."""
+        if self.mesh is None:
+            return fn
+
+        @functools.wraps(fn)
+        def call(*args, **kwargs):
+            with self.mesh:
+                return fn(*args, **kwargs)
+
+        return call
 
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill_cache:
@@ -308,7 +336,7 @@ class InferenceEngine:
                     params, cfg, tokens, max_len=bucket, last_index=true_len - 1
                 )
 
-            self._prefill_cache[bucket] = jax.jit(run)
+            self._prefill_cache[bucket] = self._under_mesh(jax.jit(run))
         return self._prefill_cache[bucket]
 
     def _scatter_prefill(self, cache, pages: List[int], true_len: int):
@@ -436,6 +464,8 @@ class InferenceEngine:
         # the first generated token: one small readback, on THIS thread
         first = _sample_host(np.asarray(logits[0]), req.temperature)
         req.first_token_at = time.monotonic()
+        _m_ttft.observe(req.first_token_at - req.submitted_at)
+        _m_tokens.inc()
         req.output.append(int(first))
         eos = self.ecfg.eos_token_id
         if eos is None or int(first) != eos:  # eos is control, not content
@@ -462,6 +492,7 @@ class InferenceEngine:
             slot.generated = 1
             self._maybe_finish(slot, req.output[-1])
             installed = True
+            _m_running.set(sum(1 for s in self.slots if s.request is not None))
 
     # ------------------------------------------------------------- stepping
 
@@ -510,6 +541,7 @@ class InferenceEngine:
                 if s.generated < s.request.max_tokens and not s.request.done.is_set():
                     s.request.output.append(tok)
                     s.generated += 1
+                    _m_tokens.inc()
                     eos = self.ecfg.eos_token_id
                     if eos is None or tok != eos:  # eos is control, not content
                         s.request._emit(tok)
@@ -524,6 +556,7 @@ class InferenceEngine:
         stopped = eos is not None and last_tok == eos
         if slot.generated >= req.max_tokens or stopped:
             req.finish_reason = "stop" if stopped else "length"
+            _m_requests.inc(tags={"finish_reason": req.finish_reason})
             if eos is not None and req.output and req.output[-1] == eos:
                 req.output.pop()
             req.finished_at = time.monotonic()
@@ -536,6 +569,7 @@ class InferenceEngine:
             slot.pages = []
             slot.position = 0
             slot.generated = 0
+            _m_running.set(sum(1 for s in self.slots if s.request is not None))
             if waiting:
                 # capacity freed: give page-starved requests another pass
                 # (the prefill thread blocks on pending, so the put wakes it)

@@ -25,9 +25,11 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, controller=None, method: str = "__call__"):
+    def __init__(self, deployment_name: str, controller=None, method: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._method = method
+        self._multiplexed_model_id = multiplexed_model_id
         self._controller = controller
         self._router = Pow2Router(deployment_name)
         self._last_sync = 0.0
@@ -50,8 +52,15 @@ class DeploymentHandle:
         )
         self._router.update_replicas(replicas, version)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self._controller, method_name)
+    def options(self, method_name: Optional[str] = None, *,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name,
+            self._controller,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
+        )
         h._router = self._router
         h._last_sync = self._last_sync
         return h
@@ -61,7 +70,9 @@ class DeploymentHandle:
         deadline = time.monotonic() + 30.0
         while True:
             try:
-                ref = self._router.assign(self._method, args, kwargs)
+                ref = self._router.assign(
+                    self._method, args, kwargs, self._multiplexed_model_id
+                )
                 return DeploymentResponse(ref)
             except RuntimeError:
                 if time.monotonic() > deadline:

@@ -30,10 +30,14 @@ class ServeReplica:
             self._callable = cls_or_fn
             self._is_function = True
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = "") -> Any:
+        from .multiplex import _current_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _current_model_id.set(multiplexed_model_id)
         try:
             if self._is_function:
                 target = self._callable
@@ -41,8 +45,23 @@ class ServeReplica:
                 target = getattr(self._callable, method or "__call__")
             return target(*args, **(kwargs or {}))
         finally:
+            _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    def loaded_model_ids(self) -> list:
+        """Model ids resident in any multiplex cache on this replica."""
+        if self._is_function:
+            return []
+        ids = []
+        for name in dir(type(self._callable)):
+            fn = getattr(type(self._callable), name, None)
+            attr = getattr(fn, "__multiplex_cache_attr__", None)
+            if attr is not None:
+                cache = getattr(self._callable, attr, None)
+                if cache is not None:
+                    ids.extend(cache.model_ids())
+        return ids
 
     def queue_len(self) -> int:
         with self._lock:
@@ -50,11 +69,15 @@ class ServeReplica:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "deployment": self.deployment_name,
                 "ongoing": self._ongoing,
                 "total": self._total,
             }
+        models = self.loaded_model_ids()
+        if models:  # surfaced via controller status / state API
+            out["multiplexed_models"] = models
+        return out
 
     def health_check(self) -> bool:
         chk = getattr(self._callable, "check_health", None)

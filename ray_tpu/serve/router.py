@@ -23,6 +23,7 @@ class Pow2Router:
         self._inflight: Dict[int, List[Any]] = {}  # replica idx -> refs
         self._lock = threading.Lock()
         self._version = -1
+        self._model_affinity: Dict[str, int] = {}  # model id -> replica idx
 
     def update_replicas(self, replicas: List[Any], version: int) -> None:
         with self._lock:
@@ -31,6 +32,7 @@ class Pow2Router:
             self._replicas = list(replicas)
             self._inflight = {i: [] for i in range(len(replicas))}
             self._version = version
+            self._model_affinity: Dict[str, int] = {}
 
     def _load(self, idx: int) -> int:
         refs = self._inflight.get(idx, [])
@@ -39,19 +41,36 @@ class Pow2Router:
             self._inflight[idx] = pending
         return len(self._inflight.get(idx, []))
 
-    def assign(self, method: str, args: tuple, kwargs: dict):
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               multiplexed_model_id: str = ""):
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(
                     f"no replicas available for {self.deployment_name!r}"
                 )
-            if n == 1:
-                idx = 0
-            else:
-                a, b = random.sample(range(n), 2)
-                idx = a if self._load(a) <= self._load(b) else b
+            idx = None
+            if multiplexed_model_id:
+                # model-affinity first (reference: multiplexed routing
+                # prefers replicas with the model resident), unless that
+                # replica is clearly the long queue
+                cand = self._model_affinity.get(multiplexed_model_id)
+                if cand is not None and cand < n:
+                    others = [i for i in range(n) if i != cand]
+                    probe = random.choice(others) if others else cand
+                    if self._load(cand) <= self._load(probe) + 2:
+                        idx = cand
+            if idx is None:
+                if n == 1:
+                    idx = 0
+                else:
+                    a, b = random.sample(range(n), 2)
+                    idx = a if self._load(a) <= self._load(b) else b
+            if multiplexed_model_id:
+                self._model_affinity[multiplexed_model_id] = idx
             replica = self._replicas[idx]
-            ref = replica.handle_request.remote(method, args, kwargs)
+            ref = replica.handle_request.remote(
+                method, args, kwargs, multiplexed_model_id
+            )
             self._inflight[idx].append(ref)
             return ref

@@ -523,3 +523,110 @@ class TestOpenAI:
         text = "".join(c["choices"][0]["text"] for c in chunks)
         out = _post(port, "/v1/completions", {"prompt": "hi", "max_tokens": 4})
         assert text == out["result"]["choices"][0]["text"]
+
+
+class TestMultiplex:
+    def test_lru_load_and_evict(self, serve_session):
+        loads = []
+
+        @serve.deployment(num_replicas=1)
+        class Multi:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                loads.append(model_id)
+                return {"id": model_id}
+
+            def __call__(self, request):
+                mid = serve.get_multiplexed_model_id()
+                model = self.get_model(mid)
+                return {"served_by": model["id"], "ctx": mid}
+
+        handle = serve.run(Multi.bind(), name="multi")
+        h_a = handle.options(multiplexed_model_id="a")
+        h_b = handle.options(multiplexed_model_id="b")
+        h_c = handle.options(multiplexed_model_id="c")
+
+        assert h_a.remote({}).result()["served_by"] == "a"
+        assert h_b.remote({}).result()["served_by"] == "b"
+        assert h_a.remote({}).result()["ctx"] == "a"  # cache hit
+        assert loads == ["a", "b"]
+        # third model evicts the LRU ("b" was most recent before "c")
+        assert h_c.remote({}).result()["served_by"] == "c"
+        assert loads == ["a", "b", "c"]
+        assert h_b.remote({}).result()["served_by"] == "b"  # reload
+        assert loads == ["a", "b", "c", "b"]
+
+    def test_model_affinity_routing(self, serve_session):
+        import ray_tpu
+
+        @serve.deployment(num_replicas=2)
+        class Who:
+            def __init__(self):
+                import os
+                self.me = os.getpid(), id(self)
+
+            @serve.multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id: str):
+                return model_id
+
+            def __call__(self, request):
+                self.get_model(serve.get_multiplexed_model_id())
+                return {"replica": repr(self.me)}
+
+        handle = serve.run(Who.bind(), name="who")
+        h_m = handle.options(multiplexed_model_id="m1")
+        first = h_m.remote({}).result()["replica"]
+        # subsequent m1 requests stick to the replica that loaded m1
+        for _ in range(6):
+            assert h_m.remote({}).result()["replica"] == first
+
+    def test_unload_hook_called(self, serve_session):
+        unloaded = []
+
+        class Model:
+            def __init__(self, mid):
+                self.mid = mid
+
+            def unload(self):
+                unloaded.append(self.mid)
+
+        @serve.deployment(num_replicas=1)
+        class Multi:
+            @serve.multiplexed(max_num_models_per_replica=1)
+            def get_model(self, model_id: str):
+                return Model(model_id)
+
+            def __call__(self, request):
+                return self.get_model(serve.get_multiplexed_model_id()).mid
+
+        handle = serve.run(Multi.bind(), name="mx")
+        assert handle.options(multiplexed_model_id="m1").remote({}).result() == "m1"
+        assert handle.options(multiplexed_model_id="m2").remote({}).result() == "m2"
+        assert unloaded == ["m1"]
+
+    def test_concurrent_same_model_loads_once(self, serve_session):
+        import threading as _threading
+
+        loads = []
+        gate = _threading.Event()
+
+        @serve.deployment(num_replicas=1, max_ongoing_requests=4)
+        class Slow:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                loads.append(model_id)
+                gate.wait(timeout=10)  # hold the load so requests overlap
+                return model_id
+
+            def __call__(self, request):
+                return self.get_model(serve.get_multiplexed_model_id())
+
+        handle = serve.run(Slow.bind(), name="slowmx")
+        h = handle.options(multiplexed_model_id="m1")
+        responses = [h.remote({}) for _ in range(3)]
+        import time as _time
+
+        _time.sleep(0.3)  # let all three reach the cache
+        gate.set()
+        assert [r.result(timeout=30) for r in responses] == ["m1"] * 3
+        assert loads == ["m1"], loads  # one in-flight load, two waiters
