@@ -1,0 +1,270 @@
+"""Object transfer plane: chunked pull of sealed objects between runtimes.
+
+Reference analogue: `src/ray/object_manager/` — `PullManager`/`PushManager`
+move plasma objects between nodes as ~1MB chunks over a dedicated gRPC
+service (`object_manager.proto :: ObjectManagerService`). Same shape here:
+each runtime can serve its object store on a TCP port; a remote runtime
+locates the holder (control-plane KV carries `object_transfer/{node}` →
+address) and pulls the object as fixed-size chunks, reassembling and
+sealing it into its own store. Pull-based (the receiver drives), like the
+reference — admission control stays with the consumer.
+
+Intra-slice device arrays never cross this plane: jax arrays travel as
+compiled collectives over ICI. This is the HOST object plane (CPU tensors,
+rollouts, checkpoint shards, pickled results) between loosely-coupled
+runtimes.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .ids import ObjectID
+from .logging import get_logger
+from .metrics import Counter
+from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
+
+logger = get_logger("object_transfer")
+
+DEFAULT_CHUNK_BYTES = 1 << 20  # ~1MB, the reference's chunk size
+
+KV_PREFIX = "object_transfer/"  # control-plane KV key prefix for addresses
+
+_pulled_chunks = Counter(
+    "object_transfer_chunks_pulled", "Chunks pulled from remote runtimes."
+)
+_pulled_bytes = Counter(
+    "object_transfer_bytes_pulled", "Bytes pulled from remote runtimes."
+)
+
+
+class ObjectPullError(RuntimeError):
+    pass
+
+
+def _serialize_for_wire(value: Any) -> bytes:
+    """One flat payload per object; cloudpickle for closures/lambdas."""
+    try:
+        return pickle.dumps(value, protocol=5)
+    except Exception:
+        import cloudpickle
+
+        return cloudpickle.dumps(value, protocol=5)
+
+
+class _TransferHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "ObjectTransferServer" = self.server  # type: ignore[assignment]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                msg_type, req = recv_msg(sock)
+                if msg_type != MSG_REQUEST:
+                    raise WireError(f"unexpected message type {msg_type}")
+                try:
+                    resp = self._dispatch(server, req)
+                except Exception as e:  # noqa: BLE001 — serialized to caller
+                    resp = {"id": req.get("id"), "ok": False, "error": repr(e)}
+                send_msg(sock, MSG_RESPONSE, resp)
+        except (WireError, OSError):
+            pass  # puller disconnected
+
+    def _dispatch(self, server: "ObjectTransferServer", req: dict) -> dict:
+        method = req.get("method")
+        if method == "meta":
+            (oid_hex,) = req["args"]
+            blob = server._blob_for(oid_hex)
+            return {"id": req["id"], "ok": True, "value": len(blob)}
+        if method == "chunk":
+            oid_hex, offset, length = req["args"]
+            blob = server._blob_for(oid_hex)
+            return {"id": req["id"], "ok": True,
+                    "value": bytes(blob[offset:offset + length])}
+        if method == "contains":
+            (oid_hex,) = req["args"]
+            try:
+                server._blob_for(oid_hex)
+                return {"id": req["id"], "ok": True, "value": True}
+            except KeyError:
+                return {"id": req["id"], "ok": True, "value": False}
+        raise WireError(f"unknown method {method!r}")
+
+
+class ObjectTransferServer(socketserver.ThreadingTCPServer):
+    """Serves one runtime's object store for remote pulls.
+
+    The serialized blob for an object is cached per object id while any
+    pull is in flight (pulls are chunked across many requests), and
+    dropped once the store drops the object."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, store, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _TransferHandler)
+        self._store = store
+        self._blob_cache: Dict[str, bytes] = {}
+        self._cache_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="object-transfer"
+        )
+        self._thread.start()
+        logger.info("object transfer plane on %s:%d", *self.server_address)
+
+    @property
+    def address(self) -> str:
+        host, port = self.server_address
+        return f"{host}:{port}"
+
+    def _blob_for(self, oid_hex: str) -> bytes:
+        with self._cache_lock:
+            blob = self._blob_cache.get(oid_hex)
+            if blob is not None:
+                return blob
+        oid = ObjectID.from_hex(oid_hex)
+        if not self._store.contains(oid):
+            raise KeyError(f"object {oid_hex} not in local store")
+        value = self._store.get(oid, timeout=0.0)
+        blob = _serialize_for_wire(value)
+        with self._cache_lock:
+            # bound the cache: drop the oldest entries past 64
+            if len(self._blob_cache) >= 64:
+                self._blob_cache.pop(next(iter(self._blob_cache)))
+            self._blob_cache[oid_hex] = blob
+        return blob
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+class ObjectTransferClient:
+    """Chunked puller. One connection per remote address, reused across
+    pulls (the reference pools object-manager RPC channels likewise)."""
+
+    def __init__(self, chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+        self.chunk_bytes = int(chunk_bytes)
+        self._conns: Dict[str, socket.socket] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._global_lock = threading.Lock()
+        self._next_id = 0
+
+    def _conn(self, address: str) -> Tuple[socket.socket, threading.Lock]:
+        with self._global_lock:
+            sock = self._conns.get(address)
+            if sock is None:
+                host, _, port = address.rpartition(":")
+                sock = socket.create_connection((host, int(port)), timeout=30.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[address] = sock
+                self._locks[address] = threading.Lock()
+            return sock, self._locks[address]
+
+    def _call(self, address: str, method: str, *args) -> Any:
+        sock, lock = self._conn(address)
+        with lock:
+            with self._global_lock:
+                self._next_id += 1
+                req_id = self._next_id
+            try:
+                send_msg(sock, MSG_REQUEST,
+                         {"id": req_id, "method": method, "args": args})
+                msg_type, resp = recv_msg(sock)
+            except (WireError, OSError) as e:
+                self._drop(address)
+                raise ObjectPullError(f"transfer connection to {address} lost: {e}")
+        if msg_type != MSG_RESPONSE or resp.get("id") != req_id:
+            self._drop(address)
+            raise ObjectPullError(f"bad transfer response from {address}")
+        if not resp.get("ok"):
+            raise ObjectPullError(resp.get("error", "pull failed"))
+        return resp["value"]
+
+    def _drop(self, address: str) -> None:
+        with self._global_lock:
+            sock = self._conns.pop(address, None)
+            self._locks.pop(address, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def pull(self, address: str, object_id) -> Any:
+        """Pull one object from the holder at `address`; returns the value.
+
+        Chunks sequentially over one connection: the transfer is bandwidth
+        -bound, not latency-bound, at ~1MB chunks (matching the reference's
+        ObjectBufferPool sizing)."""
+        oid_hex = object_id.hex() if hasattr(object_id, "hex") else str(object_id)
+        total = self._call(address, "meta", oid_hex)
+        parts = []
+        offset = 0
+        while offset < total:
+            length = min(self.chunk_bytes, total - offset)
+            chunk = self._call(address, "chunk", oid_hex, offset, length)
+            if not chunk:
+                raise ObjectPullError(
+                    f"short read at {offset}/{total} for {oid_hex}"
+                )
+            parts.append(chunk)
+            offset += len(chunk)
+            _pulled_chunks.inc()
+            _pulled_bytes.inc(len(chunk))
+        return pickle.loads(b"".join(parts))
+
+    def close(self) -> None:
+        with self._global_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+            self._locks.clear()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def serve_object_transfer(runtime, host: str = "127.0.0.1",
+                          port: int = 0) -> ObjectTransferServer:
+    """Start the transfer plane for a Runtime's driver store and advertise
+    the address in the control plane KV (`object_transfer/{node_id}`), so
+    remote runtimes sharing the control plane can locate the holder."""
+    store = runtime.driver_agent.store
+    server = ObjectTransferServer(store, host, port)
+    try:
+        runtime.control_plane.kv_put(
+            KV_PREFIX + runtime.driver_agent.node_id.hex(), server.address
+        )
+    except Exception:  # noqa: BLE001 — advertising is best-effort
+        logger.warning("could not advertise transfer address", exc_info=True)
+    return server
+
+
+def pull_from_any(control_plane, object_id,
+                  client: Optional[ObjectTransferClient] = None) -> Any:
+    """Resolve `object_transfer/*` advertisements from the control plane
+    and try each holder until one serves the object."""
+    own = client is None
+    client = client or ObjectTransferClient()
+    try:
+        errors = []
+        for key in control_plane.kv_keys(KV_PREFIX):
+            address = control_plane.kv_get(key)
+            if not address:
+                continue
+            try:
+                return client.pull(address, object_id)
+            except ObjectPullError as e:
+                errors.append((address, str(e)))
+        raise ObjectPullError(
+            f"no advertised holder served {object_id}: {errors}"
+        )
+    finally:
+        if own:
+            client.close()
