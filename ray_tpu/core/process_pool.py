@@ -192,6 +192,19 @@ def _cleanup_buffers(store, buffer_ids: List[bytes]) -> None:
 _main_guard = threading.Lock()
 
 
+def _mp_context():
+    """forkserver, not spawn: spawn re-imports the parent's __main__ in
+    every worker, which crashes when the driver is <stdin>/REPL and
+    re-executes side effects when it is a script. The forkserver child
+    forks from a clean server process that never saw driver state (or
+    jax/TPU handles). spawn is the fallback where forkserver is absent.
+    Shared by the task pool and actor worker processes."""
+    try:
+        return mp.get_context("forkserver")
+    except ValueError:
+        return mp.get_context("spawn")
+
+
 @contextlib.contextmanager
 def _suppress_main_reimport():
     """Stop multiprocessing from re-running the driver's __main__ in workers.
@@ -200,25 +213,31 @@ def _suppress_main_reimport():
     every child — which crashes outright when the driver is <stdin>/REPL and
     re-runs script side effects otherwise. Workers here never need driver
     state: functions arrive by value via cloudpickle (main-module functions
-    included). Blanking __main__.__file__/__spec__ while start() computes the
-    preparation data makes the child skip the main-module fixup entirely."""
+    included).
+
+    Mechanism: swap a BLANK module in as sys.modules['__main__'] while
+    start() computes the preparation data (it reads main via sys.modules).
+    Crucially this does NOT mutate the real main module: driver code that is
+    concurrently executing resolves `__file__`/globals through its own frame
+    globals (the real module's dict), so background worker prestart cannot
+    race the driver's top-level code."""
     main = sys.modules.get("__main__")
     if main is None:
         yield
         return
+    import types
+
     with _main_guard:
-        saved_file = main.__dict__.pop("__file__", None)
-        saved_spec = main.__dict__.get("__spec__", None)
-        main.__spec__ = None
+        blank = types.ModuleType("__main__")
+        blank.__spec__ = None  # no spec + no file => child skips main fixup
+        sys.modules["__main__"] = blank
         try:
             yield
         finally:
-            if saved_file is not None:
-                main.__file__ = saved_file
-            main.__spec__ = saved_spec
+            sys.modules["__main__"] = main
 
 
-def _worker_main(store_name: str, req_q, resp_q) -> None:
+def _worker_main(store_name: str, req_q, resp_q, log_dir: str = "") -> None:
     """Entry point of a spawned worker. Imports stay minimal: no jax."""
     from .shm_store import ShmObjectStore
 
@@ -226,6 +245,21 @@ def _worker_main(store_name: str, req_q, resp_q) -> None:
     # runtime whose refs/handles are meaningless to the parent; api.py
     # checks this flag and raises a clear error instead.
     os.environ["RAY_TPU_IN_POOL_WORKER"] = "1"
+    if log_dir:
+        # redirect the worker's stdio into the PARENT's session log dir
+        # (worker-<pid>.out) so the LogMonitor attributes and echoes it;
+        # the dir is passed in because session_dir() in the child would
+        # mint a fresh session
+        try:
+            path = os.path.join(log_dir, f"worker-{os.getpid()}.out")
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            os.dup2(fd, 1)
+            os.dup2(fd, 2)
+            os.close(fd)
+            sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+            sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+        except OSError:
+            pass  # stdio capture is best-effort
     store = ShmObjectStore(store_name, create=False)
     while True:
         item = req_q.get()
@@ -266,15 +300,7 @@ class ProcessPool:
         self.store = ShmObjectStore(
             self.store_name, capacity=_POOL_ARENA_BYTES, max_objects=8192
         )
-        # forkserver, not spawn: spawn re-imports the parent's __main__ in
-        # every worker, which crashes when the driver is <stdin>/REPL and
-        # re-executes side effects when it is a script. The forkserver child
-        # forks from a clean server process that never saw driver state (or
-        # jax/TPU handles). spawn is the fallback where forkserver is absent.
-        try:
-            self._ctx = mp.get_context("forkserver")
-        except ValueError:
-            self._ctx = mp.get_context("spawn")
+        self._ctx = _mp_context()
         self._tasks: "queue.Queue[Optional[Tuple]]" = queue.Queue()
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()
@@ -350,9 +376,11 @@ class ProcessPool:
     def _spawn(self) -> _Worker:
         req_q = self._ctx.Queue()
         resp_q = self._ctx.Queue()
+        from .logging import log_dir
+
         proc = self._ctx.Process(
             target=_worker_main,
-            args=(self.store_name, req_q, resp_q),
+            args=(self.store_name, req_q, resp_q, log_dir()),
             daemon=True,
         )
         with _suppress_main_reimport():
@@ -362,7 +390,14 @@ class ProcessPool:
     def _lane(self, index: int) -> None:
         """One parent thread drives one worker process: ship task, await
         response or death. Worker death fails only the in-flight task."""
+        # prestart (reference: worker_pool.cc prestarts workers): spawning
+        # here, before the first task arrives, moves the ~0.5s forkserver
+        # cost off the first submission's critical path
         worker: Optional[_Worker] = None
+        try:
+            worker = self._spawn()
+        except Exception:  # noqa: BLE001 — retried lazily per task below
+            worker = None
         while not self._closed.is_set():
             item = self._tasks.get()
             if item is None:

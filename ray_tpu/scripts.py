@@ -170,6 +170,12 @@ def cmd_start(args) -> int:
     )
     port = state.start_metrics_server(port=args.metrics_port)
     print(f"ray-tpu session up: metrics http://127.0.0.1:{port}/metrics")
+    from ray_tpu.core.log_monitor import LogMonitor
+
+    # publish session logs to the control-plane pubsub so remote shells can
+    # `ray-tpu logs --follow --address …`; silent locally (sink drops)
+    LogMonitor(sink=lambda record: None,
+               pubsub=rt.control_plane.pubsub).start()
     cp_server = getattr(rt, "_cp_server", None)
     if cp_server is not None:
         print(f"  control-plane RPC: {cp_server.address} "
@@ -191,6 +197,60 @@ def cmd_start(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         print("shutting down")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Session log access: list files, tail one, or follow the live stream
+    of an attached session (reference: `ray logs` + the log monitor's
+    driver echo)."""
+    from ray_tpu.core.log_monitor import (
+        LOG_CHANNEL,
+        list_log_files,
+        tail_log_file,
+    )
+
+    if args.follow:
+        import threading
+
+        if not args.address:
+            print("logs --follow needs --address (a live session's RPC)",
+                  file=sys.stderr)
+            return 2
+        client = _remote_cp(args.address)
+        done = threading.Event()
+
+        def on_record(record):
+            pid = f" pid={record['pid']}" if record.get("pid") else ""
+            print(f"({record['file']}{pid}) {record['line']}", flush=True)
+
+        client.subscribe(LOG_CHANNEL, on_record)
+        print(f"following logs from {args.address} (ctrl-c to stop)",
+              file=sys.stderr)
+        try:
+            while not done.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            client.close()
+        return 0
+
+    if args.file:
+        try:
+            for line in tail_log_file(args.file, n=args.lines,
+                                      directory=args.log_dir):
+                print(line)
+        except OSError as e:
+            print(f"cannot read {args.file}: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    files = list_log_files(args.log_dir)
+    if not files:
+        print("no session logs found (is a session running on this host?)")
+        return 0
+    _print_rows(files, ["file", "bytes", "mtime"])
     return 0
 
 
@@ -270,6 +330,16 @@ def main(argv=None) -> int:
                      help="control-plane RPC port (0 = ephemeral)")
     pst.add_argument("--serve-app", help="module:attr of a serve Application")
     pst.set_defaults(fn=cmd_start)
+
+    plog = sub.add_parser("logs", help="list/tail/follow session logs")
+    plog.add_argument("file", nargs="?", help="log file name to tail")
+    plog.add_argument("-n", "--lines", type=int, default=100)
+    plog.add_argument("--log-dir", help="session log dir "
+                      "(default: /tmp/ray_tpu/session_latest/logs)")
+    plog.add_argument("--follow", action="store_true",
+                      help="stream live lines over RPC (needs --address)")
+    plog.add_argument("--address", help="live session control-plane RPC address")
+    plog.set_defaults(fn=cmd_logs)
 
     pt = sub.add_parser("timeline", help="export the task timeline (chrome trace)")
     pt.add_argument("out", nargs="?", default="timeline.json")

@@ -34,10 +34,15 @@ class TestTasks:
             time.sleep(0.05)
             return i
 
+        # warm the worker-process pool: the first batch pays the one-time
+        # forkserver spawn (prestarted in the background at init, but this
+        # test runs immediately); the assertion is about steady-state
+        # overlap, not cold start
+        ray_tpu.get([slow.remote(i) for i in range(8)])
         start = time.monotonic()
         refs = [slow.remote(i) for i in range(8)]
         assert ray_tpu.get(refs) == list(range(8))
-        # 8 x 50ms tasks on 8 CPUs should overlap
+        # 8 x 50ms tasks across pool workers should overlap, not serialize
         assert time.monotonic() - start < 0.4
 
     def test_num_returns(self, ray_start_regular):

@@ -1,0 +1,153 @@
+"""Log aggregation tests (reference: `_private/log_monitor.py` + `ray logs`):
+tailing, prefix attribution, pubsub fan-out over RPC, worker stdio capture,
+and the CLI surface."""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.core.log_monitor import (
+    LOG_CHANNEL,
+    LogMonitor,
+    list_log_files,
+    tail_log_file,
+)
+
+
+@pytest.fixture
+def log_dir(tmp_path):
+    d = tmp_path / "logs"
+    d.mkdir()
+    return str(d)
+
+
+def _write(path, text, mode="a"):
+    with open(path, mode) as f:
+        f.write(text)
+
+
+class TestTailing:
+    def test_emits_new_lines_with_attribution(self, log_dir):
+        records = []
+        mon = LogMonitor(directory=log_dir, sink=records.append, from_start=True)
+        _write(os.path.join(log_dir, "runtime-123.log"), "hello\nworld\n")
+        mon.poll_once()
+        assert [r["line"] for r in records] == ["hello", "world"]
+        assert records[0]["pid"] == "123"
+        assert records[0]["file"] == "runtime-123.log"
+
+    def test_partial_lines_held_until_newline(self, log_dir):
+        records = []
+        mon = LogMonitor(directory=log_dir, sink=records.append, from_start=True)
+        p = os.path.join(log_dir, "worker-7.out")
+        _write(p, "incompl")
+        mon.poll_once()
+        assert records == []
+        _write(p, "ete line\n")
+        mon.poll_once()
+        assert [r["line"] for r in records] == ["incomplete line"]
+
+    def test_attach_mid_session_skips_history(self, log_dir):
+        p = os.path.join(log_dir, "old-1.log")
+        _write(p, "ancient history\n")
+        records = []
+        mon = LogMonitor(directory=log_dir, sink=records.append)
+        mon.start()
+        try:
+            _write(p, "fresh line\n")
+            deadline = time.monotonic() + 5.0
+            while not records and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            mon.stop()
+        assert [r["line"] for r in records] == ["fresh line"]
+
+    def test_truncated_file_restarts(self, log_dir):
+        records = []
+        mon = LogMonitor(directory=log_dir, sink=records.append, from_start=True)
+        p = os.path.join(log_dir, "rotate-9.log")
+        _write(p, "a very long first line\n")
+        mon.poll_once()
+        _write(p, "next\n", mode="w")  # rotation: file shrinks
+        mon.poll_once()
+        assert [r["line"] for r in records] == ["a very long first line", "next"]
+
+    def test_ignores_non_log_files(self, log_dir):
+        records = []
+        _write(os.path.join(log_dir, "data.bin"), "binary\n")
+        mon = LogMonitor(directory=log_dir, sink=records.append, from_start=True)
+        mon.poll_once()
+        assert records == []
+
+
+class TestPubsubFanout:
+    def test_lines_cross_the_rpc_wire(self, log_dir):
+        from ray_tpu.core.control_plane import ControlPlane
+        from ray_tpu.core.rpc import RemoteControlPlane, serve_control_plane
+
+        cp = ControlPlane()
+        server = serve_control_plane(cp)
+        client = RemoteControlPlane(server.address)
+        got = []
+        client.subscribe(LOG_CHANNEL, got.append)
+        time.sleep(0.1)
+        mon = LogMonitor(directory=log_dir, sink=lambda r: None,
+                         pubsub=cp.pubsub, from_start=True)
+        _write(os.path.join(log_dir, "train-42.log"), "loss=0.5\n")
+        mon.poll_once()
+        deadline = time.monotonic() + 5.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.05)
+        client.close()
+        server.stop()
+        assert got and got[0]["line"] == "loss=0.5" and got[0]["pid"] == "42"
+
+
+class TestWorkerStdioCapture:
+    def test_pool_worker_print_lands_in_session_logs(self, ray_start_regular):
+        import ray_tpu
+        from ray_tpu.core.logging import log_dir as session_log_dir
+
+        @ray_tpu.remote
+        def chatty():
+            print("hello from the pool")
+            return os.getpid()
+
+        pid = ray_tpu.get(chatty.remote())
+        if pid == os.getpid():
+            pytest.skip("task ran in-process (pool bypass) — nothing to capture")
+        path = os.path.join(session_log_dir(), f"worker-{pid}.out")
+        deadline = time.monotonic() + 10.0
+        text = ""
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                text = open(path).read()
+                if "hello from the pool" in text:
+                    break
+            time.sleep(0.1)
+        assert "hello from the pool" in text
+
+
+class TestCLISurface:
+    def test_list_and_tail(self, log_dir):
+        _write(os.path.join(log_dir, "a-1.log"), "x\ny\nz\n")
+        files = list_log_files(log_dir)
+        assert [f["file"] for f in files] == ["a-1.log"]
+        assert tail_log_file("a-1.log", n=2, directory=log_dir) == ["y", "z"]
+
+    def test_cmd_logs_lists(self, log_dir, capsys):
+        from ray_tpu.scripts import main
+
+        _write(os.path.join(log_dir, "b-2.log"), "line\n")
+        assert main(["logs", "--log-dir", log_dir]) == 0
+        out = capsys.readouterr().out
+        assert "b-2.log" in out
+
+    def test_cmd_logs_tail(self, log_dir, capsys):
+        from ray_tpu.scripts import main
+
+        _write(os.path.join(log_dir, "c-3.log"), "one\ntwo\n")
+        assert main(["logs", "c-3.log", "--log-dir", log_dir]) == 0
+        out = capsys.readouterr().out
+        assert "one" in out and "two" in out
