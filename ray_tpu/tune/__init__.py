@@ -6,9 +6,13 @@ from ..train.session import get_checkpoint, get_context, report  # noqa: F401
 from .schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
     choice,
     generate_configs,
     grid_search,

@@ -75,6 +75,57 @@ class AsyncHyperBandScheduler:
         return None
 
 
+class MedianStoppingRule:
+    """Stop a trial whose running mean falls below the median of the other
+    trials' running means at the same timestep (reference:
+    `schedulers/median_stopping_rule.py`; Vizier's default rule)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of (t, value)
+        self._history: Dict[str, List[Any]] = {}
+
+    def _running_mean_at(self, trial_id: str, t: int) -> Optional[float]:
+        vals = [v for (tt, v) in self._history.get(trial_id, []) if tt <= t]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+    def on_result(self, trial: Trial, result: Dict[str, Any], all_trials) -> str:
+        t = result.get(self.time_attr)
+        val = result.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        self._history.setdefault(trial.trial_id, []).append((t, float(val)))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [
+            m for tr in all_trials if tr.trial_id != trial.trial_id
+            for m in [self._running_mean_at(tr.trial_id, t)] if m is not None
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = self._running_mean_at(trial.trial_id, t)
+        ok = mine >= median if self.mode == "max" else mine <= median
+        return CONTINUE if ok else STOP
+
+    def exploit(self, trial, all_trials):
+        return None
+
+
 class PopulationBasedTraining:
     """PBT (restart-based): at each perturbation interval, a bottom-quantile
     trial clones a top-quantile trial's checkpoint + config, with hyperparams

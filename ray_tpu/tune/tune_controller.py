@@ -58,9 +58,11 @@ class TuneController:
         max_concurrent: int = 4,
         max_retries: int = 0,
         resources_per_trial: Optional[Dict[str, float]] = None,
+        search_alg=None,
     ):
         self.trainable = trainable
         self.scheduler = scheduler or FIFOScheduler()
+        self.search_alg = search_alg
         self.max_concurrent = max_concurrent
         self.max_retries = max_retries
         self.resources = resources_per_trial or {"CPU": 1.0}
@@ -71,6 +73,7 @@ class TuneController:
         self._actors: Dict[str, Any] = {}
         self._run_refs: Dict[str, Any] = {}
         self._resume: Dict[str, Optional[Checkpoint]] = {}
+        self._searcher_done = search_alg is None
 
     # ------------------------------------------------------------------
 
@@ -86,7 +89,7 @@ class TuneController:
         self._run_refs[trial.trial_id] = ref
         trial.status = TrialStatus.RUNNING
 
-    def _stop_trial(self, trial: Trial, *, early: bool) -> None:
+    def _stop_trial(self, trial: Trial, *, early: bool, notify: bool = True) -> None:
         actor = self._actors.pop(trial.trial_id, None)
         self._run_refs.pop(trial.trial_id, None)
         if actor is not None:
@@ -96,6 +99,8 @@ class TuneController:
                 pass
         trial.status = TrialStatus.TERMINATED
         trial.stopped_early = early
+        if notify:
+            self._notify_searcher(trial)
 
     def _drain_reports(self, trial: Trial) -> List[_Report]:
         actor = self._actors.get(trial.trial_id)
@@ -124,16 +129,40 @@ class TuneController:
             if exploit is not None:
                 new_config, src_ckpt = exploit
                 logger.info("PBT exploit: %s adopts %s", trial.trial_id, new_config)
-                self._stop_trial(trial, early=False)
+                self._stop_trial(trial, early=False, notify=False)
                 trial.config = new_config
                 trial.status = TrialStatus.PENDING
                 self._resume[trial.trial_id] = src_ckpt
                 return
 
+    def _ask_searcher(self, want: int) -> List[Trial]:
+        """Pull up to `want` fresh trials from the search algorithm
+        (sequential suggestion: TPE etc. see completed results first)."""
+        fresh: List[Trial] = []
+        while not self._searcher_done and want > 0:
+            trial_id = f"trial_{len(self.trials):04d}_{uuid.uuid4().hex[:6]}"
+            cfg = self.search_alg.suggest(trial_id)
+            if cfg is None:
+                self._searcher_done = True
+                break
+            t = Trial(trial_id=trial_id, config=cfg)
+            self.trials.append(t)
+            fresh.append(t)
+            want -= 1
+        return fresh
+
+    def _notify_searcher(self, trial: Trial) -> None:
+        if self.search_alg is not None and trial.last_result:
+            self.search_alg.on_trial_complete(trial.trial_id, trial.last_result)
+
     def run(self) -> List[Trial]:
         while True:
             running = [t for t in self.trials if t.status is TrialStatus.RUNNING]
             pending = [t for t in self.trials if t.status is TrialStatus.PENDING]
+            if len(running) + len(pending) < self.max_concurrent:
+                pending.extend(self._ask_searcher(
+                    self.max_concurrent - len(running) - len(pending)
+                ))
             if not running and not pending:
                 break
             while pending and len(running) < self.max_concurrent:

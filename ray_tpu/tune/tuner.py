@@ -19,6 +19,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    search_alg: Any = None  # a Searcher (e.g. TPESearcher); None = pre-expand
     seed: Optional[int] = None
     max_retries: int = 0
     resources_per_trial: Optional[Dict[str, float]] = None
@@ -75,7 +76,12 @@ class Tuner:
     def fit(self) -> ResultGrid:
         api._auto_init()
         tc = self.tune_config
-        configs = generate_configs(self.param_space, tc.num_samples, tc.seed)
+        # with a sequential searcher the controller asks for configs as
+        # slots free (so the searcher sees completed results); otherwise
+        # the whole space is pre-expanded
+        configs = [] if tc.search_alg is not None else generate_configs(
+            self.param_space, tc.num_samples, tc.seed
+        )
         controller = TuneController(
             self.trainable,
             configs,
@@ -83,6 +89,7 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             max_retries=tc.max_retries,
             resources_per_trial=tc.resources_per_trial,
+            search_alg=tc.search_alg,
         )
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)

@@ -181,3 +181,77 @@ class TestPBT:
         # at least one trial must have been exploited into a mutated config
         mutated = [t for t in grid.trials if t.config["factor"] != 0.1]
         assert mutated
+
+
+class TestMedianStopping:
+    def test_below_median_trials_stopped(self):
+        from ray_tpu.tune import MedianStoppingRule
+
+        def trainable(config):
+            for i in range(1, 9):
+                # quality trials report low loss; bad ones high
+                tune.report({"loss": config["q"] + 0.01 * i,
+                             "training_iteration": i})
+
+        grid = Tuner(
+            trainable,
+            param_space={"q": tune.grid_search([0.1, 0.1, 0.1, 5.0, 5.0])},
+            tune_config=TuneConfig(
+                metric="loss", mode="min", max_concurrent_trials=5,
+                scheduler=MedianStoppingRule(
+                    metric="loss", mode="min", grace_period=2,
+                    min_samples_required=2,
+                ),
+            ),
+        ).fit()
+        stopped = [t for t in grid.trials if t.stopped_early]
+        assert stopped, "bad trials should be median-stopped"
+        assert all(t.config["q"] == 5.0 for t in stopped)
+        assert grid.get_best_result().config["q"] == 0.1
+
+
+class TestTPE:
+    def test_suggests_within_domain_and_improves(self):
+        from ray_tpu.tune import TPESearcher
+
+        space = {"x": tune.uniform(-4.0, 4.0), "kind": tune.choice(["a", "b"])}
+
+        def trainable(config):
+            # optimum at x=2 with kind=="b"
+            penalty = 0.0 if config["kind"] == "b" else 1.0
+            tune.report({"loss": (config["x"] - 2.0) ** 2 + penalty})
+
+        searcher = TPESearcher(space, metric="loss", mode="min",
+                               num_samples=24, n_startup=6, seed=0)
+        grid = Tuner(
+            trainable,
+            param_space=space,
+            tune_config=TuneConfig(
+                metric="loss", mode="min", search_alg=searcher,
+                max_concurrent_trials=2,
+            ),
+        ).fit()
+        assert len(grid) == 24
+        assert all(-4.0 <= t.config["x"] <= 4.0 for t in grid.trials)
+        best = grid.get_best_result()
+        assert best.metric("loss") < 0.5, best.config
+        # exploitation: later suggestions concentrate near the optimum
+        late = grid.trials[12:]
+        near = [t for t in late if abs(t.config["x"] - 2.0) < 1.5
+                and t.config["kind"] == "b"]
+        assert len(near) >= len(late) // 3, [t.config for t in late]
+
+    def test_searcher_budget_respected(self):
+        from ray_tpu.tune import TPESearcher
+
+        space = {"x": tune.uniform(0.0, 1.0)}
+
+        def trainable(config):
+            tune.report({"loss": config["x"]})
+
+        searcher = TPESearcher(space, num_samples=5, n_startup=2, seed=1)
+        grid = Tuner(
+            trainable, param_space=space,
+            tune_config=TuneConfig(search_alg=searcher),
+        ).fit()
+        assert len(grid) == 5
