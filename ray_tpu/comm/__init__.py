@@ -1,7 +1,7 @@
 """Communication: device meshes (XLA collectives over ICI) + host collectives."""
 
 from .bootstrap import init_distributed  # noqa: F401
-from .host_collectives import CollectiveGroup  # noqa: F401
+from .host_collectives import CollectiveGroup, KVCollectiveGroup  # noqa: F401
 from .mesh import (  # noqa: F401
     AXIS_ORDER,
     MeshSpec,

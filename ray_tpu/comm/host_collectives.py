@@ -99,3 +99,96 @@ class CollectiveGroup:
     def broadcast(self, payload: Any = None, root: int = 0, timeout_s: float = 60.0) -> Any:
         gathered = self.allgather(payload if self.rank == root else None, timeout_s)
         return gathered[root]
+
+
+class KVCollectiveGroup:
+    """Host collectives over the control-plane KV — works across OS
+    processes and hosts (participants may hold a local ControlPlane or a
+    RemoteControlPlane attached over RPC; the KV is the single authority).
+
+    Reference analogue: gloo's store-based rendezvous
+    (`gloo_collective_group.py` bootstraps via a shared KV store the same
+    way). Each round writes `__collective/{group}/{round}/{rank}` and
+    polls for world_size entries; rank 0 garbage-collects the previous
+    round once the current one completes.
+
+    Group names must be UNIQUE PER INCARNATION (same contract as gloo
+    store prefixes): the FINAL round's keys survive until `close()` /
+    `destroy()`, so a fresh group reusing a live name would read the old
+    incarnation's payloads. Rank 0 should `close()` when done (or use the
+    group as a context manager); `KVCollectiveGroup.destroy(cp, name)`
+    scrubs a name unconditionally."""
+
+    PREFIX = "__collective/"
+
+    def __init__(self, control_plane, name: str, world_size: int, rank: int,
+                 poll_s: float = 0.005):
+        self.cp = control_plane
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.poll_s = poll_s
+        self._round = 0
+
+    def _key(self, round_id: int, rank: int) -> str:
+        return f"{self.PREFIX}{self.name}/{round_id}/{rank}"
+
+    def _prefix(self, round_id: int) -> str:
+        return f"{self.PREFIX}{self.name}/{round_id}/"
+
+    def allgather(self, payload: Any, timeout_s: float = 60.0) -> List[Any]:
+        round_id = self._round
+        self._round += 1
+        self.cp.kv_put(self._key(round_id, self.rank), payload)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            keys = self.cp.kv_keys(self._prefix(round_id))
+            if len(keys) >= self.world_size:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"allgather timeout in KV group {self.name!r} "
+                    f"(rank {self.rank}, have {len(keys)}/{self.world_size})"
+                )
+            time.sleep(self.poll_s)
+        out = [self.cp.kv_get(self._key(round_id, r))
+               for r in range(self.world_size)]
+        if self.rank == 0 and round_id > 0:
+            # lazy GC: the previous round is complete by induction
+            for r in range(self.world_size):
+                self.cp.kv_del(self._key(round_id - 1, r))
+        return out
+
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        self.allgather(None, timeout_s)
+
+    def broadcast(self, payload: Any = None, root: int = 0,
+                  timeout_s: float = 60.0) -> Any:
+        gathered = self.allgather(
+            payload if self.rank == root else None, timeout_s
+        )
+        return gathered[root]
+
+    def close(self) -> None:
+        """Rank 0: delete the final round's keys (every earlier round was
+        GC'd inductively). Other ranks: no-op — only call after all ranks
+        have consumed the last round."""
+        if self.rank == 0 and self._round > 0:
+            for r in range(self.world_size):
+                self.cp.kv_del(self._key(self._round - 1, r))
+
+    def __enter__(self) -> "KVCollectiveGroup":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @staticmethod
+    def destroy(control_plane, name: str) -> int:
+        """Scrub every key a group name ever wrote (crash cleanup /
+        making a name reusable). Returns the number of keys deleted."""
+        n = 0
+        for key in control_plane.kv_keys(f"{KVCollectiveGroup.PREFIX}{name}/"):
+            if control_plane.kv_del(key):
+                n += 1
+        return n

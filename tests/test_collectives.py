@@ -1,0 +1,149 @@
+"""Host collective tests (reference: `ray.util.collective` gloo path):
+actor-backed groups in one runtime, KV-backed groups across threads, and
+a real cross-OS-process rendezvous over the control-plane RPC."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ray_tpu.comm import CollectiveGroup, KVCollectiveGroup
+from ray_tpu.core.control_plane import ControlPlane
+
+
+class TestActorBackedGroup:
+    def test_allgather_and_broadcast(self, ray_start_regular):
+        results = {}
+
+        def member(rank):
+            g = CollectiveGroup("g1", world_size=3, rank=rank)
+            gathered = g.allgather(f"payload-{rank}")
+            got = g.broadcast("root-data" if rank == 0 else None, root=0)
+            results[rank] = (gathered, got)
+
+        threads = [threading.Thread(target=member, args=(r,)) for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 3
+        for rank, (gathered, got) in results.items():
+            assert gathered == ["payload-0", "payload-1", "payload-2"]
+            assert got == "root-data"
+
+    def test_barrier_releases_all(self, ray_start_regular):
+        release_order = []
+        lock = threading.Lock()
+
+        def member(rank):
+            g = CollectiveGroup("g2", world_size=2, rank=rank)
+            g.barrier(timeout_s=30)
+            with lock:
+                release_order.append(rank)
+
+        threads = [threading.Thread(target=member, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sorted(release_order) == [0, 1]
+
+
+class TestKVGroup:
+    def test_allgather_rounds_and_gc(self):
+        cp = ControlPlane()
+        results = {}
+
+        def member(rank):
+            g = KVCollectiveGroup(cp, "kvg", world_size=2, rank=rank)
+            a = g.allgather({"rank": rank})
+            b = g.allgather(rank * 10)
+            results[rank] = (a, b)
+
+        threads = [threading.Thread(target=member, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for rank, (a, b) in results.items():
+            assert a == [{"rank": 0}, {"rank": 1}]
+            assert b == [0, 10]
+        # round 0 keys were GC'd once round 1 completed
+        assert cp.kv_keys("__collective/kvg/0/") == []
+
+    def test_timeout_when_world_incomplete(self):
+        cp = ControlPlane()
+        g = KVCollectiveGroup(cp, "lonely", world_size=2, rank=0)
+        with pytest.raises(TimeoutError):
+            g.allgather("x", timeout_s=0.3)
+
+
+_CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+from ray_tpu.comm import KVCollectiveGroup
+from ray_tpu.core.rpc import RemoteControlPlane
+
+cp = RemoteControlPlane(sys.argv[1])
+rank = int(sys.argv[2])
+g = KVCollectiveGroup(cp, "xproc", world_size=2, rank=rank)
+gathered = g.allgather(f"from-rank-{{rank}}")
+value = g.broadcast("the-plan" if rank == 0 else None, root=0)
+g.barrier()
+print("GATHERED", "|".join(gathered), "GOT", value)
+cp.close()
+"""
+
+
+class TestCrossProcess:
+    def test_two_processes_rendezvous_over_rpc(self):
+        from ray_tpu.core.rpc import serve_control_plane
+
+        cp = ControlPlane()
+        server = serve_control_plane(cp)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, "-c", _CHILD.format(repo=repo),
+                     server.address, str(rank)],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                )
+                for rank in range(2)
+            ]
+            outs = [p.communicate(timeout=120) for p in procs]
+            for p, (out, err) in zip(procs, outs):
+                assert p.returncode == 0, err
+                assert "GATHERED from-rank-0|from-rank-1 GOT the-plan" in out
+        finally:
+            server.stop()
+
+
+class TestKVGroupLifecycle:
+    def test_close_scrubs_final_round(self):
+        cp = ControlPlane()
+
+        def member(rank, results):
+            with KVCollectiveGroup(cp, "fin", world_size=2, rank=rank) as g:
+                results[rank] = g.allgather(rank)
+
+        results = {}
+        threads = [threading.Thread(target=member, args=(r, results))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results[0] == [0, 1]
+        # rank 0's close() removed the final round; no keys survive
+        assert cp.kv_keys("__collective/fin/") == []
+
+    def test_destroy_makes_name_reusable(self):
+        cp = ControlPlane()
+        g = KVCollectiveGroup(cp, "reuse", world_size=2, rank=0)
+        with pytest.raises(TimeoutError):
+            g.allgather("stale", timeout_s=0.2)  # rank 1 never shows
+        assert KVCollectiveGroup.destroy(cp, "reuse") >= 1
+        assert cp.kv_keys("__collective/reuse/") == []
