@@ -1,0 +1,248 @@
+"""Dashboard-lite (reference: `python/ray/dashboard/` — per SURVEY §7.5 the
+React app is out of scope; ship the state API over HTTP + provisioned
+Grafana dashboards, the reference's `dashboard/modules/metrics/` pattern).
+
+Two pieces:
+- `write_grafana_dashboards(dir)`: emits dashboard JSONs (core / serve /
+  data planes, built from this repo's actual metric names) plus a
+  provisioning config, mirroring the reference's bundled Grafana JSONs.
+- `start_dashboard(...)`: one stdlib HTTP server with `/` (HTML status),
+  `/api/v0/<nodes|actors|jobs|objects|summary>` (state API as JSON) and
+  `/metrics` (Prometheus text) — the reference serves the same three
+  surfaces from the dashboard head + agent.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from .core.logging import get_logger
+from .core.metrics import registry as metrics_registry
+
+logger = get_logger("dashboard")
+
+
+# ---------------------------------------------------------------------------
+# Grafana provisioning
+# ---------------------------------------------------------------------------
+
+
+def _panel(title: str, expr: str, panel_id: int, y: int, unit: str = "short",
+           legend: str = "{{__name__}}") -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "gridPos": {"h": 8, "w": 12, "x": 12 * (panel_id % 2), "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [{
+            "expr": expr,
+            "legendFormat": legend,
+            "refId": "A",
+        }],
+    }
+
+
+def _dashboard(uid: str, title: str, panels: List[Dict[str, Any]]) -> Dict[str, Any]:
+    return {
+        "uid": uid,
+        "title": title,
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "panels": panels,
+    }
+
+
+def build_dashboards() -> Dict[str, Dict[str, Any]]:
+    """name -> Grafana dashboard JSON, from this repo's metric names."""
+    core = _dashboard("raytpu-core", "ray_tpu / core", [
+        _panel("Tasks finished (rate)", "rate(ray_tpu_tasks_finished[1m])",
+               0, 0, legend="{{outcome}}"),
+        _panel("Tasks running", "ray_tpu_tasks_running", 1, 0),
+        _panel("Nodes by state", "ray_tpu_nodes", 2, 8, legend="{{state}}"),
+        _panel("Actors by state", "ray_tpu_actors", 3, 8, legend="{{state}}"),
+        _panel("Pool fallbacks (rate)", "rate(ray_tpu_pool_fallbacks[5m])",
+               4, 16, legend="{{reason}}"),
+        _panel("Object transfer (B/s)",
+               "rate(object_transfer_bytes_pulled[1m])", 5, 16, unit="Bps"),
+    ])
+    serve = _dashboard("raytpu-serve", "ray_tpu / serve", [
+        _panel("Requests finished (rate)",
+               "rate(serve_requests_finished[1m])", 0, 0,
+               legend="{{finish_reason}}"),
+        _panel("Requests in decode slots", "serve_requests_running", 1, 0),
+        _panel("Decode throughput (tok/s)",
+               "rate(serve_tokens_generated[1m])", 2, 8),
+        _panel("TTFT p50/p95",
+               "histogram_quantile(0.5, rate(serve_ttft_seconds_bucket[5m]))",
+               3, 8, unit="s", legend="p50"),
+    ])
+    # p95 as a second target on the TTFT panel
+    serve["panels"][3]["targets"].append({
+        "expr": "histogram_quantile(0.95, rate(serve_ttft_seconds_bucket[5m]))",
+        "legendFormat": "p95",
+        "refId": "B",
+    })
+    data = _dashboard("raytpu-data", "ray_tpu / data", [
+        _panel("Tasks finished (rate)", "rate(ray_tpu_tasks_finished[1m])",
+               0, 0, legend="{{outcome}}"),
+        _panel("Transfer chunks (rate)",
+               "rate(object_transfer_chunks_pulled[1m])", 1, 0),
+    ])
+    return {"core": core, "serve": serve, "data": data}
+
+
+def write_grafana_dashboards(directory: str) -> List[str]:
+    """Write dashboard JSONs + a provisioning YAML; returns written paths.
+
+    Point Grafana at the directory via its provisioning config (the
+    reference ships the same layout in `dashboard/modules/metrics/export/`).
+    """
+    os.makedirs(directory, exist_ok=True)
+    written = []
+    for name, dash in build_dashboards().items():
+        path = os.path.join(directory, f"ray_tpu_{name}.json")
+        with open(path, "w") as f:
+            json.dump(dash, f, indent=2)
+        written.append(path)
+    prov = os.path.join(directory, "provisioning.yaml")
+    with open(prov, "w") as f:
+        f.write(
+            "apiVersion: 1\n"
+            "providers:\n"
+            "  - name: ray_tpu\n"
+            "    folder: ray_tpu\n"
+            "    type: file\n"
+            "    options:\n"
+            f"      path: {os.path.abspath(directory)}\n"
+        )
+    written.append(prov)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# HTTP dashboard (state API + HTML status + metrics)
+# ---------------------------------------------------------------------------
+
+_dash_server = None
+
+
+def _state_payload(what: str) -> Any:
+    from .util import state
+
+    if what == "nodes":
+        return state.list_nodes()
+    if what == "actors":
+        return state.list_actors()
+    if what == "jobs":
+        return state.list_jobs()
+    if what == "objects":
+        return state.list_objects()
+    if what == "summary":
+        return state.summary()
+    raise KeyError(what)
+
+
+def _html_table(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "<p><i>none</i></p>"
+    cols = list(rows[0])
+    head = "".join(f"<th>{html.escape(str(c))}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(
+            f"<td>{html.escape(str(r.get(c, '')))}</td>" for c in cols
+        ) + "</tr>"
+        for r in rows[:50]
+    )
+    return f"<table border=1 cellpadding=4><tr>{head}</tr>{body}</table>"
+
+
+def _render_status_page() -> str:
+    from .util import state
+
+    s = state.summary()
+    parts = [
+        "<html><head><title>ray_tpu</title>",
+        "<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}</style>",
+        "</head><body><h1>ray_tpu session</h1>",
+        f"<pre>{html.escape(json.dumps(s, indent=2, default=str))}</pre>",
+        '<p><a href="/metrics">/metrics</a> (Prometheus)</p>',
+    ]
+    for what in ("nodes", "actors", "jobs"):
+        try:
+            rows = _state_payload(what)
+        except Exception as e:  # noqa: BLE001 — page must render partially
+            rows, parts = [], parts + [f"<p>{what}: error {html.escape(repr(e))}</p>"]
+        parts.append(f"<h2>{what} ({len(rows)})</h2>")
+        parts.append(_html_table(rows))
+        parts.append(f'<p><a href="/api/v0/{what}">/api/v0/{what}</a></p>')
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Serve the dashboard; returns the bound port."""
+    global _dash_server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            try:
+                if self.path in ("/", "/index.html"):
+                    return self._send(
+                        200, _render_status_page().encode(), "text/html"
+                    )
+                if self.path == "/metrics":
+                    return self._send(
+                        200, metrics_registry.render_prometheus().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                if self.path.startswith("/api/v0/"):
+                    what = self.path[len("/api/v0/"):].strip("/")
+                    payload = _state_payload(what)
+                    return self._send(
+                        200, json.dumps(payload, default=str).encode(),
+                        "application/json",
+                    )
+                return self._send(404, b'{"error": "not found"}',
+                                  "application/json")
+            except KeyError:
+                return self._send(404, b'{"error": "unknown resource"}',
+                                  "application/json")
+            except Exception as e:  # noqa: BLE001 — serialized to client
+                return self._send(
+                    500, json.dumps({"error": repr(e)}).encode(),
+                    "application/json",
+                )
+
+    _dash_server = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=_dash_server.serve_forever, daemon=True,
+                         name="dashboard")
+    t.start()
+    bound = _dash_server.server_address[1]
+    logger.info("dashboard on http://%s:%d/", host, bound)
+    return bound
+
+
+def stop_dashboard() -> None:
+    global _dash_server
+    if _dash_server is not None:
+        _dash_server.shutdown()
+        _dash_server.server_close()
+        _dash_server = None
