@@ -144,11 +144,12 @@ def _auto_init() -> Runtime:
     if not _cw.runtime_initialized():
         if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
             raise RuntimeError(
-                "the ray_tpu API is not available inside process-pool "
-                "workers: a worker-local runtime's refs/handles would be "
-                "meaningless to the driver. Return plain values instead, "
-                "or run this task with num_tpus/actor semantics so it "
-                "stays in the driver process."
+                "the ray_tpu API is not available inside worker processes "
+                "(pool tasks / isolated actors): a worker-local runtime's "
+                "refs/handles would be meaningless to the driver. Return "
+                "plain values instead; for an actor that must drive the "
+                "runtime (spawn tasks/actors), create it with "
+                "@ray_tpu.remote(in_process=True)."
             )
         init()
     return _cw.get_runtime()
@@ -177,6 +178,7 @@ def _make_options(kwargs: Dict[str, Any]) -> TaskOptions:
         scheduling_strategy=kwargs.pop("scheduling_strategy", None) or TaskOptions().scheduling_strategy,
         runtime_env=kwargs.pop("runtime_env", None),
         max_concurrency=kwargs.pop("max_concurrency", 1),
+        in_process=kwargs.pop("in_process", None),
     )
     if kwargs:
         raise TypeError(f"unknown remote options: {sorted(kwargs)}")

@@ -1,0 +1,292 @@
+"""Dedicated worker processes for actors: crash isolation with a mailbox RPC.
+
+Reference analogue: every reference actor IS a worker process — the raylet
+leases a worker (`src/ray/raylet/worker_pool.cc`), the actor instance lives
+in it, and method calls arrive over gRPC (`core_worker/transport/
+task_receiver.cc` in-order delivery). Here the same contract for CPU
+actors: the instance is constructed in a spawned child; the parent holds an
+`_InstanceProxy` whose attribute access returns shipping stubs, so the node
+agent's existing mailbox/`_run_actor_task` machinery is oblivious — a
+method call pickles (args, kwargs) to the child, executes there, and the
+result (or the user exception) pickles back. A dead child surfaces as
+`ActorProcessCrash` → the agent's normal actor-death path (restarts,
+`RayActorError` to callers).
+
+Device actors are exempt by explicit contract (node_agent._should_isolate):
+a child importing jax would race the parent for the TPU client. In-process
+execution also remains the fallback whenever the creation payload cannot
+cross a process boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import threading
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+from .logging import get_logger
+
+logger = get_logger("actor_process")
+
+
+class ActorProcessCrash(RuntimeError):
+    """The actor's dedicated worker process died."""
+
+
+class ActorNotSerializableError(RuntimeError):
+    """Creation payload can't cross the process boundary."""
+
+
+def _child_main(req_q, resp_q, log_dir: str = "") -> None:
+    """Actor worker entry: construct the instance, then serve method calls.
+
+    Runs max_concurrency threads over one request queue so blocking methods
+    (queues, batchers) don't wedge the whole actor; per-call tags route
+    responses. Imports stay minimal — user code decides what else loads."""
+    os.environ["RAY_TPU_IN_POOL_WORKER"] = "1"  # api.py guards private inits
+    if log_dir:
+        try:
+            path = os.path.join(log_dir, f"actor-{os.getpid()}.out")
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            os.dup2(fd, 1)
+            os.dup2(fd, 2)
+            os.close(fd)
+            sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+            sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+        except OSError:
+            pass
+
+    kind, payload = req_q.get()
+    if kind != "init":
+        return
+    try:
+        cls, args, kwargs, concurrency, renv = pickle.loads(payload)
+        from .runtime_env import applied
+
+        ctx = applied(renv)
+        ctx.__enter__()  # actor-scoped: env stays applied for its lifetime
+        instance = cls(*args, **kwargs)
+    except BaseException as e:  # noqa: BLE001 — reported, not raised
+        try:
+            err = cloudpickle.dumps(e)
+        except Exception:
+            err = cloudpickle.dumps(RuntimeError(repr(e)))
+        resp_q.put(("init", False, err))
+        return
+    resp_q.put(("init", True, b""))
+
+    send_lock = threading.Lock()
+
+    def serve_loop():
+        while True:
+            item = req_q.get()
+            if item is None or item[0] == "stop":
+                # one sentinel per thread: re-post for siblings then exit
+                req_q.put(("stop",))
+                return
+            _, tag, method, call_payload = item
+            try:
+                args, kwargs = pickle.loads(call_payload)
+                out = getattr(instance, method)(*args, **kwargs)
+                body = cloudpickle.dumps((True, out))
+            except BaseException as e:  # noqa: BLE001 — user methods raise anything
+                try:
+                    body = cloudpickle.dumps((False, e))
+                except Exception:
+                    body = cloudpickle.dumps((False, RuntimeError(repr(e))))
+            with send_lock:
+                resp_q.put(("done", tag, body))
+
+    threads = [
+        threading.Thread(target=serve_loop, daemon=True, name=f"serve-{i}")
+        for i in range(max(1, concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class ActorProcess:
+    """Parent-side handle on one actor's dedicated worker process."""
+
+    def __init__(self, cls, args, kwargs, *, max_concurrency: int = 1,
+                 runtime_env: Optional[dict] = None):
+        # creation payload must cross the boundary NOW (fail fast into the
+        # in-process fallback, before a process is spawned); the pool's
+        # pickler rejects inline-only types (ObjectRef/ActorHandle) whose
+        # methods could not work from inside a worker process
+        from .process_pool import _cloudpickle_dumps
+
+        try:
+            payload = _cloudpickle_dumps(
+                (cls, tuple(args), dict(kwargs or {}), max(1, max_concurrency),
+                 runtime_env)
+            )
+        except Exception as e:
+            raise ActorNotSerializableError(repr(e)) from e
+
+        from .logging import log_dir
+        from .process_pool import _mp_context, _suppress_main_reimport
+
+        # all teardown-visible state exists BEFORE anything can fail, so
+        # terminate() on the init-error path below never masks the actor's
+        # real __init__ exception with an AttributeError
+        self._lock = threading.Lock()
+        self._waiters: Dict[str, Tuple[threading.Event, list]] = {}
+        self._dead = threading.Event()
+        self._reader: Optional[threading.Thread] = None
+
+        ctx = _mp_context()
+        self._req_q = ctx.Queue()
+        self._resp_q = ctx.Queue()
+        self._proc = ctx.Process(
+            target=_child_main,
+            args=(self._req_q, self._resp_q, log_dir()),
+            daemon=True,
+        )
+        with _suppress_main_reimport():
+            self._proc.start()
+        self._req_q.put(("init", payload))
+        kind, ok, body = self._get_resp(timeout=300.0, init=True)
+        if not ok:
+            err = cloudpickle.loads(body)
+            self.terminate()
+            raise err
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"actor-proc-{self._proc.pid}",
+        )
+        self._reader.start()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _get_resp(self, timeout: float, init: bool = False):
+        """Blocking read used only during init (before the reader starts)."""
+        import queue as _q
+
+        deadline = timeout
+        while True:
+            try:
+                return self._resp_q.get(timeout=min(0.1, deadline))
+            except _q.Empty:
+                deadline -= 0.1
+                if not self._proc.is_alive():
+                    raise ActorProcessCrash(
+                        f"actor process died during init "
+                        f"(exitcode {self._proc.exitcode})"
+                    )
+                if deadline <= 0:
+                    raise ActorProcessCrash("actor init timed out")
+
+    def _read_loop(self) -> None:
+        import queue as _q
+
+        while not self._dead.is_set():
+            try:
+                item = self._resp_q.get(timeout=0.1)
+            except _q.Empty:
+                if not self._proc.is_alive():
+                    self._fail_all_waiters()
+                    return
+                continue
+            if item[0] != "done":
+                continue
+            _, tag, body = item
+            with self._lock:
+                waiter = self._waiters.pop(tag, None)
+            if waiter is not None:
+                event, box = waiter
+                box.append(body)
+                event.set()
+
+    def _fail_all_waiters(self) -> None:
+        self._dead.set()
+        with self._lock:
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+        for event, box in waiters:
+            box.append(None)  # None body => crashed
+            event.set()
+
+    # -- api ----------------------------------------------------------------
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid
+
+    def alive(self) -> bool:
+        return not self._dead.is_set() and self._proc.is_alive()
+
+    def call(self, method: str, args: tuple, kwargs: dict,
+             timeout: Optional[float] = None) -> Any:
+        if self._dead.is_set():
+            raise ActorProcessCrash("actor process is dead")
+        from .process_pool import _cloudpickle_dumps
+
+        try:
+            payload = _cloudpickle_dumps((tuple(args), dict(kwargs or {})))
+        except Exception as e:
+            raise ActorNotSerializableError(
+                f"args of {method}() can't cross to the actor process: {e!r}"
+            ) from e
+        tag = uuid.uuid4().hex
+        event = threading.Event()
+        box: list = []
+        with self._lock:
+            self._waiters[tag] = (event, box)
+        self._req_q.put(("call", tag, method, payload))
+        if not event.wait(timeout=timeout):
+            with self._lock:
+                self._waiters.pop(tag, None)
+            raise TimeoutError(f"actor call {method}() timed out")
+        body = box[0]
+        if body is None:
+            raise ActorProcessCrash(
+                f"actor process died executing {method}() "
+                f"(exitcode {self._proc.exitcode})"
+            )
+        ok, value = cloudpickle.loads(body)
+        if not ok:
+            raise value
+        return value
+
+    def terminate(self) -> None:
+        self._dead.set()
+        try:
+            self._proc.terminate()
+            self._proc.join(timeout=5.0)
+            if self._proc.is_alive():
+                self._proc.kill()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        self._fail_all_waiters()
+
+
+class _InstanceProxy:
+    """Drop-in for `_ActorRunner.instance`: attribute access returns stubs
+    that ship the call to the actor's worker process. The node agent's
+    `getattr(instance, method)(*args)` path works unchanged."""
+
+    def __init__(self, proc: ActorProcess, class_name: str):
+        object.__setattr__(self, "_proc", proc)
+        object.__setattr__(self, "_class_name", class_name)
+
+    def __getattr__(self, name: str):
+        proc: ActorProcess = object.__getattribute__(self, "_proc")
+
+        def stub(*args, **kwargs):
+            return proc.call(name, args, kwargs)
+
+        stub.__name__ = name
+        return stub
+
+    def __repr__(self):
+        cls = object.__getattribute__(self, "_class_name")
+        proc: ActorProcess = object.__getattribute__(self, "_proc")
+        return f"<{cls} in worker process {proc.pid}>"

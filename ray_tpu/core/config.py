@@ -66,6 +66,19 @@ declare(
     "0 = execute on the node agent's threads. Device tasks always stay on "
     "threads in the device-owning process. Default derives from host CPUs.",
 )
+declare(
+    "prestart_worker_processes", True,
+    "Warm the worker-process pool in the background at node-agent creation "
+    "(reference: worker_pool.cc prestart), so the forkserver cost overlaps "
+    "driver setup instead of the first task submission.",
+)
+declare(
+    "actor_processes", True,
+    "CPU actors (num_tpus=0, max_concurrency=1) get a dedicated worker "
+    "process with a mailbox RPC (crash isolation, the reference's actor "
+    "model). Device actors and high-concurrency system actors stay in the "
+    "device-owning process; unpicklable state falls back in-process.",
+)
 declare("task_max_retries", 3, "Default retries for tasks on worker/node death.")
 declare("actor_max_restarts", 0, "Default actor restarts on failure.")
 declare("lease_timeout_ms", 10_000, "Worker lease grant timeout.")

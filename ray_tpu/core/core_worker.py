@@ -631,6 +631,16 @@ class Runtime:
                     fut.event.set()
             return
 
+        # Actor-death detection must precede the retry decision: a crashed
+        # actor task with retries left would otherwise re-enqueue, find the
+        # dead runner, and burn its retries before anyone schedules the
+        # restart (the retried task then routes once the new incarnation
+        # is ALIVE).
+        if spec.kind is TaskKind.ACTOR_TASK and not result.is_application_error:
+            actor = self.control_plane.get_actor(spec.actor_id)
+            if actor is not None and actor.state is ActorState.ALIVE:
+                self._on_actor_death(actor, result.error)
+
         retriable = not result.is_application_error or item.retry_exceptions
         if retriable and item.retries_left > 0:
             item.retries_left -= 1
@@ -665,12 +675,6 @@ class Runtime:
         else:
             error = RayTaskError(spec.name, result.error)  # type: ignore[arg-type]
         self._fail_task(item, error)
-
-        # actor death detection from a crashed actor task
-        if spec.kind is TaskKind.ACTOR_TASK and not result.is_application_error:
-            actor = self.control_plane.get_actor(spec.actor_id)
-            if actor is not None and actor.state is ActorState.ALIVE:
-                self._on_actor_death(actor, result.error)
 
     def _on_actor_death(self, actor: ActorInfo, cause: Optional[BaseException]) -> None:
         with self._lock:

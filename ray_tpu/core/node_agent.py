@@ -23,6 +23,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .actor_process import ActorProcessCrash
 from .config import config
 from .control_plane import ControlPlane, NodeInfo
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
@@ -35,6 +36,10 @@ logger = get_logger("node_agent")
 
 _tasks_counter = Counter("ray_tpu_tasks_finished", "Tasks finished by outcome")
 _running_gauge = Gauge("ray_tpu_tasks_running", "Tasks currently executing")
+_actors_isolated_counter = Counter(
+    "ray_tpu_actors_isolated",
+    "Actor creations by isolation outcome (process / in_process / fallback).",
+)
 _pool_fallback_counter = Counter(
     "ray_tpu_pool_fallbacks",
     "CPU tasks that bypassed process isolation (unpicklable args/closure)",
@@ -59,6 +64,24 @@ class TaskResult:
 
 
 DoneCallback = Callable[[TaskResult], None]
+
+
+def _preboot_forkserver() -> None:
+    """Boot the multiprocessing forkserver without spawning any worker:
+    the server process launches via `-c` and never reads the driver's
+    __main__, so this is safe to run concurrently with driver code. The
+    first real worker spawn then skips the ~multi-second server boot."""
+    try:
+        from .process_pool import _mp_context
+
+        ctx = _mp_context()
+        if ctx.get_start_method() != "forkserver":
+            return
+        from multiprocessing import forkserver
+
+        forkserver.ensure_running()
+    except Exception:  # noqa: BLE001 — warmup is best-effort
+        logger.debug("forkserver preboot failed", exc_info=True)
 
 
 class ResourceTracker:
@@ -99,6 +122,7 @@ class _ActorRunner:
     def __init__(self, actor_id: ActorID, max_concurrency: int = 1):
         self.actor_id = actor_id
         self.instance: Any = None
+        self.process = None  # ActorProcess when isolated (actor_process.py)
         self.held_resources: Dict[str, float] = {}
         self.mailbox: "queue.Queue[Optional[Tuple[TaskSpec, Callable[[], None]]]]" = queue.Queue()
         self.dead = False
@@ -169,9 +193,20 @@ class NodeAgent:
         self._running: Dict[TaskID, threading.Event] = {}
         self._pending_actor_dones: Dict[TaskID, DoneCallback] = {}
         # CPU-task process pool (config.worker_processes > 0): created lazily
-        # on the first eligible task so thread-mode runtimes pay nothing.
+        # on the first eligible task so thread-mode runtimes pay nothing —
+        # but the forkserver itself pre-boots in the background at agent
+        # creation (the reference PRESTARTS workers, worker_pool.cc), so
+        # most of the spawn cost overlaps driver setup. Only the server
+        # boots here: actually spawning workers would run the __main__
+        # suppression window concurrently with arbitrary driver top-level
+        # code (see process_pool._suppress_main_reimport) — worker spawns
+        # stay inside explicit submission calls.
         self._pool = None
         self._pool_lock = threading.Lock()
+        if config.worker_processes > 0 and config.prestart_worker_processes:
+            threading.Thread(
+                target=_preboot_forkserver, daemon=True, name="pool-warmup"
+            ).start()
         # test hook: simulate a hung host (stops heartbeating, keeps running)
         self.suspend_heartbeat = False
 
@@ -391,6 +426,56 @@ class NodeAgent:
             self._directory.add_location(oid, self.node_id)
 
     # ---------------------------------------------------------------- actors
+    def _should_isolate(self, spec: TaskSpec) -> bool:
+        """Actor-isolation policy (reference: every actor IS a worker
+        process). CPU actors with serial mailboxes isolate; device actors
+        are exempt by contract (a child importing jax races the parent for
+        the TPU client), and high-concurrency actors (serve replicas, trial
+        runners — streaming returns, shared batchers) stay in-process."""
+        if spec.options.in_process is not None:
+            return not spec.options.in_process
+        return (
+            config.actor_processes
+            and spec.options.resource_demand().get("TPU", 0.0) <= 0.0
+            and spec.options.max_concurrency <= 1
+        )
+
+    def _build_actor_instance(self, spec: TaskSpec, args, kwargs):
+        """-> (instance, actor_process_or_None), honoring the isolation
+        policy with in-process fallback for unpicklable state."""
+        if self._should_isolate(spec):
+            from .actor_process import (
+                ActorNotSerializableError,
+                ActorProcess,
+                _InstanceProxy,
+            )
+            from .runtime_env import validate
+
+            try:
+                proc = ActorProcess(
+                    spec.func, args, kwargs,
+                    max_concurrency=spec.options.max_concurrency,
+                    runtime_env=validate(spec.options.runtime_env),
+                )
+                _actors_isolated_counter.inc(tags={"mode": "process"})
+                return _InstanceProxy(
+                    proc, getattr(spec.func, "__name__", "Actor")
+                ), proc
+            except ActorNotSerializableError as e:
+                if spec.options.runtime_env or spec.options.in_process is False:
+                    # isolation was explicitly REQUIRED (env isolation, or
+                    # in_process=False for crash containment): silently
+                    # running in the driver would defeat the request
+                    raise
+                _actors_isolated_counter.inc(tags={"mode": "fallback"})
+                logger.debug(
+                    "actor %s state can't cross a process boundary (%s); "
+                    "running in-process", spec.name, e,
+                )
+        else:
+            _actors_isolated_counter.inc(tags={"mode": "in_process"})
+        return spec.func(*args, **kwargs), None
+
     def _execute_actor_creation(self, spec: TaskSpec) -> TaskResult:
         kill_event = threading.Event()
         with self._lock:
@@ -398,10 +483,14 @@ class NodeAgent:
         try:
             args, kwargs = self._materialize_args(spec)
             runner = _ActorRunner(spec.actor_id, spec.options.max_concurrency)
-            runner.instance = spec.func(*args, **kwargs)  # func is the class
+            runner.instance, runner.process = self._build_actor_instance(
+                spec, args, kwargs
+            )
             # the node may have died while __init__ ran: report the crash so
             # the owner reschedules instead of marking the actor ALIVE here
             if kill_event.is_set() or self._stopped.is_set():
+                if runner.process is not None:
+                    runner.process.terminate()
                 raise WorkerCrashedError("node died during actor creation")
             runner.start(self._run_actor_task)
             with self._lock:
@@ -409,9 +498,10 @@ class NodeAgent:
             self._seal_returns(spec, [None])
             _tasks_counter.inc(tags={"outcome": "ok"})
             return TaskResult(spec.task_id, ok=True, values=[None])
-        except WorkerCrashedError as e:
+        except (WorkerCrashedError, ActorProcessCrash) as e:
             _tasks_counter.inc(tags={"outcome": "crashed"})
-            return TaskResult(spec.task_id, ok=False, error=e)
+            return TaskResult(spec.task_id, ok=False,
+                              error=WorkerCrashedError(str(e)))
         except BaseException as e:  # noqa: BLE001
             _tasks_counter.inc(tags={"outcome": "error"})
             return TaskResult(spec.task_id, ok=False, error=e, is_application_error=True)
@@ -450,11 +540,12 @@ class NodeAgent:
             self._seal_returns(spec, values)
             _tasks_counter.inc(tags={"outcome": "ok"})
             done(TaskResult(spec.task_id, ok=True, values=values))
-        except WorkerCrashedError as e:
+        except (WorkerCrashedError, ActorProcessCrash) as e:
             runner.dead = True
             runner.death_cause = e
             _tasks_counter.inc(tags={"outcome": "crashed"})
-            done(TaskResult(spec.task_id, ok=False, error=e))
+            done(TaskResult(spec.task_id, ok=False,
+                            error=WorkerCrashedError(str(e))))
         except BaseException as e:  # noqa: BLE001
             _tasks_counter.inc(tags={"outcome": "error"})
             done(TaskResult(spec.task_id, ok=False, error=e, is_application_error=True))
@@ -479,6 +570,8 @@ class NodeAgent:
         runner.dead = True
         runner.death_cause = WorkerCrashedError(cause)
         runner.stop()
+        if runner.process is not None:
+            runner.process.terminate()
         if runner.held_resources:
             self.resources.release(runner.held_resources)
             runner.held_resources = {}
@@ -543,6 +636,8 @@ class NodeAgent:
             runner.dead = True
             runner.death_cause = WorkerCrashedError("node stopped")
             runner.stop()
+            if runner.process is not None:
+                runner.process.terminate()
         self.kill_running_tasks()
         # fail everything still queued so owners see the crash, not a hang
         while True:

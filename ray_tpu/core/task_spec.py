@@ -79,6 +79,9 @@ class TaskOptions:
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     runtime_env: Optional[Dict[str, Any]] = None
     max_concurrency: int = 1  # actors only
+    # actors only: None = policy decides (CPU actors isolate into a worker
+    # process; device actors stay in-process); True forces in-process
+    in_process: Optional[bool] = None
 
     def resource_demand(self) -> Dict[str, float]:
         demand = dict(self.resources)

@@ -275,4 +275,8 @@ def get_or_create_controller():
     try:
         return api.get_actor(CONTROLLER_NAME)
     except ValueError:
-        return ServeController.options(name=CONTROLLER_NAME).remote()
+        # in_process: the controller drives the runtime (spawns/kills
+        # replica actors) — worker processes have no runtime back-channel
+        return ServeController.options(
+            name=CONTROLLER_NAME, in_process=True
+        ).remote()

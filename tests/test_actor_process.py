@@ -1,0 +1,241 @@
+"""Actor process isolation tests (reference: every actor is a worker
+process — worker_pool.cc lease + task_receiver.cc mailbox): CPU actors run
+in dedicated children, device/high-concurrency actors stay in-process,
+crashes are contained, restarts respawn."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _rt(ray_start_regular):
+    yield
+
+
+class TestIsolation:
+    def test_cpu_actor_runs_in_child_process(self):
+        @ray_tpu.remote
+        class Who:
+            def pid(self):
+                return os.getpid()
+
+        a = Who.remote()
+        child = ray_tpu.get(a.pid.remote())
+        assert child != os.getpid()
+
+    def test_state_persists_across_calls(self):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        c = Counter.remote(10)
+        assert ray_tpu.get(c.add.remote(5)) == 15
+        assert ray_tpu.get(c.add.remote(1)) == 16
+
+    def test_exceptions_propagate_with_type(self):
+        @ray_tpu.remote
+        class Boom:
+            def go(self):
+                raise KeyError("kaput")
+
+        b = Boom.remote()
+        with pytest.raises(ray_tpu.RayTaskError) as ei:
+            ray_tpu.get(b.go.remote())
+        assert "kaput" in str(ei.value)
+
+    def test_tpu_actor_stays_in_process(self):
+        @ray_tpu.remote(num_tpus=0, num_cpus=1, in_process=True)
+        class Dev:
+            def pid(self):
+                return os.getpid()
+
+        d = Dev.remote()
+        assert ray_tpu.get(d.pid.remote()) == os.getpid()
+
+    def test_high_concurrency_actor_stays_in_process(self):
+        @ray_tpu.remote(max_concurrency=4)
+        class Wide:
+            def pid(self):
+                return os.getpid()
+
+        w = Wide.remote()
+        assert ray_tpu.get(w.pid.remote()) == os.getpid()
+
+    def test_unpicklable_state_falls_back_in_process(self):
+        import threading
+
+        lock = threading.Lock()  # locks cannot cross a process boundary
+
+        @ray_tpu.remote
+        class Locky:
+            def __init__(self, lk):
+                self.lk = lk
+
+            def pid(self):
+                return os.getpid()
+
+        a = Locky.remote(lock)
+        assert ray_tpu.get(a.pid.remote()) == os.getpid()
+
+
+class TestCrashContainment:
+    def test_hard_crash_kills_only_that_actor(self):
+        @ray_tpu.remote
+        class Bomb:
+            def boom(self):
+                os._exit(13)  # segfault-equivalent: no cleanup, no excepthook
+
+            def ok(self):
+                return True
+
+        @ray_tpu.remote
+        class Bystander:
+            def ping(self):
+                return "alive"
+
+        bomb, by = Bomb.remote(), Bystander.remote()
+        assert ray_tpu.get(by.ping.remote()) == "alive"
+        with pytest.raises(ray_tpu.RayActorError):
+            ray_tpu.get(bomb.boom.remote())
+        # the runtime and other actors are untouched
+        assert ray_tpu.get(by.ping.remote()) == "alive"
+        with pytest.raises(ray_tpu.RayActorError):
+            ray_tpu.get(bomb.ok.remote())  # dead actor stays dead
+
+    def test_restart_respawns_fresh_process(self, tmp_path):
+        marker = str(tmp_path / "died_once")
+
+        @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+        class Phoenix:
+            def pid_or_die(self, marker_path):
+                if not os.path.exists(marker_path):
+                    open(marker_path, "w").write("x")
+                    os._exit(7)  # first attempt dies AFTER leaving the marker
+                return os.getpid()
+
+        p = Phoenix.remote()
+        # the first attempt crashes the child; the retry lands on the
+        # restarted actor in a fresh process and succeeds
+        pid = ray_tpu.get(p.pid_or_die.remote(marker), timeout=60.0)
+        assert pid != os.getpid()
+
+    def test_kill_terminates_child(self):
+        @ray_tpu.remote
+        class Victim:
+            def pid(self):
+                return os.getpid()
+
+        v = Victim.remote()
+        child = ray_tpu.get(v.pid.remote())
+        assert child != os.getpid()
+        ray_tpu.kill(v)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                os.kill(child, 0)  # raises when the process is gone
+            except ProcessLookupError:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"child {child} still alive after kill()")
+
+
+class TestInteraction:
+    def test_object_refs_resolve_into_child(self):
+        @ray_tpu.remote
+        def produce():
+            return {"data": [1, 2, 3]}
+
+        @ray_tpu.remote
+        class Consumer:
+            def total(self, payload):
+                return sum(payload["data"])
+
+        c = Consumer.remote()
+        ref = produce.remote()
+        # the ref materializes parent-side, the VALUE crosses to the child
+        assert ray_tpu.get(c.total.remote(ref)) == 6
+
+    def test_named_actor_round_trip(self):
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v):
+                self.d[k] = v
+                return True
+
+            def get(self, k):
+                return self.d.get(k)
+
+        a = Registry.options(name="proc_registry").remote()
+        ray_tpu.get(a.put.remote("k", 42))
+        b = ray_tpu.get_actor("proc_registry")
+        assert ray_tpu.get(b.get.remote("k")) == 42
+
+    def test_print_lands_in_session_logs(self):
+        from ray_tpu.core.logging import log_dir as session_log_dir
+
+        @ray_tpu.remote
+        class Chatty:
+            def speak(self):
+                print("actor process says hi")
+                return os.getpid()
+
+        pid = ray_tpu.get(Chatty.remote().speak.remote())
+        if pid == os.getpid():
+            pytest.skip("ran in-process")
+        path = os.path.join(session_log_dir(), f"actor-{pid}.out")
+        deadline = time.monotonic() + 10
+        text = ""
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                text = open(path).read()
+                if "actor process says hi" in text:
+                    break
+            time.sleep(0.1)
+        assert "actor process says hi" in text
+
+
+class TestReviewRegressions:
+    def test_init_error_surfaces_real_exception(self):
+        @ray_tpu.remote
+        class Bad:
+            def __init__(self):
+                raise ValueError("my init error")
+
+            def ping(self):
+                return True
+
+        b = Bad.remote()
+        with pytest.raises(ray_tpu.RayActorError) as ei:
+            ray_tpu.get(b.ping.remote())
+        # the user's ValueError, not an AttributeError from teardown
+        assert "my init error" in str(ei.value), str(ei.value)
+        assert "AttributeError" not in str(ei.value), str(ei.value)
+
+    def test_forced_isolation_with_unpicklable_state_raises(self):
+        import threading
+
+        @ray_tpu.remote(in_process=False)
+        class Forced:
+            def __init__(self, lk):
+                self.lk = lk
+
+            def ping(self):
+                return True
+
+        f = Forced.remote(threading.Lock())
+        with pytest.raises(ray_tpu.RayActorError) as ei:
+            ray_tpu.get(f.ping.remote())
+        assert "cross" in str(ei.value) or "Serializable" in str(ei.value)

@@ -156,8 +156,11 @@ class TestRuntimeIntegration:
             if os.path.exists(marker):
                 os.unlink(marker)
 
-    def test_actor_stays_in_process(self, proc_runtime):
-        # actors hold state: they must NOT move to the process pool
+    def test_actor_state_never_routes_through_the_pool(self, proc_runtime):
+        # actors hold state: their tasks must NOT round-robin over pool
+        # workers. A CPU actor now lives in its own DEDICATED process
+        # (core/actor_process.py), so every call sees the same pid and the
+        # same state; in-process actors (in_process=True) see the driver pid.
         @ray_tpu.remote
         class Counter:
             def __init__(self):
@@ -174,7 +177,13 @@ class TestRuntimeIntegration:
         c = Counter.remote()
         assert ray_tpu.get(c.incr.remote()) == 1
         assert ray_tpu.get(c.incr.remote()) == 2
-        assert ray_tpu.get(c.where.remote()) == os.getpid()
+        home = ray_tpu.get(c.where.remote())
+        assert home != os.getpid()  # isolated, not in the driver
+        assert ray_tpu.get(c.where.remote()) == home  # pinned to one process
+
+        pinned = Counter.options(in_process=True).remote()
+        ray_tpu.get(pinned.incr.remote())
+        assert ray_tpu.get(pinned.where.remote()) == os.getpid()
 
     def test_runtime_api_inside_worker_raises_clearly(self, proc_runtime):
         # ray_tpu.put() inside a pool worker must not auto-init a private
