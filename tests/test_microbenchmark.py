@@ -1,0 +1,30 @@
+"""Microbenchmark harness smoke tests (reference: `ray_perf.py` /
+`ray microbenchmark`): every pattern runs, reports sane positive rates,
+and cleans its actors up so patterns can't starve each other."""
+
+import json
+
+import ray_tpu
+from ray_tpu import microbenchmark as mb
+from ray_tpu.util import state as state_api
+
+
+class TestPatterns:
+    def test_all_patterns_report_positive_rates(self, ray_start_regular, capsys):
+        rows = mb.run_all(min_seconds=0.2)
+        assert len(rows) == 8
+        for rec in rows:
+            assert rec["value"] > 0, rec
+            assert rec["metric"].startswith("micro_")
+        # one JSON line per pattern on stdout (the CLI contract)
+        lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+        assert len(lines) == 8
+        for ln in lines:
+            json.loads(ln)
+
+    def test_actor_patterns_release_their_actors(self, ray_start_regular):
+        mb.bench_actor_sync(ray_tpu, min_seconds=0.1)
+        mb.bench_actor_process_sync(ray_tpu, min_seconds=0.1)
+        alive = [a for a in state_api.list_actors()
+                 if a.get("state") == "ALIVE"]
+        assert alive == [], alive
