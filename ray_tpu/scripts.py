@@ -254,6 +254,45 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_serve_run(args) -> int:
+    """Run serve apps in the foreground from a YAML/JSON config or an
+    import path (reference: `serve run` / `serve deploy` config shape)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import ServeConfigSchema, apply
+
+    ray_tpu.init()
+    target = args.config_or_import_path
+    if target.endswith((".yaml", ".yml", ".json")):
+        config = ServeConfigSchema.load(target)
+        if args.http_port:
+            config.http_port = args.http_port
+        status = apply(config)
+    else:
+        import importlib
+
+        module, _, attr = target.partition(":")
+        app = getattr(importlib.import_module(module), attr or "app")
+        serve.run(app, http_port=args.http_port)
+        status = serve.status()
+    print(json.dumps(status, indent=2, default=str))
+    print(f"serving on http://127.0.0.1:{serve.http_port()} (ctrl-c to stop)",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        serve.shutdown()
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu.microbenchmark import run_all
+
+    run_all()
+    return 0
+
+
 def cmd_timeline(args) -> int:
     if args.events_dir:
         # merge per-session dumps (written on runtime shutdown when
@@ -350,6 +389,18 @@ def main(argv=None) -> int:
     pb = sub.add_parser("bench", help="run the driver benchmarks")
     pb.add_argument("--suite", default="train,serve,data")
     pb.set_defaults(fn=cmd_bench)
+
+    pm = sub.add_parser("microbenchmark",
+                        help="core task/actor/object-plane throughput canaries")
+    pm.set_defaults(fn=cmd_microbenchmark)
+
+    psv = sub.add_parser("serve", help="serve apps from a config or import path")
+    psv_sub = psv.add_subparsers(dest="serve_cmd", required=True)
+    psr = psv_sub.add_parser("run", help="deploy + serve in the foreground")
+    psr.add_argument("config_or_import_path",
+                     help="a serve YAML/JSON config, or module:attr")
+    psr.add_argument("--http-port", type=int, default=0)
+    psr.set_defaults(fn=cmd_serve_run)
 
     args = p.parse_args(argv)
     if hasattr(args, "entrypoint"):

@@ -19,7 +19,7 @@ logger = get_logger("serve.api")
 
 _state_lock = threading.Lock()
 _proxy: Optional[HTTPProxy] = None
-_apps: Dict[str, str] = {}  # app name -> deployment name
+_apps: Dict[str, tuple] = {}  # app name -> (deployment name, http route)
 
 
 def run(
@@ -45,14 +45,15 @@ def run(
         dep.name, dep._target, app.init_args, app.init_kwargs, dep.config
     ))
     handle = DeploymentHandle(dep.name, controller)
+    route = (route_prefix or name or dep.name).strip("/")
     with _state_lock:
-        _apps[name] = dep.name
+        _apps[name] = (dep.name, route)
         if _proxy is None:
             _proxy = HTTPProxy(port=http_port)
             _proxy.start()
-        _proxy.add_route(name or dep.name, handle)
+        _proxy.add_route(route, handle)
     logger.info("app %r -> deployment %r at /%s (port %d)",
-                name, dep.name, name, _proxy.port)
+                name, dep.name, route, _proxy.port)
     if blocking:  # pragma: no cover
         threading.Event().wait()
     return handle
@@ -60,7 +61,7 @@ def run(
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:
     with _state_lock:
-        dep_name = _apps[name]
+        dep_name, _ = _apps[name]
     return DeploymentHandle(dep_name)
 
 
@@ -84,9 +85,10 @@ def status() -> Dict[str, Any]:
 def delete(name: str = "default") -> None:
     global _proxy
     with _state_lock:
-        dep_name = _apps.pop(name, None)
+        entry = _apps.pop(name, None)
+        dep_name, route = entry if entry else (None, name)
         if _proxy is not None:
-            _proxy.remove_route(name)
+            _proxy.remove_route(route)
     if dep_name is not None:
         controller = core_api.get_actor(CONTROLLER_NAME)
         core_api.get(controller.delete_deployment.remote(dep_name))

@@ -58,14 +58,22 @@ class HTTPProxy:
 
             def do_POST(self):
                 parts = [p for p in self.path.split("/") if p]
-                if not parts or parts[0] not in proxy.routes:
+                # longest-prefix route match (route prefixes may span
+                # several segments, e.g. /api/v9); remaining segments map
+                # to underscored methods, so the OpenAI wire path
+                # /v1/chat/completions hits chat_completions
+                handle = None
+                rest: list = []
+                for i in range(len(parts), 0, -1):
+                    candidate = "/".join(parts[:i])
+                    if candidate in proxy.routes:
+                        handle = proxy.routes[candidate]
+                        rest = parts[i:]
+                        break
+                if handle is None:
                     return self._send(404, {"error": f"no app at {self.path}"})
-                handle = proxy.routes[parts[0]]
-                if len(parts) > 1:
-                    # nested paths map to underscored methods, so the
-                    # OpenAI wire path /v1/chat/completions hits
-                    # chat_completions on the deployment
-                    handle = handle.options("_".join(parts[1:]))
+                if rest:
+                    handle = handle.options("_".join(rest))
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b"{}"
                 try:

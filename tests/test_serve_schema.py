@@ -1,0 +1,187 @@
+"""Declarative serve config tests (reference: `serve/schema.py` + the
+`serve deploy` YAML): parse/validate, import + override application, and
+the `ray-tpu serve run` CLI end-to-end over HTTP."""
+
+import json
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import pytest
+
+from ray_tpu.serve.schema import (
+    ApplicationSchema,
+    ServeConfigSchema,
+    build_app,
+)
+
+# a real importable app target for the schema tests
+APP_MODULE = textwrap.dedent("""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Hello:
+        def __init__(self, greeting="hi"):
+            self.greeting = greeting
+
+        def __call__(self, request):
+            return {"msg": f"{self.greeting} {request.get('who', 'world')}"}
+
+    app = Hello.bind("hello")
+
+    def build(greeting="yo"):
+        return Hello.bind(greeting)
+""")
+
+
+@pytest.fixture
+def app_module(tmp_path, monkeypatch):
+    mod = tmp_path / "sample_serve_app.py"
+    mod.write_text(APP_MODULE)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield "sample_serve_app"
+    sys.modules.pop("sample_serve_app", None)
+
+
+class TestSchema:
+    def test_yaml_round_trip(self, tmp_path, app_module):
+        cfg = tmp_path / "serve.yaml"
+        cfg.write_text(textwrap.dedent(f"""
+            applications:
+              - name: hello
+                import_path: {app_module}:app
+                deployments:
+                  - name: Hello
+                    num_replicas: 2
+                    max_ongoing_requests: 16
+        """))
+        schema = ServeConfigSchema.load(str(cfg))
+        assert len(schema.applications) == 1
+        app = build_app(schema.applications[0])
+        assert app.deployment.config.num_replicas == 2
+        assert app.deployment.config.max_ongoing_requests == 16
+        assert app.init_args == ("hello",)
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError) as ei:
+            ServeConfigSchema.parse({
+                "applications": [{"name": "x", "import_path": "m:a",
+                                  "replicas": 3}],
+            })
+        assert "replicas" in str(ei.value)
+
+    def test_builder_with_kwargs(self, app_module):
+        app = build_app(ApplicationSchema(
+            name="b", import_path=f"{app_module}:build",
+            kwargs={"greeting": "hey"},
+        ))
+        assert app.deployment.name == "Hello"
+
+    def test_bad_import_path_message(self):
+        with pytest.raises(ValueError) as ei:
+            build_app(ApplicationSchema(name="x", import_path="no_colon"))
+        assert "module:attribute" in str(ei.value)
+
+    def test_apply_deploys_and_serves(self, ray_start_regular, app_module,
+                                      tmp_path):
+        from ray_tpu import serve
+
+        cfg = tmp_path / "serve.yaml"
+        cfg.write_text(textwrap.dedent(f"""
+            applications:
+              - name: hello
+                import_path: {app_module}:app
+        """))
+        try:
+            from ray_tpu.serve.schema import apply
+
+            status = apply(ServeConfigSchema.load(str(cfg)))
+            assert "Hello" in str(status)
+            port = serve.http_port()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/hello",
+                data=json.dumps({"who": "schema"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.loads(r.read())
+            assert body["result"] == {"msg": "hello schema"}
+        finally:
+            serve.shutdown()
+
+
+class TestCLI:
+    def test_serve_run_cli_end_to_end(self, tmp_path):
+        import os
+        import time
+
+        mod = tmp_path / "cli_serve_app.py"
+        mod.write_text(APP_MODULE)
+        cfg = tmp_path / "app.yaml"
+        cfg.write_text(textwrap.dedent("""
+            applications:
+              - name: cliapp
+                import_path: cli_serve_app:app
+        """))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ,
+                   PYTHONPATH=f"{repo}{os.pathsep}{tmp_path}",
+                   JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts", "serve", "run",
+             str(cfg), "--http-port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        try:
+            # wait for the "serving on http://...:PORT" banner on stderr
+            port = None
+            deadline = time.monotonic() + 120
+            line = ""
+            while time.monotonic() < deadline:
+                line = proc.stderr.readline()
+                if "serving on" in line:
+                    port = int(line.rsplit(":", 1)[1].split()[0])
+                    break
+            assert port, f"no banner; stderr so far: {line!r}"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/cliapp",
+                data=json.dumps({"who": "cli"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["result"] == {"msg": "hello cli"}
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+
+class TestReviewRegressions:
+    def test_builder_args_not_applied_twice(self, app_module):
+        # builder consumes the args; bind() must NOT receive them again
+        app = build_app(ApplicationSchema(
+            name="b", import_path=f"{app_module}:build", args=["salut"],
+        ))
+        assert app.init_args == ("salut",)
+
+    def test_route_prefix_respected(self, ray_start_regular, app_module):
+        from ray_tpu import serve
+
+        try:
+            app = build_app(ApplicationSchema(
+                name="routed", import_path=f"{app_module}:app",
+                route_prefix="/api/v9",
+            ))
+            serve.run(app, name="routed", route_prefix="/api/v9")
+            port = serve.http_port()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v9",
+                data=json.dumps({"who": "router"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["result"]["msg"] == "hello router"
+            serve.delete("routed")  # removes the custom route, not /routed
+        finally:
+            serve.shutdown()
