@@ -240,6 +240,13 @@ class ActorProcess:
         box: list = []
         with self._lock:
             self._waiters[tag] = (event, box)
+        # _fail_all_waiters may have snapshotted BEFORE our registration
+        # (child died concurrently): re-check so this call fails instead of
+        # waiting on an event no reader thread will ever set
+        if self._dead.is_set():
+            with self._lock:
+                self._waiters.pop(tag, None)
+            raise ActorProcessCrash("actor process is dead")
         self._req_q.put(("call", tag, method, payload))
         if not event.wait(timeout=timeout):
             with self._lock:

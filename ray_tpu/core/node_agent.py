@@ -473,6 +473,16 @@ class NodeAgent:
                     "running in-process", spec.name, e,
                 )
         else:
+            if spec.options.runtime_env:
+                from .runtime_env import RuntimeEnvError
+
+                # same strictness as the task path (node_agent._invoke):
+                # an env that cannot be applied must not be silently dropped
+                raise RuntimeEnvError(
+                    f"actor {spec.name} has a runtime_env but would run "
+                    "in-process (device actor / max_concurrency>1 / "
+                    "in_process=True) where env isolation is impossible"
+                )
             _actors_isolated_counter.inc(tags={"mode": "in_process"})
         return spec.func(*args, **kwargs), None
 

@@ -104,8 +104,11 @@ class JobSubmissionClient:
         metadata: Optional[Dict[str, str]] = None,
     ) -> str:
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        # num_cpus=0: the supervisor just babysits a subprocess (reference
+        # JobSupervisor is likewise zero-CPU) — the entrypoint's own work
+        # is accounted by whatever IT schedules
         sup = JobSupervisor.options(
-            name=f"_job_supervisor:{job_id}", max_concurrency=4
+            name=f"_job_supervisor:{job_id}", max_concurrency=4, num_cpus=0
         ).remote(job_id, entrypoint, runtime_env)
         self._supervisors[job_id] = sup
         rt = api._auto_init()

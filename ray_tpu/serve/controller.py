@@ -276,7 +276,9 @@ def get_or_create_controller():
         return api.get_actor(CONTROLLER_NAME)
     except ValueError:
         # in_process: the controller drives the runtime (spawns/kills
-        # replica actors) — worker processes have no runtime back-channel
+        # replica actors) — worker processes have no runtime back-channel.
+        # num_cpus=0: system actor (the reference's controller likewise
+        # requests zero CPUs), so it never starves replicas on small hosts.
         return ServeController.options(
-            name=CONTROLLER_NAME, in_process=True
+            name=CONTROLLER_NAME, in_process=True, num_cpus=0
         ).remote()

@@ -239,3 +239,15 @@ class TestReviewRegressions:
         with pytest.raises(ray_tpu.RayActorError) as ei:
             ray_tpu.get(f.ping.remote())
         assert "cross" in str(ei.value) or "Serializable" in str(ei.value)
+
+    def test_in_process_actor_with_runtime_env_rejected(self):
+        @ray_tpu.remote(max_concurrency=4,
+                        runtime_env={"env_vars": {"MODE": "prod"}})
+        class Wide:
+            def ping(self):
+                return True
+
+        w = Wide.remote()
+        with pytest.raises(ray_tpu.RayActorError) as ei:
+            ray_tpu.get(w.ping.remote())
+        assert "runtime_env" in str(ei.value)
