@@ -1,0 +1,177 @@
+"""IMPALA (reference: `rllib/algorithms/impala/` — distributed actor-
+learner with V-trace off-policy correction, Espeholt et al. 2018).
+
+The shape that matters: EnvRunner actors sample with a BEHAVIOR policy
+that lags the learner (weights broadcast every `broadcast_interval`
+iterations, like the reference's asynchronous weight sync), and the
+learner corrects the off-policyness with V-trace — clipped importance
+ratios rho/c weight the TD errors, computed by a backward lax.scan inside
+the jitted update, so on TPU the whole correction fuses into the step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.logging import get_logger
+from .env_runner import EnvRunnerGroup
+from .module import init_mlp_module, mlp_forward, mlp_forward_np
+
+logger = get_logger("rl.impala")
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env_fn: Callable[[], Any] = None
+    num_env_runners: int = 2
+    rollout_steps_per_runner: int = 256
+    broadcast_interval: int = 2  # iterations between behavior-weight syncs
+    lr: float = 5e-4
+    gamma: float = 0.99
+    rho_bar: float = 1.0  # V-trace importance clip for the TD term
+    c_bar: float = 1.0  # V-trace trace-cutting clip
+    num_passes: int = 1  # SGD passes per rollout (V-trace corrects the drift)
+    entropy_coef: float = 0.01
+    baseline_coef: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+
+def vtrace_targets(behavior_logp, target_logp, rewards, values,
+                   bootstrap_value, dones, gamma, rho_bar, c_bar):
+    """V-trace value targets + policy-gradient advantages (jax, scan-able).
+
+    All inputs are flat [T] sequences; `dones` cuts episodes (terminal
+    transitions bootstrap nothing and traces do not cross the boundary)."""
+    rho = jnp.minimum(rho_bar, jnp.exp(target_logp - behavior_logp))
+    c = jnp.minimum(c_bar, jnp.exp(target_logp - behavior_logp))
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], jnp.array([bootstrap_value])])
+    # at an episode cut, the "next state" belongs to a new episode:
+    # bootstrap with 0 (terminal) via the nonterminal mask
+    deltas = rho * (rewards + gamma * nonterminal * next_values - values)
+
+    def backward(carry, xs):
+        acc = carry
+        delta_t, c_t, nt_t = xs
+        acc = delta_t + gamma * nt_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, 0.0, (deltas, c, nonterminal), reverse=True
+    )
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], jnp.array([bootstrap_value])])
+    pg_adv = rho * (rewards + gamma * nonterminal * next_vs - values)
+    return vs, pg_adv
+
+
+class IMPALA:
+    def __init__(self, config: IMPALAConfig):
+        assert config.env_fn is not None, "IMPALAConfig.env_fn required"
+        self.config = config
+        env = config.env_fn()
+        self.params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), env.observation_size,
+            env.num_actions, config.hidden,
+        )
+        self.behavior_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.runners = EnvRunnerGroup(
+            config.env_fn, mlp_forward_np, config.num_env_runners, config.seed
+        )
+        self._update = self._build_update()
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            logits, values = mlp_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            vs, pg_adv = vtrace_targets(
+                batch["behavior_logp"], jax.lax.stop_gradient(target_logp),
+                batch["rewards"], jax.lax.stop_gradient(values),
+                batch["bootstrap_value"], batch["dones"],
+                cfg.gamma, cfg.rho_bar, cfg.c_bar,
+            )
+            pg_loss = -jnp.mean(jax.lax.stop_gradient(pg_adv) * target_logp)
+            baseline_loss = 0.5 * jnp.mean(
+                (values - jax.lax.stop_gradient(vs)) ** 2
+            )
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.baseline_coef * baseline_loss
+                     - cfg.entropy_coef * entropy)
+            return total, {"pg_loss": pg_loss, "baseline_loss": baseline_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sample with the (possibly stale) behavior policy,
+        one V-trace-corrected gradient step per rollout."""
+        cfg = self.config
+        if self.iteration % cfg.broadcast_interval == 0:
+            self.behavior_params = self.params  # async-style weight sync
+        # ALWAYS pass the (stale) behavior params: a runner restarted after
+        # a crash mid-interval starts weightless and would assert on every
+        # sample until the next broadcast otherwise. Passing the same stale
+        # pytree preserves the intended behavior lag.
+        rollouts = self.runners.sample(
+            cfg.rollout_steps_per_runner, self.behavior_params
+        )
+        if not rollouts:
+            raise RuntimeError("all env runners failed")
+        metrics: Dict[str, Any] = {}
+        ep_returns: List[float] = []
+        timesteps = 0
+        batches = []  # host->device once, reused across passes
+        for ro in rollouts:
+            timesteps += len(ro["obs"])
+            ep_returns.extend(ro["episode_returns"].tolist())
+            batches.append({
+                "obs": jnp.asarray(ro["obs"]),
+                "actions": jnp.asarray(ro["actions"]),
+                "rewards": jnp.asarray(ro["rewards"]),
+                "dones": jnp.asarray(ro["dones"]),
+                "behavior_logp": jnp.asarray(ro["logp"]),
+                "bootstrap_value": jnp.asarray(ro["bootstrap_value"]),
+            })
+        for _ in range(max(1, cfg.num_passes)):
+            for batch in batches:
+                self.params, self.opt_state, metrics = self._update(
+                    self.params, self.opt_state, batch
+                )
+        self.iteration += 1
+        self._recent_returns.extend(ep_returns)
+        self._recent_returns = self._recent_returns[-100:]
+        out = {k: float(v) for k, v in metrics.items()}
+        out.update({
+            "training_iteration": self.iteration,
+            "episodes_this_iter": len(ep_returns),
+            "timesteps_this_iter": timesteps,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else 0.0,
+        })
+        return out

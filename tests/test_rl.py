@@ -284,3 +284,70 @@ class TestMultiAgent:
         ))
         result = algo.train()
         assert set(result["loss_by_policy"]) == {"p0", "p1"}
+
+
+class TestIMPALA:
+    def test_vtrace_reduces_to_td_when_on_policy(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import vtrace_targets
+
+        # on-policy (ratios=1), one episode, gamma=1, no clipping active:
+        # vs should equal the reward-to-go (Monte Carlo return)
+        T = 4
+        rewards = jnp.array([1.0, 1.0, 1.0, 1.0])
+        values = jnp.array([0.5, 0.5, 0.5, 0.5])
+        logp = jnp.zeros(T)
+        dones = jnp.array([False, False, False, True])
+        vs, pg_adv = vtrace_targets(
+            logp, logp, rewards, values, 9.9, dones,
+            gamma=1.0, rho_bar=1.0, c_bar=1.0,
+        )
+        np.testing.assert_allclose(np.asarray(vs), [4.0, 3.0, 2.0, 1.0],
+                                   atol=1e-5)
+
+    def test_clipped_ratios_bound_the_correction(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import vtrace_targets
+
+        T = 3
+        rewards = jnp.ones(T)
+        values = jnp.zeros(T)
+        behavior = jnp.zeros(T)
+        target = jnp.full(T, 5.0)  # wildly off-policy: raw ratio e^5
+        dones = jnp.array([False, False, True])
+        vs_clipped, _ = vtrace_targets(
+            behavior, target, rewards, values, 0.0, dones,
+            gamma=1.0, rho_bar=1.0, c_bar=1.0,
+        )
+        # with rho/c clipped at 1 the targets match the on-policy case
+        vs_on, _ = vtrace_targets(
+            behavior, behavior, rewards, values, 0.0, dones,
+            gamma=1.0, rho_bar=1.0, c_bar=1.0,
+        )
+        np.testing.assert_allclose(np.asarray(vs_clipped), np.asarray(vs_on),
+                                   atol=1e-5)
+
+    def test_learns_cartpole_with_stale_behavior(self, ray_start_regular):
+        from ray_tpu.rl import IMPALA, IMPALAConfig
+
+        algo = IMPALA(IMPALAConfig(
+            env_fn=CartPole,
+            num_env_runners=2,
+            rollout_steps_per_runner=256,
+            broadcast_interval=2,  # behavior lags the learner: V-trace earns it
+            num_passes=2,
+            lr=2e-3,
+            seed=0,
+        ))
+        first = None
+        result = None
+        for _ in range(50):
+            result = algo.train()
+            if first is None and result["episodes_this_iter"]:
+                first = result["episode_return_mean"]
+            if result["episode_return_mean"] > 120.0:
+                break
+        final = result["episode_return_mean"]
+        assert final > 70.0 and final > (first or 0) * 1.5, (first, final)
