@@ -127,6 +127,9 @@ class Dataset:
     def iter_rows(self) -> Iterator[Any]:
         return self.iterator().iter_rows()
 
+    def iter_torch_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_torch_batches(**kw)
+
     def iter_device_batches(self, **kw) -> Iterator[Any]:
         return self.iterator().iter_device_batches(**kw)
 

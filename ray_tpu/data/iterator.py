@@ -94,6 +94,49 @@ class DataIterator:
         if pending_rows and not drop_last:
             yield emit_from(pending)
 
+    def iter_torch_batches(
+        self,
+        batch_size: int = 256,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: Optional[str] = None,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        """Batches as torch tensors (reference: `iter_torch_batches`).
+
+        On this stack torch is the HOST-side interop format (CPU feature
+        pipelines, torch-native eval code); the accelerator path is
+        `iter_device_batches` (jax / HBM prefetch). dtypes maps column ->
+        torch dtype; device is a torch device string."""
+        import torch
+
+        def to_torch(col, name):
+            arr = np.asarray(col)
+            if arr.dtype == object:
+                raise TypeError(
+                    f"column {name!r} is not tensor-convertible (object "
+                    "dtype); map it to numeric first"
+                )
+            t = torch.from_numpy(np.ascontiguousarray(arr))
+            if dtypes and name in dtypes:
+                t = t.to(dtypes[name])
+            if device:
+                t = t.to(device)
+            return t
+
+        for batch in self.iter_batches(
+            batch_size=batch_size,
+            batch_format="numpy",
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed,
+        ):
+            if isinstance(batch, dict):
+                yield {k: to_torch(v, k) for k, v in batch.items()}
+            else:
+                yield to_torch(batch, "<batch>")
+
     def iter_device_batches(
         self,
         batch_size: int,

@@ -278,3 +278,23 @@ class TestWriteJson:
         rows = sorted(back.take_all(), key=lambda r: r["a"])
         assert [r["a"] for r in rows] == list(builtins.range(6))
         assert list(rows[2]["v"]) == [2, 3]
+
+
+class TestTorchBatches:
+    def test_tensors_with_dtypes(self, ray_start_regular):
+        import torch
+
+        ds = data.from_numpy({"x": np.arange(10, dtype=np.float64),
+                              "y": np.arange(10, dtype=np.int64)})
+        batches = list(ds.iter_torch_batches(
+            batch_size=4, dtypes={"x": torch.float32}))
+        assert [len(b["x"]) for b in batches] == [4, 4, 2]
+        assert batches[0]["x"].dtype == torch.float32
+        assert batches[0]["y"].dtype == torch.int64
+        total = torch.cat([b["y"] for b in batches]).sum().item()
+        assert total == sum(range(10))
+
+    def test_object_column_rejected(self, ray_start_regular):
+        ds = data.from_items([{"s": "a"}, {"s": "bb"}])
+        with pytest.raises(TypeError):
+            list(ds.iter_torch_batches(batch_size=2))
