@@ -253,6 +253,31 @@ class TestEngine:
         )
         return InferenceEngine(params, cfg, ecfg), params, cfg
 
+    def test_batched_prefill_matches_single(self):
+        import threading as _threading
+
+        # same prompts through prefill_batch_size=3 and =1 (greedy):
+        # coalesced padded prefill must not change any output
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 2], [9, 1, 3]]
+        outs = {}
+        for K in (1, 3):
+            engine, _, _ = self._engine(prefill_batch_size=K)
+            results = [None] * len(prompts)
+
+            def worker(i, eng=engine, res=results):
+                res[i] = eng.generate(prompts[i], max_tokens=6,
+                                      temperature=0.0)
+
+            threads = [_threading.Thread(target=worker, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            outs[K] = [r["token_ids"] for r in results]
+            engine.stop()
+        assert outs[1] == outs[3], (outs[1], outs[3])
+
     def test_matches_reference_generate(self):
         engine, params, cfg = self._engine()
         prompt = [5, 6, 7, 8, 9, 10]
@@ -378,8 +403,8 @@ class TestEngine:
         real_prefill_fn = engine._prefill_fn
         slow = {"armed": False}
 
-        def slow_prefill_fn(bucket):
-            fn = real_prefill_fn(bucket)
+        def slow_prefill_fn(bucket, batch=1):
+            fn = real_prefill_fn(bucket, batch)
 
             def wrapped(*a, **kw):
                 if slow["armed"]:
