@@ -25,6 +25,7 @@ from .config import (  # noqa: F401
     RunConfig,
     ScalingConfig,
 )
+from .integrations import MLflowLoggerCallback, WandbLoggerCallback  # noqa: F401
 from .result import Result  # noqa: F401
 from .session import TrainContext, get_checkpoint, get_context, report  # noqa: F401
 from .trainer import JaxTrainer, TrainingFailedError  # noqa: F401

@@ -161,3 +161,16 @@ class JaxTrainer:
                     history.append(rep.metrics)
                     if rep.checkpoint is not None:
                         manager.register(rep.checkpoint, rep.metrics)
+                    # streaming callback protocol (integrations.py):
+                    # on_report(metrics) fires per rank-0 report; the
+                    # plain-callable protocol still gets history at the end
+                    for cb in self.run_config.callbacks:
+                        on_report = getattr(cb, "on_report", None)
+                        if callable(on_report):
+                            try:
+                                on_report(rep.metrics)
+                            except Exception:
+                                logger.warning(
+                                    "callback %r on_report failed",
+                                    cb, exc_info=True,
+                                )

@@ -1,0 +1,73 @@
+"""Experiment-tracking integration tests (reference: air/integrations):
+local-fallback run layout, streaming vs end-of-run protocols, and the
+trainer wiring that fires on_report per rank-0 report."""
+
+import json
+import os
+
+import pytest
+
+from ray_tpu.train import MLflowLoggerCallback, WandbLoggerCallback
+
+
+class TestLocalFallback:
+    def test_wandb_fallback_writes_run_layout(self, tmp_path):
+        cb = WandbLoggerCallback(project="proj", name="runA",
+                                 dir=str(tmp_path), config={"lr": 0.1})
+        cb.on_report({"loss": 1.0})
+        cb.on_report({"loss": 0.5})
+        cb([{"loss": 1.0}, {"loss": 0.5}])
+        run = tmp_path / "runA"
+        assert json.load(open(run / "config.json")) == {"lr": 0.1}
+        lines = [json.loads(ln) for ln in open(run / "history.jsonl")]
+        assert [ln["loss"] for ln in lines] == [1.0, 0.5]
+        assert [ln["_step"] for ln in lines] == [0, 1]
+        summary = json.load(open(run / "summary.json"))
+        assert summary["loss"] == 0.5 and summary["_num_reports"] == 2
+
+    def test_end_only_protocol_backfills(self, tmp_path):
+        cb = MLflowLoggerCallback(experiment_name="exp", name="runB",
+                                  dir=str(tmp_path))
+        cb([{"a": 1}, {"a": 2}, {"a": 3}])  # plain-callable protocol only
+        lines = [json.loads(ln) for ln in
+                 open(tmp_path / "runB" / "history.jsonl")]
+        assert [ln["a"] for ln in lines] == [1, 2, 3]
+
+
+class TestTrainerWiring:
+    def test_on_report_streams_per_rank0_report(self, ray_start_regular,
+                                                tmp_path):
+        from ray_tpu import train
+        from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+        streamed = []
+
+        class Probe:
+            def on_report(self, metrics):
+                streamed.append(dict(metrics))
+
+            def __call__(self, history):
+                streamed.append({"END": len(history)})
+
+        def loop(config):
+            for i in range(3):
+                train.report({"step": i, "loss": 1.0 / (i + 1)})
+
+        wandb_cb = WandbLoggerCallback(project="p", name="runC",
+                                       dir=str(tmp_path))
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1, use_tpu=False),
+            run_config=RunConfig(callbacks=[Probe(), wandb_cb],
+                                 storage_path=str(tmp_path / "store")),
+        ).fit()
+        assert result.error is None
+        assert streamed[:3] == [
+            {"step": 0, "loss": 1.0},
+            {"step": 1, "loss": 0.5},
+            {"step": 2, "loss": 1.0 / 3},
+        ]
+        assert streamed[-1] == {"END": 3}
+        lines = [json.loads(ln) for ln in
+                 open(tmp_path / "runC" / "history.jsonl")]
+        assert len(lines) == 3  # streamed, not backfilled twice
