@@ -1,4 +1,5 @@
 """ray_tpu.util — user-facing utilities (reference: `python/ray/util/`)."""
 
 from .actor_pool import ActorPool  # noqa: F401
+from .multiprocessing import Pool  # noqa: F401
 from .queue import Queue  # noqa: F401
