@@ -10,8 +10,10 @@ from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from .dataset import Dataset, GroupedData  # noqa: F401
 from .iterator import DataIterator  # noqa: F401
 from .read_api import (  # noqa: F401
+    from_arrow,
     from_items,
     from_numpy,
+    from_pandas,
     range,
     read_binary_files,
     read_csv,

@@ -52,11 +52,37 @@ def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
     return _make(tasks, "from_items", n)
 
 
-def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = 1) -> Dataset:
-    def task():
-        return {k: np.asarray(v) for k, v in arrays.items()}
+def from_pandas(df, *, parallelism: int = 1) -> Dataset:
+    """DataFrame -> Dataset (reference: `ray.data.from_pandas`)."""
+    cols = {c: df[c].to_numpy() for c in df.columns}
+    return from_numpy(cols, parallelism=parallelism)
 
-    return _make([task], "from_numpy")
+
+def from_arrow(table, *, parallelism: int = 1) -> Dataset:
+    """pyarrow Table -> Dataset (reference: `ray.data.from_arrow`)."""
+    cols = {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+    return from_numpy(cols, parallelism=parallelism)
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = 1) -> Dataset:
+    import builtins  # this module shadows `range` with the Dataset factory
+
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    parallelism = max(1, min(parallelism, n or 1))
+    cuts = [n * i // parallelism for i in builtins.range(parallelism + 1)]
+
+    def make_task(lo, hi):
+        def task():
+            return {k: v[lo:hi] for k, v in arrays.items()}
+        return task
+
+    tasks = [make_task(cuts[i], cuts[i + 1])
+             for i in builtins.range(parallelism)]
+    return _make(tasks, "from_numpy", num_rows=n)
 
 
 def _expand_paths(paths, suffix) -> List[str]:

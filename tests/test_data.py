@@ -298,3 +298,26 @@ class TestTorchBatches:
         ds = data.from_items([{"s": "a"}, {"s": "bb"}])
         with pytest.raises(TypeError):
             list(ds.iter_torch_batches(batch_size=2))
+
+
+class TestFromPandasArrow:
+    def test_from_pandas(self, ray_start_regular):
+        import pandas as pd
+
+        df = pd.DataFrame({"a": [1, 2, 3], "b": [1.5, 2.5, 3.5]})
+        ds = data.from_pandas(df)
+        assert ds.count() == 3
+        assert ds.sum("a") == 6
+
+    def test_from_arrow(self, ray_start_regular):
+        import pyarrow as pa
+
+        table = pa.table({"x": [10, 20], "y": ["u", "v"]})
+        rows = data.from_arrow(table).take_all()
+        assert [int(r["x"]) for r in rows] == [10, 20]
+
+    def test_from_numpy_parallelism_splits_blocks(self, ray_start_regular):
+        ds = data.from_numpy({"x": np.arange(10)}, parallelism=4)
+        blocks = list(ds._stream_refs())
+        assert len(blocks) == 4
+        assert ds.sum("x") == 45
