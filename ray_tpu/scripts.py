@@ -1,17 +1,17 @@
-"""Operator CLI: ``ray-tpu start|status|submit|list|bench``.
+"""Operator CLI: ``ray-tpu start|status|list|submit|logs|serve|memory|
+timeline|bench|microbenchmark``.
 
-Reference analogue: `python/ray/scripts/scripts.py` (`ray start/status/
-job submit/list`). Design difference, stated plainly: this runtime is
-single-process (no RPC control plane yet — SURVEY N8), so the CLI cannot
-attach to a runtime living in another process. Instead:
+Reference analogue: `python/ray/scripts/scripts.py`. Three ways to reach
+a runtime:
 
-- ``submit`` runs the entrypoint under a fresh runtime via the job
-  supervisor (subprocess entrypoint, streamed logs, exit code = job state).
-- ``status``/``list`` show the live runtime of THIS invocation (resources,
-  TPU topology) or, with ``--snapshot``, the tables of a persisted
-  control-plane snapshot from another (possibly dead) runtime.
-- ``start`` boots a long-lived session: snapshotting on, Prometheus
-  metrics exported, optional serve app deployed; blocks until SIGINT.
+- ``--address host:port`` attaches to a LIVE session's control-plane RPC
+  (``ray-tpu start`` serves it; status/list/logs --follow work remotely).
+- ``--snapshot path`` reads a persisted control-plane snapshot from a
+  possibly-dead runtime.
+- neither: commands run against a fresh in-process runtime (``submit``
+  supervises the entrypoint as a job; ``serve run`` deploys and blocks;
+  ``start`` boots the long-lived session: snapshots, metrics, RPC, log
+  publishing).
 """
 
 from __future__ import annotations
@@ -254,6 +254,37 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """Object-plane introspection (reference: `ray memory`): per-object
+    sizes and store totals — from this invocation's runtime, or from a
+    persisted snapshot (`--snapshot`). Objects are node-local, so there
+    is no `--address` mode (same contract as `ray-tpu list objects`)."""
+    if args.snapshot:
+        from ray_tpu.core import persistence
+
+        snap = persistence.load_snapshot(args.snapshot)
+        oids = snap.get("objects", [])
+        print("\n".join(oids) or "(none)")
+        print(f"\ntotal: {len(oids)} objects (snapshot)")
+        return 0
+    import ray_tpu
+    from ray_tpu.util import state
+
+    rt = ray_tpu.init()
+    rows = state.list_objects(limit=args.limit)
+    cols = list(rows[0].keys()) if rows else []
+    _print_rows(rows, cols)
+    total_bytes = 0
+    total_objects = 0
+    for agent in rt.agents.values():
+        stats = agent.store.stats()
+        total_bytes += stats.get("used_bytes", 0)
+        total_objects += stats.get("num_objects", 0)
+    print(f"\ntotal: {total_objects} objects, {total_bytes} bytes "
+          f"across {len(rt.agents)} node store(s)")
+    return 0
+
+
 def cmd_serve_run(args) -> int:
     """Run serve apps in the foreground from a YAML/JSON config or an
     import path (reference: `serve run` / `serve deploy` config shape)."""
@@ -369,6 +400,11 @@ def main(argv=None) -> int:
                      help="control-plane RPC port (0 = ephemeral)")
     pst.add_argument("--serve-app", help="module:attr of a serve Application")
     pst.set_defaults(fn=cmd_start)
+
+    pmem = sub.add_parser("memory", help="object-plane sizes and totals")
+    pmem.add_argument("--limit", type=int, default=100)
+    pmem.add_argument("--snapshot", help="read a control-plane snapshot file")
+    pmem.set_defaults(fn=cmd_memory)
 
     plog = sub.add_parser("logs", help="list/tail/follow session logs")
     plog.add_argument("file", nargs="?", help="log file name to tail")

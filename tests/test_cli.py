@@ -82,3 +82,20 @@ def test_timeline_merges_session_dumps(tmp_path):
     assert r.returncode == 0, r.stderr
     doc = json.load(open(out))
     assert any(e["cat"] == "task" for e in doc["traceEvents"])
+
+
+def test_cmd_memory_lists_objects(capsys):
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.scripts import main
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        ref = ray_tpu.put(np.arange(1000))
+        assert main(["memory"]) == 0
+        out = capsys.readouterr().out
+        assert ref.object_id.hex()[:16] in out
+        assert "total:" in out
+    finally:
+        ray_tpu.shutdown()
