@@ -18,3 +18,4 @@ from .multi_agent import (  # noqa: F401
 from .offline import BC, BCConfig, load_offline_dataset, rollouts_to_dataset, save_rollouts  # noqa: F401
 from .ppo import PPO, PPOConfig, compute_gae  # noqa: F401
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer, SumTree  # noqa: F401
+from .sac import SAC, SACConfig  # noqa: F401

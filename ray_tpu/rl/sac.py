@@ -1,0 +1,200 @@
+"""SAC, discrete-action variant (reference: `rllib/algorithms/sac/` —
+soft actor-critic with twin Q networks and learned entropy temperature;
+discrete formulation per Christodoulou 2019).
+
+Discrete actions make every expectation over the policy EXACT (a sum over
+the action set instead of a reparameterized sample), so the soft targets,
+policy loss, and entropy all compute in closed form inside one jitted
+update — no sampling noise in the learner. Off-policy: transitions come
+from the shared ReplayBuffer; collection uses the same EnvRunner actors
+(softmax over the policy logits is exactly the SAC behavior policy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.logging import get_logger
+from .env_runner import EnvRunnerGroup
+from .module import init_mlp_module, mlp_forward, mlp_forward_np
+from .replay_buffer import ReplayBuffer
+
+logger = get_logger("rl.sac")
+
+
+@dataclasses.dataclass
+class SACConfig:
+    env_fn: Callable[[], Any] = None
+    num_env_runners: int = 1
+    rollout_steps_per_runner: int = 256
+    buffer_capacity: int = 50_000
+    learning_starts: int = 512
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.01  # polyak coefficient for target networks
+    batch_size: int = 64
+    sgd_steps_per_iter: int = 64
+    target_entropy_scale: float = 0.7  # fraction of max entropy log|A|
+    init_alpha: float = 0.2
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        assert config.env_fn is not None, "SACConfig.env_fn required"
+        self.config = config
+        env = config.env_fn()
+        self.num_actions = env.num_actions
+        k = jax.random.split(jax.random.PRNGKey(config.seed), 3)
+        # pi head of each module = policy logits / Q values respectively
+        self.pi = init_mlp_module(k[0], env.observation_size,
+                                  env.num_actions, config.hidden)
+        self.q1 = init_mlp_module(k[1], env.observation_size,
+                                  env.num_actions, config.hidden)
+        self.q2 = init_mlp_module(k[2], env.observation_size,
+                                  env.num_actions, config.hidden)
+        self.q1_target = self.q1
+        self.q2_target = self.q2
+        self.log_alpha = jnp.asarray(np.log(config.init_alpha), jnp.float32)
+        self.opt = optax.adam(config.lr)
+        self.pi_opt = self.opt.init(self.pi)
+        self.q1_opt = self.opt.init(self.q1)
+        self.q2_opt = self.opt.init(self.q2)
+        self.alpha_opt = self.opt.init(self.log_alpha)
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.runners = EnvRunnerGroup(
+            config.env_fn, mlp_forward_np, config.num_env_runners, config.seed
+        )
+        self.target_entropy = (
+            config.target_entropy_scale * float(np.log(env.num_actions))
+        )
+        self._update = self._build_update()
+        self.iteration = 0
+        self.grad_steps = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        cfg = self.config
+
+        def q_of(params, obs):
+            q, _ = mlp_forward(params, obs)
+            return q  # [B, A]
+
+        def policy(params, obs):
+            logits, _ = mlp_forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            return jnp.exp(logp), logp  # probs, log-probs [B, A]
+
+        def soft_target(pi, q1_t, q2_t, log_alpha, batch):
+            probs, logp = policy(pi, batch["next_obs"])
+            q_min = jnp.minimum(q_of(q1_t, batch["next_obs"]),
+                                q_of(q2_t, batch["next_obs"]))
+            alpha = jnp.exp(log_alpha)
+            # exact soft state value: E_pi[min Q - alpha log pi]
+            v_next = jnp.sum(probs * (q_min - alpha * logp), axis=-1)
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            return batch["rewards"] + cfg.gamma * nonterminal * v_next
+
+        def critic_loss(q_params, target, batch):
+            q = q_of(q_params, batch["obs"])
+            q_a = jnp.take_along_axis(q, batch["actions"][:, None], -1)[:, 0]
+            return jnp.mean((q_a - target) ** 2)
+
+        def actor_loss(pi, q1, q2, log_alpha, batch):
+            probs, logp = policy(pi, batch["obs"])
+            q_min = jax.lax.stop_gradient(
+                jnp.minimum(q_of(q1, batch["obs"]), q_of(q2, batch["obs"]))
+            )
+            alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+            loss = jnp.mean(jnp.sum(probs * (alpha * logp - q_min), axis=-1))
+            entropy = -jnp.mean(jnp.sum(probs * logp, axis=-1))
+            return loss, entropy
+
+        def alpha_loss(log_alpha, entropy):
+            # drive entropy toward the target; alpha rises when entropy is low
+            return -log_alpha * jax.lax.stop_gradient(
+                self.target_entropy - entropy
+            )
+
+        @jax.jit
+        def update(pi, q1, q2, q1_t, q2_t, log_alpha,
+                   pi_opt, q1_opt, q2_opt, alpha_opt, batch):
+            target = jax.lax.stop_gradient(
+                soft_target(pi, q1_t, q2_t, log_alpha, batch)
+            )
+            q1_l, q1_g = jax.value_and_grad(critic_loss)(q1, target, batch)
+            q2_l, q2_g = jax.value_and_grad(critic_loss)(q2, target, batch)
+            up1, q1_opt = self.opt.update(q1_g, q1_opt)
+            q1 = optax.apply_updates(q1, up1)
+            up2, q2_opt = self.opt.update(q2_g, q2_opt)
+            q2 = optax.apply_updates(q2, up2)
+
+            (pi_l, entropy), pi_g = jax.value_and_grad(
+                actor_loss, has_aux=True)(pi, q1, q2, log_alpha, batch)
+            upp, pi_opt = self.opt.update(pi_g, pi_opt)
+            pi = optax.apply_updates(pi, upp)
+
+            a_l, a_g = jax.value_and_grad(alpha_loss)(log_alpha, entropy)
+            upa, alpha_opt = self.opt.update(a_g, alpha_opt)
+            log_alpha = optax.apply_updates(log_alpha, upa)
+
+            q1_t = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, q1_t, q1)
+            q2_t = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, q2_t, q2)
+            aux = {"q1_loss": q1_l, "q2_loss": q2_l, "pi_loss": pi_l,
+                   "entropy": entropy, "alpha": jnp.exp(log_alpha)}
+            return (pi, q1, q2, q1_t, q2_t, log_alpha,
+                    pi_opt, q1_opt, q2_opt, alpha_opt, aux)
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        # softmax over policy logits IS the SAC behavior policy
+        rollouts = self.runners.sample(cfg.rollout_steps_per_runner, self.pi)
+        if not rollouts:
+            raise RuntimeError("all env runners failed")
+        ep_returns: List[float] = []
+        for ro in rollouts:
+            self.buffer.add_batch({
+                "obs": ro["obs"], "actions": ro["actions"],
+                "rewards": ro["rewards"], "dones": ro["dones"],
+                "next_obs": ro["next_obs"],
+            })
+            ep_returns.extend(ro["episode_returns"].tolist())
+
+        aux: Dict[str, Any] = {}
+        if len(self.buffer) >= max(cfg.learning_starts, cfg.batch_size):
+            for _ in range(cfg.sgd_steps_per_iter):
+                batch = {k: jnp.asarray(v)
+                         for k, v in self.buffer.sample(cfg.batch_size).items()}
+                (self.pi, self.q1, self.q2, self.q1_target, self.q2_target,
+                 self.log_alpha, self.pi_opt, self.q1_opt, self.q2_opt,
+                 self.alpha_opt, aux) = self._update(
+                    self.pi, self.q1, self.q2, self.q1_target, self.q2_target,
+                    self.log_alpha, self.pi_opt, self.q1_opt, self.q2_opt,
+                    self.alpha_opt, batch,
+                )
+                self.grad_steps += 1
+
+        self.iteration += 1
+        self._recent_returns.extend(ep_returns)
+        self._recent_returns = self._recent_returns[-100:]
+        out = {k: float(v) for k, v in aux.items()}
+        out.update({
+            "training_iteration": self.iteration,
+            "grad_steps": self.grad_steps,
+            "buffer_size": len(self.buffer),
+            "episodes_this_iter": len(ep_returns),
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else 0.0,
+        })
+        return out

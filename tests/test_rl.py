@@ -351,3 +351,68 @@ class TestIMPALA:
                 break
         final = result["episode_return_mean"]
         assert final > 70.0 and final > (first or 0) * 1.5, (first, final)
+
+
+class TestSAC:
+    def test_learns_cartpole_with_entropy_autotune(self, ray_start_regular):
+        from ray_tpu.rl import SAC, SACConfig
+
+        algo = SAC(SACConfig(env_fn=CartPole, seed=0))
+        first = None
+        result = None
+        for _ in range(60):
+            result = algo.train()
+            if first is None and result["episodes_this_iter"]:
+                first = result["episode_return_mean"]
+            if result["episode_return_mean"] > 120.0:
+                break
+        final = result["episode_return_mean"]
+        assert final > 70.0 and final > (first or 0) * 1.5, (first, final)
+        # the temperature stayed live (autotuned, not stuck at init)
+        assert 0.0 < result["alpha"] < 5.0
+
+    def test_exact_soft_targets_reduce_to_q_learning_at_zero_alpha(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import SAC, SACConfig
+
+        algo = SAC(SACConfig(env_fn=CartPole, init_alpha=1e-8, seed=0))
+        # with alpha ~= 0 the soft value collapses to E_pi[min Q']; check
+        # the jitted update runs and critics move toward the bellman target
+        import numpy as np
+
+        batch = {
+            "obs": jnp.asarray(np.zeros((8, 4), np.float32)),
+            "actions": jnp.asarray(np.zeros(8, np.int32)),
+            "rewards": jnp.asarray(np.ones(8, np.float32)),
+            "dones": jnp.asarray(np.zeros(8, bool)),
+            "next_obs": jnp.asarray(np.zeros((8, 4), np.float32)),
+        }
+        from ray_tpu.rl.module import mlp_forward
+
+        # analytic check: at alpha ~= 0 the soft target reduces to plain
+        # expected-SARSA Q-learning, target = r + gamma * E_pi[min Q'](s')
+        probs = jax.nn.softmax(mlp_forward(algo.pi, batch["next_obs"])[0])
+        q_min = jnp.minimum(mlp_forward(algo.q1_target, batch["next_obs"])[0],
+                            mlp_forward(algo.q2_target, batch["next_obs"])[0])
+        expected = batch["rewards"] + 0.99 * jnp.sum(probs * q_min, axis=-1)
+        q1_now = jnp.take_along_axis(
+            mlp_forward(algo.q1, batch["obs"])[0],
+            batch["actions"][:, None], -1)[:, 0]
+        expected_loss = float(jnp.mean((q1_now - expected) ** 2))
+
+        state = (algo.pi, algo.q1, algo.q2, algo.q1_target, algo.q2_target,
+                 algo.log_alpha, algo.pi_opt, algo.q1_opt, algo.q2_opt,
+                 algo.alpha_opt)
+        first_loss = None
+        for _ in range(150):  # critics must move toward the bellman target
+            out = algo._update(*state, batch)
+            state, aux = out[:-1], out[-1]
+            if first_loss is None:
+                first_loss = float(aux["q1_loss"])
+        assert abs(first_loss - expected_loss) < 1e-3, (first_loss, expected_loss)
+        # descent is tempered by the polyak-moving target; require a clear
+        # monotonic-ish reduction, not convergence
+        assert float(aux["q1_loss"]) < first_loss * 0.7, (first_loss, aux)
+        assert np.isfinite(float(aux["pi_loss"]))
