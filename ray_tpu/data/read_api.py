@@ -76,8 +76,12 @@ def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = 1) -> Datase
     cuts = [n * i // parallelism for i in builtins.range(parallelism + 1)]
 
     def make_task(lo, hi):
+        # Slice up front: each closure ships only its partition, not the
+        # whole dict K times through the task plane (ADVICE r3).
+        part = {k: v[lo:hi] for k, v in arrays.items()}
+
         def task():
-            return {k: v[lo:hi] for k, v in arrays.items()}
+            return part
         return task
 
     tasks = [make_task(cuts[i], cuts[i + 1])

@@ -123,7 +123,11 @@ class DQN:
         for ro in rollouts:
             self.buffer.add_batch({
                 "obs": ro["obs"], "actions": ro["actions"],
-                "rewards": ro["rewards"], "dones": ro["dones"],
+                # mask the 1-step bootstrap only on TRUE terminals: at a
+                # time-limit truncation next_obs is the live pre-reset obs,
+                # so the target net bootstraps from it (ADVICE r3)
+                "rewards": ro["rewards"],
+                "dones": ro.get("terminateds", ro["dones"]),
                 "next_obs": ro["next_obs"],
             })
             self.env_steps += len(ro["obs"])

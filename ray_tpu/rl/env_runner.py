@@ -17,6 +17,20 @@ from ..core.logging import get_logger
 logger = get_logger("rl.env_runner")
 
 
+def fold_truncation_bootstrap(ro: Dict[str, np.ndarray], gamma: float) -> np.ndarray:
+    """Rewards with gamma*V(next_obs) folded in at time-limit cuts.
+
+    A truncation cuts the advantage/return recursion like a terminal, but
+    its continuation value is V(next_obs), not 0 (the time-limit bias,
+    ADVICE r3). Folding the bootstrap into the reward at the cut keeps
+    every done-masked consumer (GAE, V-trace) unbiased without changing
+    its recursion. Tolerates rollout dicts without the column."""
+    tv = ro.get("truncation_values")
+    if tv is None:
+        return ro["rewards"]
+    return ro["rewards"] + gamma * tv
+
+
 @api.remote
 class EnvRunner:
     def __init__(self, env_fn: Callable[[], Any], forward_fn, seed: int = 0):
@@ -48,6 +62,7 @@ class EnvRunner:
         assert self.params is not None, "set_weights before sample"
         obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
         next_l = []
+        term_l, trunc_l, tv_l = [], [], []
         completed = []
         for _ in range(num_steps):
             logits, value = self.forward(self.params, self._obs[None])
@@ -69,6 +84,21 @@ class EnvRunner:
             self._ep_return += r
             rew_l.append(r)
             done_l.append(term or trunc)
+            term_l.append(bool(term))
+            trunc_l.append(bool(trunc and not term))
+            # Time-limit bias fix (ADVICE r3): at a truncation the episode
+            # is cut for advantage/return purposes, but the value target
+            # should bootstrap from V(next_obs), not 0 — only a true
+            # terminal has zero continuation value. Record V(next_obs) for
+            # truncated steps so on-policy learners can fold
+            # gamma*V(next_obs) back into the reward at the cut.
+            if trunc and not term:
+                _, v_nxt = self.forward(
+                    self.params, np.asarray(nxt, np.float32)[None]
+                )
+                tv_l.append(float(v_nxt[0]))
+            else:
+                tv_l.append(0.0)
             if term or trunc:
                 completed.append(self._ep_return)
                 self._ep_return = 0.0
@@ -83,6 +113,9 @@ class EnvRunner:
             "actions": np.asarray(act_l, np.int32),
             "rewards": np.asarray(rew_l, np.float32),
             "dones": np.asarray(done_l, np.bool_),
+            "terminateds": np.asarray(term_l, np.bool_),
+            "truncateds": np.asarray(trunc_l, np.bool_),
+            "truncation_values": np.asarray(tv_l, np.float32),
             "next_obs": np.asarray(next_l, np.float32),
             "logp": np.asarray(logp_l, np.float32),
             "values": np.asarray(val_l, np.float32),

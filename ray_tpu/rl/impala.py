@@ -20,7 +20,7 @@ import numpy as np
 import optax
 
 from ..core.logging import get_logger
-from .env_runner import EnvRunnerGroup
+from .env_runner import EnvRunnerGroup, fold_truncation_bootstrap
 from .module import init_mlp_module, mlp_forward, mlp_forward_np
 
 logger = get_logger("rl.impala")
@@ -150,10 +150,11 @@ class IMPALA:
         for ro in rollouts:
             timesteps += len(ro["obs"])
             ep_returns.extend(ro["episode_returns"].tolist())
+            rew = fold_truncation_bootstrap(ro, cfg.gamma)
             batches.append({
                 "obs": jnp.asarray(ro["obs"]),
                 "actions": jnp.asarray(ro["actions"]),
-                "rewards": jnp.asarray(ro["rewards"]),
+                "rewards": jnp.asarray(rew),
                 "dones": jnp.asarray(ro["dones"]),
                 "behavior_logp": jnp.asarray(ro["logp"]),
                 "bootstrap_value": jnp.asarray(ro["bootstrap_value"]),

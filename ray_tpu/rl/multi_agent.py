@@ -81,12 +81,14 @@ class MultiCartPole(MultiAgentEnv):
 class MultiAgentEnvRunner:
     """Samples a MultiAgentEnv, bucketing transitions per policy id."""
 
-    def __init__(self, env_fn, forward_fn, policy_mapping_fn, seed: int = 0):
+    def __init__(self, env_fn, forward_fn, policy_mapping_fn, seed: int = 0,
+                 gamma: float = 0.99):
         self.env = env_fn()
         self.forward = forward_fn
         self.map_policy = policy_mapping_fn
         self.params: Dict[str, Any] = {}
         self.rng = np.random.default_rng(seed)
+        self.gamma = gamma  # for the truncation-bootstrap reward fold
         self._obs = self.env.reset(seed=seed)
         self._ep_return = 0.0
 
@@ -134,7 +136,19 @@ class MultiAgentEnvRunner:
                 b = cols[pid]
                 r = rew_d.get(agent, 0.0)
                 self._ep_return += r
-                done = term_d.get(agent, False) or trunc_d.get(agent, False)
+                term = term_d.get(agent, False)
+                trunc = trunc_d.get(agent, False) or trunc_d.get("__all__", False)
+                done = term or trunc
+                # Time-limit bias fix (ADVICE r3, same as EnvRunner): a
+                # truncation cuts the trace but its continuation value is
+                # V(next_obs), not 0 — fold gamma*V(next_obs) into the
+                # reward at the cut (the GAE mask zeroes next_values at
+                # every done, so folding is the only unbiased route).
+                if done and not term and agent in obs_d:
+                    _, v_nxt = self.forward(
+                        self.params[pid], obs_d[agent][None]
+                    )
+                    r = r + self.gamma * float(v_nxt[0])
                 b["rewards"].append(r)
                 b["dones"].append(done)
                 if done:
@@ -204,7 +218,7 @@ class MultiAgentPPO:
         self.runners = [
             MultiAgentEnvRunner.remote(
                 config.env_fn, mlp_forward_np, config.policy_mapping_fn,
-                config.seed + i,
+                config.seed + i, config.gamma,
             )
             for i in range(config.num_env_runners)
         ]

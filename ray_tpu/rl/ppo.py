@@ -18,7 +18,7 @@ import numpy as np
 import optax
 
 from ..core.logging import get_logger
-from .env_runner import EnvRunnerGroup
+from .env_runner import EnvRunnerGroup, fold_truncation_bootstrap
 from .module import init_mlp_module, mlp_forward, mlp_forward_np
 
 logger = get_logger("rl.ppo")
@@ -118,7 +118,8 @@ class PPO:
         ep_returns: List[float] = []
         for ro in rollouts:
             adv, ret = compute_gae(
-                ro["rewards"], ro["values"], ro["dones"],
+                fold_truncation_bootstrap(ro, cfg.gamma),
+                ro["values"], ro["dones"],
                 ro["bootstrap_value"], cfg.gamma, cfg.gae_lambda,
             )
             obs.append(ro["obs"]); acts.append(ro["actions"])

@@ -166,7 +166,10 @@ class SAC:
         for ro in rollouts:
             self.buffer.add_batch({
                 "obs": ro["obs"], "actions": ro["actions"],
-                "rewards": ro["rewards"], "dones": ro["dones"],
+                # true terminals only — truncations bootstrap from
+                # next_obs via the soft target (ADVICE r3)
+                "rewards": ro["rewards"],
+                "dones": ro.get("terminateds", ro["dones"]),
                 "next_obs": ro["next_obs"],
             })
             ep_returns.extend(ro["episode_returns"].tolist())

@@ -64,7 +64,10 @@ class HTTPProxy:
                 # /v1/chat/completions hits chat_completions
                 handle = None
                 rest: list = []
-                for i in range(len(parts), 0, -1):
+                # i=0 tests the empty candidate so route_prefix "/" (route
+                # key "") is reachable — the reference's DEFAULT prefix
+                # (ADVICE r3).
+                for i in range(len(parts), -1, -1):
                     candidate = "/".join(parts[:i])
                     if candidate in proxy.routes:
                         handle = proxy.routes[candidate]

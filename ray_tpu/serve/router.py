@@ -67,7 +67,11 @@ class Pow2Router:
                     a, b = random.sample(range(n), 2)
                     idx = a if self._load(a) <= self._load(b) else b
             if multiplexed_model_id:
-                self._model_affinity[multiplexed_model_id] = idx
+                # Record affinity only for a first placement: a load-check
+                # diversion must not abandon the replica that actually has
+                # the model resident (ADVICE r3). The pointer moves only
+                # when the resident replica disappears on a version bump.
+                self._model_affinity.setdefault(multiplexed_model_id, idx)
             replica = self._replicas[idx]
             ref = replica.handle_request.remote(
                 method, args, kwargs, multiplexed_model_id

@@ -185,3 +185,27 @@ class TestReviewRegressions:
             serve.delete("routed")  # removes the custom route, not /routed
         finally:
             serve.shutdown()
+
+    def test_root_route_prefix_reachable(self, ray_start_regular, app_module):
+        # route_prefix "/" strips to the empty route key; the proxy's
+        # longest-prefix match must test the empty candidate (ADVICE r3) —
+        # "/" is the reference's DEFAULT prefix.
+        from ray_tpu import serve
+
+        try:
+            app = build_app(ApplicationSchema(
+                name="rooted", import_path=f"{app_module}:app",
+                route_prefix="/",
+            ))
+            serve.run(app, name="rooted", route_prefix="/")
+            port = serve.http_port()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/",
+                data=json.dumps({"who": "root"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["result"]["msg"] == "hello root"
+            serve.delete("rooted")
+        finally:
+            serve.shutdown()
