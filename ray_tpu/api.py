@@ -61,6 +61,7 @@ def init(
     system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = True,
     resume_from: Optional[str] = None,
+    address: Optional[str] = None,
     _existing_runtime: Optional[Runtime] = None,
 ) -> Runtime:
     """Start (or attach to) the runtime with one local node.
@@ -68,11 +69,34 @@ def init(
     On a real TPU host this discovers local devices and advertises them as
     TPU resources with topology labels (see ray_tpu.sched.topology).
 
+    address: join an existing cluster head (its control-plane RPC address,
+    ``host:port``) as a WORKER host: this process's NodeAgent registers with
+    the head and executes tasks/actors the head's scheduler pushes to it
+    (see ``ray_tpu.core.cross_host``). Returns the WorkerRuntime handle; the
+    task-submission API stays with the head driver (single-controller).
+
     resume_from: path to a control-plane snapshot (see
     ``system_config={"control_plane_snapshot_path": ...}``); restores the
     KV/job tables and re-creates named actors from their pickled specs
     (`ray_tpu.core.persistence` documents the restore policy).
     """
+    global _worker_runtime
+    if address is not None:
+        if _cw.runtime_initialized():
+            raise RuntimeError("this process already hosts a head runtime; "
+                               "init(address=...) joins as a worker")
+        if _worker_runtime is not None and _worker_runtime.is_running:
+            if ignore_reinit_error:
+                return _worker_runtime
+            raise RuntimeError("ray_tpu.init() called twice")
+        config.apply_overrides(system_config)
+        from .core.cross_host import join_cluster
+
+        _worker_runtime = join_cluster(
+            address, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources
+        )
+        atexit.register(shutdown)
+        return _worker_runtime
     if _cw.runtime_initialized():
         if ignore_reinit_error:
             return _cw.get_runtime()
@@ -106,13 +130,17 @@ def init(
             rt, config.control_plane_snapshot_path
         )
     if config.control_plane_rpc_port >= 0:
+        from .core.cross_host import HeadService, enable_cross_host
         from .core.rpc import serve_control_plane
 
+        # serve the full head surface (control plane + directory ops) and
+        # accept worker-host joins (cross-host execution plane)
         rt._cp_server = serve_control_plane(
-            rt.control_plane,
+            HeadService(rt),
             host=config.control_plane_rpc_host,
             port=config.control_plane_rpc_port,
         )
+        enable_cross_host(rt)
     return rt
 
 
@@ -129,6 +157,11 @@ def _detect_local_tpu_chips() -> float:
 
 
 def shutdown() -> None:
+    global _worker_runtime
+    if _worker_runtime is not None:
+        _worker_runtime.shutdown()
+        _worker_runtime = None
+        config.reset()
     if _cw.runtime_initialized():
         _cw.get_runtime().shutdown()
         _cw.set_runtime(None)
@@ -140,8 +173,18 @@ def is_initialized() -> bool:
     return _cw.runtime_initialized()
 
 
+_worker_runtime = None  # WorkerRuntime when this process joined via address=
+
+
 def _auto_init() -> Runtime:
     if not _cw.runtime_initialized():
+        if _worker_runtime is not None:
+            raise RuntimeError(
+                "this process joined a cluster as a WORKER host "
+                "(init(address=...)); the task-submission API lives with the "
+                "head driver. Submit from the head, or run a separate driver "
+                "process against the head."
+            )
         if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
             raise RuntimeError(
                 "the ray_tpu API is not available inside worker processes "

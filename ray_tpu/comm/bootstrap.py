@@ -23,6 +23,23 @@ logger = get_logger("bootstrap")
 _COORD_KEY = "comm/coordinator/{gang}"
 
 
+def _control_plane():
+    """The cluster KV, from whichever runtime this process hosts: the head
+    driver's, or (on a joined worker host, cross_host.WorkerRuntime) the
+    remote control-plane client — train workers run in-process on TPU hosts
+    (they own the chips), so the rendezvous must work from both."""
+    if _cw.runtime_initialized():
+        return _cw.get_runtime().control_plane
+    from .. import api
+
+    if api._worker_runtime is not None:
+        return api._worker_runtime.control_plane
+    raise RuntimeError(
+        "no runtime in this process: gang rendezvous needs the cluster KV "
+        "(head driver or a joined worker host)"
+    )
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
@@ -31,19 +48,19 @@ def free_port() -> int:
 
 def publish_coordinator(gang_name: str, address: Optional[str] = None) -> str:
     """Host 0 of a gang: publish the coordinator address into cluster KV."""
-    rt = _cw.get_runtime()
+    cp = _control_plane()
     if address is None:
         address = f"{socket.gethostbyname(socket.gethostname())}:{free_port()}"
-    rt.control_plane.kv_put(_COORD_KEY.format(gang=gang_name), address.encode())
+    cp.kv_put(_COORD_KEY.format(gang=gang_name), address.encode())
     return address
 
 
 def lookup_coordinator(gang_name: str, timeout_s: float = 60.0) -> str:
-    rt = _cw.get_runtime()
+    cp = _control_plane()
     deadline = time.monotonic() + timeout_s
     key = _COORD_KEY.format(gang=gang_name)
     while time.monotonic() < deadline:
-        raw = rt.control_plane.kv_get(key)
+        raw = cp.kv_get(key)
         if raw:
             return raw.decode()
         time.sleep(0.05)

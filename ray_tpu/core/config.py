@@ -115,6 +115,14 @@ declare(
     "processes/hosts and the CLI can attach: -1 = off, 0 = ephemeral port "
     "(logged), >0 = fixed port.",
 )
+declare(
+    "node_host", "127.0.0.1",
+    "This host's address for cross-host serving (worker dispatch + object "
+    "transfer, core/cross_host.py): both the bind interface and the "
+    "address ADVERTISED to the cluster, so it must be reachable from the "
+    "head — set to this machine's cluster-facing IP when joining from "
+    "another host.",
+)
 
 # Control-plane persistence (GCS-Redis analogue, file-backed)
 declare(

@@ -118,6 +118,9 @@ class ControlPlane:
     # -- node table ---------------------------------------------------------
     def register_node(self, info: NodeInfo) -> None:
         with self._lock:
+            # the info may have crossed a process boundary: its monotonic
+            # heartbeat stamp is another clock's — restamp locally
+            info.last_heartbeat = time.monotonic()
             self._nodes[info.node_id] = info
         _nodes_gauge.add(1, {"state": "ALIVE"})
         self.pubsub.publish("node", ("ALIVE", info))

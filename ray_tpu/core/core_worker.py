@@ -403,7 +403,12 @@ class Runtime:
             raise ObjectLostError(ref.object_id)
         try:
             return holder.store.get(ref.object_id, timeout=10.0)
-        except TimeoutError:
+        except (TimeoutError, ObjectLostError):
+            # holder died between locate and pull (remote store proxies
+            # surface this as ObjectLostError) — one reconstruction attempt
+            self.directory.remove_location(ref.object_id, holder.node_id)
+            if self._try_reconstruct(ref.object_id):
+                return self._get_one(ref, timeout)
             raise ObjectLostError(ref.object_id)
 
     def wait(
@@ -830,6 +835,10 @@ class Runtime:
         if cp_server is not None:
             cp_server.stop()
             self._cp_server = None
+        transfer = getattr(self, "_transfer_server", None)
+        if transfer is not None:
+            transfer.stop()
+            self._transfer_server = None
         self._kick_scheduler()
         self.control_plane.finish_job(self.job_id)
         with self._lock:

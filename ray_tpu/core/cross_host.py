@@ -1,0 +1,763 @@
+"""Cross-host execution plane: remote task/actor dispatch between runtimes.
+
+Reference analogue: the reference's whole lease/push path — a raylet on
+another host grants a worker lease and the owner pushes the task to it
+(`src/ray/raylet/node_manager.cc :: HandleRequestWorkerLease`,
+`src/ray/core_worker/transport/actor_task_submitter.cc` /
+`normal_task_submitter.cc`). TPU-native shape (SURVEY §7.1): a SINGLE
+CONTROLLER — the head runtime owns the cluster scheduler and PUSHES task
+specs to worker hosts over the wire; workers never lease-negotiate. This
+matches how TPU pods are actually driven (one coordinator, jax.distributed
+workers) and keeps every scheduling policy in one place.
+
+Topology:
+
+  head process                      worker host process
+  ------------                      -------------------
+  Runtime (scheduler, GCS)  <--RPC--  RemoteControlPlane (register,
+   |  ControlPlaneServer               heartbeat, KV, dir_*, pubsub)
+   |  ObjectTransferServer  <--pull--  NodeAgent._fetch_async (deps)
+   |  RemoteNodeAgent  ----submit--->  WorkerNodeServer -> NodeAgent
+   |       ^...........done+seal......   (executes, seals returns into
+   |  ObjectDirectory  <--dir_add----     its local store)
+   |  (locations)                      ObjectTransferServer (serves
+   +--RemoteStoreProxy  ----pull---->     results to any puller)
+
+The data plane stays the existing object-transfer plane (chunked TCP,
+sealed payloads); this module only adds DISPATCH. Device arrays still never
+cross it: intra-slice tensors ride XLA collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .config import config
+from .control_plane import NodeInfo
+from .ids import ActorID, NodeID, ObjectID
+from .logging import get_logger
+from .node_agent import NodeAgent, TaskResult, WorkerCrashedError
+from .object_store import ObjectLostError
+from .object_transfer import (
+    KV_PREFIX,
+    ObjectPullError,
+    ObjectTransferClient,
+    ObjectTransferServer,
+)
+from .rpc import RemoteControlPlane
+from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
+
+logger = get_logger("cross_host")
+
+NODE_SERVICE_PREFIX = "node_service/"  # KV: node_id hex -> dispatch address
+
+
+def _dumps(obj: Any) -> bytes:
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except Exception:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj, protocol=5)
+
+
+def _dump_exc(e: Optional[BaseException]) -> Optional[bytes]:
+    if e is None:
+        return None
+    try:
+        return _dumps(e)
+    except Exception:
+        return _dumps(RuntimeError(repr(e)))
+
+
+def _load_exc(blob: Optional[bytes]) -> Optional[BaseException]:
+    if blob is None:
+        return None
+    try:
+        return pickle.loads(blob)
+    except Exception as e:  # noqa: BLE001 — a broken exc must not mask the task error
+        return RuntimeError(f"remote error (undeserializable: {e!r})")
+
+
+# ---------------------------------------------------------------------------
+# Head side: service surface + remote-agent proxy
+# ---------------------------------------------------------------------------
+
+
+class HeadService:
+    """The head runtime's served surface: the ControlPlane plus directory
+    methods, so worker hosts can publish/resolve object locations.
+
+    Served by ``rpc.serve_control_plane`` in place of the bare ControlPlane
+    (same duck surface — unknown attributes forward to the control plane)."""
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+        self.pubsub = runtime.control_plane.pubsub
+
+    def __getattr__(self, name: str):
+        return getattr(self._runtime.control_plane, name)
+
+    # -- directory ops (worker -> head) ------------------------------------
+    def dir_add_location(self, oid_hex: str, node_id_hex: str) -> bool:
+        self._runtime.directory.add_location(
+            ObjectID.from_hex(oid_hex), NodeID.from_hex(node_id_hex)
+        )
+        return True
+
+    def dir_remove_location(self, oid_hex: str, node_id_hex: str) -> bool:
+        self._runtime.directory.remove_location(
+            ObjectID.from_hex(oid_hex), NodeID.from_hex(node_id_hex)
+        )
+        return True
+
+    def dir_locations(self, oid_hex: str) -> List[str]:
+        return [
+            n.hex()
+            for n in self._runtime.directory.locations(ObjectID.from_hex(oid_hex))
+        ]
+
+
+class _AgentStoreAdapter:
+    """Serves EVERY local agent's store through one transfer server, so a
+    single advertised address covers all of the head's (virtual) nodes."""
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+
+    def _stores(self):
+        with self._runtime._lock:
+            agents = list(self._runtime.agents.values())
+        return [a.store for a in agents if isinstance(a, NodeAgent)]
+
+    def contains(self, oid) -> bool:
+        return any(s.contains(oid) for s in self._stores())
+
+    def get(self, oid, timeout=None):
+        for s in self._stores():
+            if s.contains(oid):
+                return s.get(oid, timeout=timeout)
+        raise KeyError(oid)
+
+    def get_raw(self, oid, timeout=None):
+        for s in self._stores():
+            if s.contains(oid):
+                return s.get_raw(oid, timeout=timeout)
+        raise KeyError(oid)
+
+
+class RemoteStoreProxy:
+    """Duck-typed store view of a remote runtime: get/get_raw pull over the
+    transfer plane, delete goes over the dispatch connection."""
+
+    def __init__(self, owner: "RemoteNodeAgent"):
+        self._owner = owner
+        self._transfer = ObjectTransferClient()
+
+    def contains(self, oid) -> bool:
+        try:
+            return bool(
+                self._transfer._call(self._owner.transfer_addr, "contains", oid.hex())
+            )
+        except ObjectPullError:
+            return False
+
+    def get(self, oid, timeout=None):
+        # store duck contract: callers handle TimeoutError/ObjectLostError,
+        # never the transfer plane's own error type
+        try:
+            return self._transfer.pull(self._owner.transfer_addr, oid)
+        except ObjectPullError as e:
+            raise ObjectLostError(oid) from e
+
+    def get_raw(self, oid, timeout=None):
+        try:
+            return self._transfer.pull(self._owner.transfer_addr, oid, raw=True)
+        except ObjectPullError as e:
+            raise ObjectLostError(oid) from e
+
+    def delete(self, oid) -> None:
+        try:
+            self._owner._call("store_delete", oid_hex=oid.hex())
+        except (WireError, OSError, RuntimeError):
+            pass  # holder gone: nothing to delete
+
+    def put(self, oid, value, nbytes=None) -> None:
+        raise NotImplementedError("push-to-remote-store is not part of the plane "
+                                  "(the consumer pulls; see object_transfer.py)")
+
+    def close(self) -> None:
+        self._transfer.close()
+
+
+class RemoteNodeAgent:
+    """Head-side proxy with NodeAgent's duck surface, dispatching to a
+    WorkerNodeServer on another host.
+
+    submit() is asynchronous: the spec ships as one frame; the worker sends
+    the TaskResult frame whenever the task finishes (responses interleave,
+    matched by id). Return VALUES never ride the dispatch plane — the worker
+    seals them into its own store and registers locations with the head
+    directory before acking, so a subsequent get() pulls them over the
+    transfer plane exactly like any other remote object."""
+
+    is_remote = True
+
+    def __init__(self, info: NodeInfo, node_service_addr: str, transfer_addr: str):
+        self.info = info
+        self.node_id = info.node_id
+        self.node_service_addr = node_service_addr
+        self.transfer_addr = transfer_addr
+        self._stopped = threading.Event()
+        self.store = RemoteStoreProxy(self)
+        host, _, port = node_service_addr.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)), timeout=10.0)
+        # connect timeout only — the dispatch connection is long-lived and
+        # idle between tasks; a lingering socket timeout would kill the
+        # read loop after 10 quiet seconds
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._next_id = 0
+        self._done_cbs: Dict[int, Callable[[TaskResult], None]] = {}
+        self._replies: Dict[int, dict] = {}
+        self._reply_cv = threading.Condition()
+        # Completions run OFF the read loop: _on_task_done may call back
+        # into this agent (e.g. kill_actor on killed-during-init), which
+        # needs the read loop free to deliver the reply.
+        self._completions: "queue.Queue[Optional[Tuple[Callable, TaskResult]]]" = queue.Queue()
+        self._completion_thread = threading.Thread(
+            target=self._completion_loop, daemon=True,
+            name=f"remote-agent-done-{info.node_id.hex()[:8]}",
+        )
+        self._completion_thread.start()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"remote-agent-{info.node_id.hex()[:8]}",
+        )
+        self._reader.start()
+
+    def _completion_loop(self) -> None:
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            cb, result = item
+            try:
+                cb(result)
+            except Exception:  # noqa: BLE001
+                logger.exception("task-done callback failed")
+
+    # -- plumbing -----------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                msg_type, payload = recv_msg(self._sock)
+                if msg_type != MSG_RESPONSE:
+                    continue
+                req_id = payload.get("id")
+                cb = self._done_cbs.pop(req_id, None)
+                if cb is not None:
+                    self._completions.put((cb, self._to_task_result(payload)))
+                else:
+                    with self._reply_cv:
+                        self._replies[req_id] = payload
+                        self._reply_cv.notify_all()
+        except (WireError, OSError) as e:
+            if not self._stopped.is_set():
+                logger.warning("dispatch connection to node %s dropped: %r",
+                               self.node_id.hex()[:8], e)
+        except Exception:  # noqa: BLE001 — a cb bug must not die silently
+            logger.exception("remote-agent read loop failed")
+        finally:
+            self._fail_outstanding(WorkerCrashedError(
+                f"connection to node {self.node_id.hex()[:8]} lost"))
+
+    def _fail_outstanding(self, error: BaseException) -> None:
+        self._stopped.set()
+        cbs = list(self._done_cbs.values())
+        self._done_cbs.clear()
+        with self._reply_cv:
+            self._replies[-1] = {"ok": False, "error": repr(error), "exc": None}
+            self._reply_cv.notify_all()
+        for cb in cbs:
+            self._completions.put((cb, TaskResult(task_id=None, ok=False, error=error)))
+        self._completions.put(None)  # drain, then stop the completion thread
+
+    @staticmethod
+    def _to_task_result(payload: dict) -> TaskResult:
+        if payload.get("ok"):
+            return TaskResult(task_id=None, ok=True, values=None)
+        error = _load_exc(payload.get("exc_blob")) or WorkerCrashedError(
+            payload.get("error", "remote task failed"))
+        return TaskResult(
+            task_id=None, ok=False, error=error,
+            is_application_error=bool(payload.get("is_application_error")),
+        )
+
+    def _send(self, method: str, *, done: Optional[Callable] = None, **fields) -> int:
+        with self._send_lock:
+            self._next_id += 1
+            req_id = self._next_id
+            if done is not None:
+                self._done_cbs[req_id] = done
+            try:
+                send_msg(self._sock, MSG_REQUEST,
+                         {"id": req_id, "method": method, **fields})
+            except (WireError, OSError) as e:
+                self._done_cbs.pop(req_id, None)
+                raise WorkerCrashedError(
+                    f"dispatch to node {self.node_id.hex()[:8]} failed: {e}")
+        return req_id
+
+    def _call(self, method: str, timeout: float = 30.0, **fields) -> Any:
+        req_id = self._send(method, **fields)
+        deadline = time.monotonic() + timeout
+        with self._reply_cv:
+            while req_id not in self._replies:
+                if self._stopped.is_set():
+                    raise WorkerCrashedError(
+                        f"connection to node {self.node_id.hex()[:8]} lost")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise WorkerCrashedError(f"rpc {method} timed out")
+                self._reply_cv.wait(timeout=min(1.0, remaining))
+            resp = self._replies.pop(req_id)
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", f"{method} failed"))
+        return resp.get("value")
+
+    # -- NodeAgent duck surface --------------------------------------------
+    def submit(self, spec, done: Callable[[TaskResult], None]) -> None:
+        if self._stopped.is_set():
+            done(TaskResult(spec.task_id, ok=False,
+                            error=WorkerCrashedError("remote node disconnected")))
+            return
+
+        def on_result(result: TaskResult) -> None:
+            result.task_id = spec.task_id
+            done(result)
+
+        try:
+            self._send("submit", done=on_result, spec_blob=_dumps(spec))
+        except WorkerCrashedError as e:
+            done(TaskResult(spec.task_id, ok=False, error=e))
+
+    def kill_actor(self, actor_id: ActorID, cause: str = "killed") -> bool:
+        try:
+            return bool(self._call("kill_actor", actor_id_hex=actor_id.hex(),
+                                   cause=cause))
+        except (WorkerCrashedError, RuntimeError):
+            return False
+
+    def has_actor(self, actor_id: ActorID) -> bool:
+        try:
+            return bool(self._call("has_actor", actor_id_hex=actor_id.hex()))
+        except (WorkerCrashedError, RuntimeError):
+            return False
+
+    def submit_direct(self, actor_id: ActorID, fn) -> None:
+        raise WorkerCrashedError(
+            "compiled-graph direct submit does not cross hosts; place DAG "
+            "actors on the driver's node"
+        )
+
+    def kill_running_tasks(self) -> None:
+        try:
+            self._call("kill_running_tasks", timeout=5.0)
+        except (WorkerCrashedError, RuntimeError):
+            pass
+
+    def _sync_load(self) -> None:
+        """No-op: the worker host heartbeats the control plane itself."""
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        try:
+            self._send("stop")
+        except (WorkerCrashedError, OSError):
+            pass
+        self._fail_outstanding(WorkerCrashedError("node removed"))
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        self.store.close()
+
+
+def enable_cross_host(runtime) -> ObjectTransferServer:
+    """Turn the head runtime into a joinable cluster head: serve its agents'
+    stores on the transfer plane and attach a RemoteNodeAgent for every
+    worker host that registers (reference: node addition through GCS node
+    table + raylet connection, `gcs_node_manager.cc`)."""
+    transfer = ObjectTransferServer(
+        _AgentStoreAdapter(runtime),
+        host=config.control_plane_rpc_host,
+    )
+    # one address serves every local (virtual) node's store
+    def _advertise_local(node_id: NodeID) -> None:
+        runtime.control_plane.kv_put(KV_PREFIX + node_id.hex(), transfer.address)
+
+    with runtime._lock:
+        local_ids = list(runtime.agents)
+    for nid in local_ids:
+        _advertise_local(nid)
+
+    def on_node_event(event: Tuple[str, NodeInfo]) -> None:
+        state, info = event
+        if state != "ALIVE":
+            return
+        with runtime._lock:
+            known = info.node_id in runtime.agents
+        if known:
+            return
+        svc = runtime.control_plane.kv_get(NODE_SERVICE_PREFIX + info.node_id.hex())
+        taddr = runtime.control_plane.kv_get(KV_PREFIX + info.node_id.hex())
+        if not svc or not taddr:
+            _advertise_local(info.node_id)  # a local late-joining virtual node
+            return
+        svc = svc.decode() if isinstance(svc, bytes) else svc
+        taddr = taddr.decode() if isinstance(taddr, bytes) else taddr
+        try:
+            proxy = RemoteNodeAgent(info, svc, taddr)
+        except OSError as e:
+            logger.warning("cannot reach joining node %s at %s: %s",
+                           info.node_id.hex()[:8], svc, e)
+            runtime.control_plane.mark_node_dead(info.node_id, f"unreachable: {e}")
+            return
+        runtime.directory.register_agent(proxy)
+        with runtime._lock:
+            runtime.agents[info.node_id] = proxy
+        logger.info("remote node %s joined (dispatch %s, transfer %s)",
+                    info.node_id.hex()[:8], svc, taddr)
+        runtime.pg_manager._retry_queued()
+        runtime._kick_scheduler()
+
+    runtime.control_plane.pubsub.subscribe("node", on_node_event)
+    # workers block on object availability via this channel (obj_loc):
+    # publish every directory add so RemoteDirectoryClient.subscribe_once
+    # wakes without polling
+    runtime.directory.on_add = lambda oid, nid: runtime.control_plane.pubsub.publish(
+        "obj_loc", oid.hex()
+    )
+    runtime._transfer_server = transfer
+    return transfer
+
+
+# ---------------------------------------------------------------------------
+# Worker side: join a head, serve dispatch
+# ---------------------------------------------------------------------------
+
+
+class RemoteDirectoryClient:
+    """Worker-side view of the head's ObjectDirectory (duck-typed for
+    NodeAgent): location writes go to the head; reads resolve holders into
+    pull-capable proxies via the KV-advertised transfer addresses."""
+
+    def __init__(self, control_plane: RemoteControlPlane, self_node_id: NodeID):
+        self._cp = control_plane
+        self._self_id = self_node_id
+        self._transfer = ObjectTransferClient()
+        self._lock = threading.Lock()
+        self._waiters: Dict[str, List[Callable[[], None]]] = {}
+        self._subscribed = False
+        # waiter callbacks run OFF the control-plane read loop: they issue
+        # blocking RPCs (dir_locations, kv_get) on the SAME connection whose
+        # read loop delivers the replies — firing inline would deadlock the
+        # whole worker (pull hangs, heartbeats wedge)
+        self._fire_queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        threading.Thread(
+            target=self._fire_loop, daemon=True, name="dir-obj-ready"
+        ).start()
+
+    def _fire_loop(self) -> None:
+        while True:
+            oid_hex = self._fire_queue.get()
+            if oid_hex is None:
+                return
+            self._fire(oid_hex)
+
+    def add_location(self, object_id: ObjectID, node_id: NodeID) -> None:
+        self._cp.dir_add_location(object_id.hex(), node_id.hex())
+
+    def remove_location(self, object_id: ObjectID, node_id: NodeID) -> None:
+        self._cp.dir_remove_location(object_id.hex(), node_id.hex())
+
+    def locations(self, object_id: ObjectID) -> List[NodeID]:
+        return [NodeID.from_hex(h) for h in self._cp.dir_locations(object_id.hex())]
+
+    def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None):
+        for hexid in self._cp.dir_locations(object_id.hex()):
+            node_id = NodeID.from_hex(hexid)
+            if node_id == exclude:
+                continue
+            addr = self._cp.kv_get(KV_PREFIX + hexid)
+            if not addr:
+                continue
+            addr = addr.decode() if isinstance(addr, bytes) else addr
+            return _PullHolder(addr, self._transfer)
+        return None
+
+    def subscribe_once(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
+        oid_hex = object_id.hex()
+        with self._lock:
+            if not self._subscribed:
+                self._cp.subscribe("obj_loc", self._on_obj_loc)
+                self._subscribed = True
+            self._waiters.setdefault(oid_hex, []).append(callback)
+        # subscribe-then-check closes the race with a concurrent seal; fire
+        # via the queue so a failed-pull -> resubscribe cycle cannot recurse
+        # on this stack
+        if self._cp.dir_locations(oid_hex):
+            self._fire_queue.put(oid_hex)
+
+    def _on_obj_loc(self, oid_hex: str) -> None:
+        self._fire_queue.put(oid_hex)
+
+    def _fire(self, oid_hex: str) -> None:
+        with self._lock:
+            callbacks = self._waiters.pop(oid_hex, [])
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                logger.exception("object-ready callback failed")
+
+
+class _PullHolder:
+    """Minimal holder handle: .store.get_raw pulls sealed bytes."""
+
+    class _Store:
+        def __init__(self, addr: str, client: ObjectTransferClient):
+            self._addr = addr
+            self._client = client
+
+        def get_raw(self, oid, timeout=None):
+            try:
+                return self._client.pull(self._addr, oid, raw=True)
+            except ObjectPullError as e:
+                raise ObjectLostError(oid) from e
+
+        def get(self, oid, timeout=None):
+            try:
+                return self._client.pull(self._addr, oid)
+            except ObjectPullError as e:
+                raise ObjectLostError(oid) from e
+
+    def __init__(self, addr: str, client: ObjectTransferClient):
+        self.store = self._Store(addr, client)
+        self._stopped = threading.Event()  # duck parity with NodeAgent
+
+
+class _WorkerDispatchHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "WorkerNodeServer" = self.server  # type: ignore[assignment]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_lock = threading.Lock()
+
+        def reply(payload: dict) -> None:
+            try:
+                with send_lock:
+                    send_msg(sock, MSG_RESPONSE, payload)
+            except (WireError, OSError):
+                pass  # head gone; worker keeps running until told otherwise
+
+        try:
+            while True:
+                msg_type, req = recv_msg(sock)
+                if msg_type != MSG_REQUEST:
+                    raise WireError(f"unexpected message type {msg_type}")
+                try:
+                    self._dispatch(server, req, reply)
+                except Exception as e:  # noqa: BLE001 — serialized to caller
+                    reply({"id": req.get("id"), "ok": False, "error": repr(e)})
+        except (WireError, OSError):
+            pass
+
+    def _dispatch(self, server: "WorkerNodeServer", req: dict, reply) -> None:
+        method = req.get("method")
+        req_id = req.get("id")
+        agent = server.agent
+        if method == "submit":
+            spec = pickle.loads(req["spec_blob"])
+
+            def done(result: TaskResult) -> None:
+                if result.ok:
+                    reply({"id": req_id, "ok": True})
+                else:
+                    reply({
+                        "id": req_id, "ok": False,
+                        "error": repr(result.error),
+                        "exc_blob": _dump_exc(result.error),
+                        "is_application_error": result.is_application_error,
+                    })
+
+            # off the read loop: submit() pulls missing dependencies inline,
+            # which must not serialize behind other dispatches
+            threading.Thread(
+                target=agent.submit, args=(spec, done), daemon=True,
+                name=f"dispatch-{spec.task_id.hex()[:8]}",
+            ).start()
+        elif method == "kill_actor":
+            ok = agent.kill_actor(ActorID.from_hex(req["actor_id_hex"]),
+                                  cause=req.get("cause", "killed"))
+            reply({"id": req_id, "ok": True, "value": ok})
+        elif method == "has_actor":
+            reply({"id": req_id, "ok": True,
+                   "value": agent.has_actor(ActorID.from_hex(req["actor_id_hex"]))})
+        elif method == "store_delete":
+            agent.store.delete(ObjectID.from_hex(req["oid_hex"]))
+            reply({"id": req_id, "ok": True, "value": True})
+        elif method == "kill_running_tasks":
+            agent.kill_running_tasks()
+            reply({"id": req_id, "ok": True, "value": True})
+        elif method == "ping":
+            reply({"id": req_id, "ok": True, "value": True})
+        elif method == "stop":
+            reply({"id": req_id, "ok": True, "value": True})
+            server.owner_requested_stop.set()
+        else:
+            reply({"id": req_id, "ok": False, "error": f"unknown method {method!r}"})
+
+
+class WorkerNodeServer(socketserver.ThreadingTCPServer):
+    """Serves one worker host's NodeAgent for head dispatch."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, agent: NodeAgent, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _WorkerDispatchHandler)
+        self.agent = agent
+        self.owner_requested_stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="worker-dispatch"
+        )
+        self._thread.start()
+        logger.info("worker dispatch on %s:%d", *self.server_address)
+
+    @property
+    def address(self) -> str:
+        host, port = self.server_address
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+class WorkerRuntime:
+    """A worker host joined to a head: one NodeAgent + the servers that make
+    it reachable. Created by ``ray_tpu.init(address=...)`` or
+    ``ray-tpu start --address=...``.
+
+    This process is a WORKER, not a driver: the head owns scheduling and
+    object futures, so the task-submission API is unavailable here (the
+    reference allows drivers anywhere because every worker runs a full
+    CoreWorker with ownership; single-controller keeps ownership at the
+    head — SURVEY §7.1)."""
+
+    def __init__(
+        self,
+        address: str,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        node_host: Optional[str] = None,
+    ):
+        import os
+
+        if node_host is None:
+            node_host = config.node_host
+
+        self.head_address = address
+        self.control_plane = RemoteControlPlane(address)
+        node_resources = dict(resources or {})
+        node_resources.setdefault(
+            "CPU", num_cpus if num_cpus is not None else float(os.cpu_count() or 8))
+        if num_tpus is None:
+            from ..api import _detect_local_tpu_chips
+
+            num_tpus = _detect_local_tpu_chips()
+        if num_tpus:
+            node_resources.setdefault("TPU", float(num_tpus))
+        self.info = NodeInfo(
+            node_id=NodeID.generate(),
+            address=f"{node_host}",
+            resources_total=node_resources,
+            labels=labels or {},
+        )
+        self.node_id = self.info.node_id
+        self.directory = RemoteDirectoryClient(self.control_plane, self.node_id)
+        self.agent = NodeAgent(self.info, self.control_plane, self.directory)
+        self.dispatch_server = WorkerNodeServer(self.agent, host=node_host)
+        self.transfer_server = ObjectTransferServer(self.agent.store, host=node_host)
+        self._stopped = threading.Event()
+        # advertise BEFORE registering: the head resolves both addresses
+        # inside the node-ALIVE pubsub handler (ordering guaranteed: one
+        # socket, serialized requests)
+        self.control_plane.kv_put(
+            NODE_SERVICE_PREFIX + self.node_id.hex(), self.dispatch_server.address)
+        self.control_plane.kv_put(
+            KV_PREFIX + self.node_id.hex(), self.transfer_server.address)
+        self.control_plane.register_node(self.info)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="worker-heartbeat"
+        )
+        self._hb_thread.start()
+        logger.info("joined cluster at %s as node %s (%s)",
+                    address, self.node_id.hex()[:8], node_resources)
+
+    def _heartbeat_loop(self) -> None:
+        period = config.health_check_period_ms / 1000.0
+        while not self._stopped.is_set():
+            try:
+                self.control_plane.heartbeat(
+                    self.node_id, self.agent.resources.available())
+            except (WireError, OSError, RuntimeError):
+                logger.warning("head unreachable; shutting worker down")
+                self.shutdown()
+                return
+            if self.dispatch_server.owner_requested_stop.is_set():
+                logger.info("head requested stop; shutting worker down")
+                self.shutdown()
+                return
+            self._stopped.wait(period)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the worker shuts down (head death or stop request)."""
+        return self._stopped.wait(timeout)
+
+    @property
+    def is_running(self) -> bool:
+        return not self._stopped.is_set()
+
+    def shutdown(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        try:
+            self.control_plane.kv_del(NODE_SERVICE_PREFIX + self.node_id.hex())
+            self.control_plane.kv_del(KV_PREFIX + self.node_id.hex())
+            self.control_plane.mark_node_dead(self.node_id, "worker shutdown")
+        except (WireError, OSError, RuntimeError):
+            pass
+        self.dispatch_server.stop()
+        self.transfer_server.stop()
+        self.agent.stop()
+        self.control_plane.close()
+
+
+def join_cluster(address: str, **kwargs) -> WorkerRuntime:
+    """Join an existing cluster as a worker host (push-dispatch target)."""
+    return WorkerRuntime(address, **kwargs)

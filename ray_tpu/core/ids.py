@@ -76,6 +76,13 @@ class BaseID:
     def __hash__(self) -> int:
         return self._hash
 
+    def __reduce__(self):
+        # Rebuild through the constructor: the cached _hash is salted by
+        # THIS process's PYTHONHASHSEED and must never cross a process
+        # boundary (an unpickled id with a foreign hash silently misses
+        # every dict lookup against locally-built ids).
+        return (type(self), (self._bytes,))
+
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self._bytes.hex()})"
 

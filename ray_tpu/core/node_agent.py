@@ -681,6 +681,9 @@ class ObjectDirectory:
         self._locations: Dict[ObjectID, List[NodeID]] = {}
         self._agents: Dict[NodeID, NodeAgent] = {}
         self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
+        # cross-host hook: every add_location also notifies joined worker
+        # hosts via pubsub (set by cross_host.enable_cross_host)
+        self.on_add: Optional[Callable[[ObjectID, NodeID], None]] = None
 
     def register_agent(self, agent: NodeAgent) -> None:
         with self._lock:
@@ -704,6 +707,8 @@ class ObjectDirectory:
             callbacks = self._waiters.pop(object_id, [])
         for cb in callbacks:
             cb()
+        if self.on_add is not None:
+            self.on_add(object_id, node_id)
 
     def remove_location(self, object_id: ObjectID, node_id: NodeID) -> None:
         with self._lock:

@@ -76,22 +76,23 @@ class _TransferHandler(socketserver.BaseRequestHandler):
 
     def _dispatch(self, server: "ObjectTransferServer", req: dict) -> dict:
         method = req.get("method")
+        # args may carry a trailing raw flag: raw=True ships the SEALED
+        # payload (SealedBytes pickled as-is) so sealing survives the hop
+        # (store.get_raw parity for cross-runtime pulls)
         if method == "meta":
-            (oid_hex,) = req["args"]
-            blob = server._blob_for(oid_hex)
+            oid_hex, *rest = req["args"]
+            blob = server._blob_for(oid_hex, raw=bool(rest and rest[0]))
             return {"id": req["id"], "ok": True, "value": len(blob)}
         if method == "chunk":
-            oid_hex, offset, length = req["args"]
-            blob = server._blob_for(oid_hex)
+            oid_hex, offset, length, *rest = req["args"]
+            blob = server._blob_for(oid_hex, raw=bool(rest and rest[0]))
             return {"id": req["id"], "ok": True,
                     "value": bytes(blob[offset:offset + length])}
         if method == "contains":
             (oid_hex,) = req["args"]
-            try:
-                server._blob_for(oid_hex)
-                return {"id": req["id"], "ok": True, "value": True}
-            except KeyError:
-                return {"id": req["id"], "ok": True, "value": False}
+            oid = ObjectID.from_hex(oid_hex)
+            return {"id": req["id"], "ok": True,
+                    "value": bool(server._store.contains(oid))}
         raise WireError(f"unknown method {method!r}")
 
 
@@ -108,7 +109,7 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0):
         super().__init__((host, port), _TransferHandler)
         self._store = store
-        self._blob_cache: Dict[str, bytes] = {}
+        self._blob_cache: Dict[Tuple[str, bool], bytes] = {}
         self._cache_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True, name="object-transfer"
@@ -121,21 +122,25 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         host, port = self.server_address
         return f"{host}:{port}"
 
-    def _blob_for(self, oid_hex: str) -> bytes:
+    def _blob_for(self, oid_hex: str, raw: bool = False) -> bytes:
+        key = (oid_hex, raw)
         with self._cache_lock:
-            blob = self._blob_cache.get(oid_hex)
+            blob = self._blob_cache.get(key)
             if blob is not None:
                 return blob
         oid = ObjectID.from_hex(oid_hex)
         if not self._store.contains(oid):
             raise KeyError(f"object {oid_hex} not in local store")
-        value = self._store.get(oid, timeout=0.0)
+        if raw:
+            value = self._store.get_raw(oid, timeout=0.0)
+        else:
+            value = self._store.get(oid, timeout=0.0)
         blob = _serialize_for_wire(value)
         with self._cache_lock:
             # bound the cache: drop the oldest entries past 64
             if len(self._blob_cache) >= 64:
                 self._blob_cache.pop(next(iter(self._blob_cache)))
-            self._blob_cache[oid_hex] = blob
+            self._blob_cache[key] = blob
         return blob
 
     def stop(self) -> None:
@@ -195,19 +200,20 @@ class ObjectTransferClient:
             except OSError:
                 pass
 
-    def pull(self, address: str, object_id) -> Any:
-        """Pull one object from the holder at `address`; returns the value.
+    def pull(self, address: str, object_id, raw: bool = False) -> Any:
+        """Pull one object from the holder at `address`; returns the value
+        (raw=True: the sealed payload, store.get_raw parity).
 
         Chunks sequentially over one connection: the transfer is bandwidth
         -bound, not latency-bound, at ~1MB chunks (matching the reference's
         ObjectBufferPool sizing)."""
         oid_hex = object_id.hex() if hasattr(object_id, "hex") else str(object_id)
-        total = self._call(address, "meta", oid_hex)
+        total = self._call(address, "meta", oid_hex, raw)
         parts = []
         offset = 0
         while offset < total:
             length = min(self.chunk_bytes, total - offset)
-            chunk = self._call(address, "chunk", oid_hex, offset, length)
+            chunk = self._call(address, "chunk", oid_hex, offset, length, raw)
             if not chunk:
                 raise ObjectPullError(
                     f"short read at {offset}/{total} for {oid_hex}"

@@ -35,6 +35,8 @@ _ALLOWED_METHODS: Set[str] = {
     "list_actors",
     "register_job", "finish_job", "list_jobs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
+    # object-directory ops for joined worker hosts (cross_host.HeadService)
+    "dir_add_location", "dir_remove_location", "dir_locations",
 }
 
 
@@ -145,6 +147,9 @@ class RemoteControlPlane:
 
         host, _, port = address.rpartition(":")
         self._sock = socket.create_connection((host, int(port)), connect_timeout)
+        # create_connection leaves its timeout on the socket: clear it, or
+        # an idle read loop dies with TimeoutError after connect_timeout
+        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._next_id = 0

@@ -161,6 +161,25 @@ def cmd_start(args) -> int:
     import ray_tpu
     from ray_tpu.util import state
 
+    if getattr(args, "address", None):
+        # worker mode: join the head and serve dispatched tasks until the
+        # head stops us (or dies)
+        system_config = (
+            {"node_host": args.node_host} if args.node_host else None
+        )
+        worker = ray_tpu.init(
+            address=args.address, num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus, system_config=system_config,
+        )
+        print(f"joined {args.address} as node {worker.node_id.hex()[:8]} "
+              f"({worker.info.resources_total})")
+        try:
+            worker.wait()
+        except KeyboardInterrupt:
+            print("shutting down worker")
+            worker.shutdown()
+        return 0
+
     system_config: Dict[str, Any] = {"control_plane_rpc_port": args.rpc_port}
     if args.snapshot:
         system_config["control_plane_snapshot_path"] = args.snapshot
@@ -399,6 +418,16 @@ def main(argv=None) -> int:
     pst.add_argument("--rpc-port", type=int, default=0,
                      help="control-plane RPC port (0 = ephemeral)")
     pst.add_argument("--serve-app", help="module:attr of a serve Application")
+    pst.add_argument("--address", help="join an existing head as a WORKER "
+                     "host (head's control-plane RPC host:port)")
+    pst.add_argument("--num-cpus", type=float, default=None,
+                     help="CPU resource to advertise (worker join)")
+    pst.add_argument("--num-tpus", type=float, default=None,
+                     help="TPU resource to advertise (worker join)")
+    pst.add_argument("--node-host", default=None,
+                     help="this host's cluster-reachable address (worker "
+                     "join serves dispatch/transfer on it; default "
+                     "RAY_TPU_NODE_HOST or 127.0.0.1)")
     pst.set_defaults(fn=cmd_start)
 
     pmem = sub.add_parser("memory", help="object-plane sizes and totals")

@@ -1,0 +1,117 @@
+"""Head-driver script for test_cross_host gang test: a 2-worker train gang
+SPANNING TWO OS-PROCESS RUNTIMES (head + joined worker host) runs the real
+sharded LM train step over a jax.distributed mesh.
+
+This is the executable version of the reference's multi-node Train path
+(upstream ray `python/ray/train/_internal/worker_group.py` gang on two
+raylets + `torch/config.py` process-group setup; SURVEY.md §7.4.1): the
+head schedules one gang member per runtime by resource shape, rank 0
+publishes the jax.distributed coordinator through the cluster KV, rank 1
+(on the JOINED host) resolves it through the worker runtime's remote
+control-plane client, and both run the same SPMD step on the global mesh.
+
+Usage: _cross_host_gang.py   (spawns its own worker-host subprocess)
+Env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=2
+"""
+
+import faulthandler
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+
+def main() -> int:
+    faulthandler.register(signal.SIGUSR1)
+    import ray_tpu
+
+    rt = ray_tpu.init(
+        num_cpus=1, num_tpus=0, resources={"host0": 1.0},
+        system_config={"control_plane_rpc_port": 0, "worker_processes": 0},
+    )
+    addr = rt._cp_server.address
+    worker_code = textwrap.dedent(f"""
+        import faulthandler, signal
+        faulthandler.register(signal.SIGUSR1)
+        import ray_tpu
+        w = ray_tpu.init(address={addr!r}, num_cpus=1, num_tpus=0,
+                         resources={{"host1": 1.0}})
+        w.wait(timeout=600)
+    """)
+    # worker output to a file: an unread PIPE would backpressure the worker
+    # once the 64KB buffer fills
+    wlog = open(os.environ.get("XH_WORKER_LOG", "/tmp/_xh_gang_worker.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", worker_code], env=dict(os.environ),
+        stdout=wlog, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if len(rt.control_plane.alive_nodes()) == 2:
+                break
+            time.sleep(0.2)
+        assert len(rt.control_plane.alive_nodes()) == 2, "worker never joined"
+
+        # in_process=True: the gang member owns its runtime process's
+        # devices — the real TPU-host shape (one runtime per host, the
+        # train worker runs in the device-owning process)
+        @ray_tpu.remote(num_cpus=0, in_process=True)
+        class GangWorker:
+            def train(self, rank: int, nproc: int) -> float:
+                from ray_tpu.comm.bootstrap import init_distributed
+
+                init_distributed("xh-gang", nproc, rank)
+                import jax
+
+                assert jax.process_count() == nproc
+                from ray_tpu.comm.mesh import MeshSpec, build_mesh
+                from ray_tpu.models import get_config
+                from ray_tpu.train.lm import (
+                    batch_shardings,
+                    init_train_state,
+                    make_global_batch,
+                    make_optimizer,
+                    make_train_step,
+                    synthetic_batch,
+                )
+
+                cfg = get_config("tiny-llama")
+                mesh = build_mesh(MeshSpec.create(dp=2, fsdp=2))
+                opt = make_optimizer(total_steps=10)
+                state, shardings = init_train_state(
+                    cfg, mesh, jax.random.PRNGKey(0), opt)
+                step = jax.jit(
+                    make_train_step(cfg, opt),
+                    donate_argnums=0,
+                    in_shardings=(shardings, batch_shardings(mesh)),
+                )
+                host_batch = jax.tree.map(
+                    lambda x: jax.device_get(x), synthetic_batch(cfg, 4, 32))
+                batch = make_global_batch(host_batch, batch_shardings(mesh))
+                with mesh:
+                    state, metrics = step(state, batch)
+                    state, metrics = step(state, batch)
+                return float(metrics["loss"])
+
+        w0 = GangWorker.options(resources={"host0": 0.1}).remote()
+        w1 = GangWorker.options(resources={"host1": 0.1}).remote()
+        losses = ray_tpu.get(
+            [w0.train.remote(0, 2), w1.train.remote(1, 2)], timeout=560)
+        for rank, loss in enumerate(losses):
+            print(f"GANG_LOSS rank={rank} {loss:.6f}", flush=True)
+        assert abs(losses[0] - losses[1]) < 1e-6, losses
+        print("XH-GANG-OK", flush=True)
+        return 0
+    finally:
+        ray_tpu.shutdown()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
