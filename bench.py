@@ -74,21 +74,19 @@ def bench_serve(model: str) -> None:
     from ray_tpu.serve.engine import EngineConfig, InferenceEngine
 
     cfg = get_config(model)
-    ecfg = EngineConfig(max_batch_size=8, max_seq_len=512)
+    # bursty-arrival tuning (r4): batched prefill + adaptive decode span —
+    # see EngineConfig docstrings for the measurements behind both knobs
+    ecfg = EngineConfig(max_batch_size=8, max_seq_len=512,
+                        prefill_batch_size=8, busy_span=4)
     engine = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg, ecfg)
     rng = np.random.default_rng(0)
     prompt_len, max_tokens, n_req = 128, 64, 24
     prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len)) for _ in range(n_req)]
-    # warmup compiles every program the timed run hits: the prefill bucket
-    # and the decode-span program (two concurrent prompts also exercise
-    # the continuous-batching install path)
-    _warm = [threading.Thread(
-        target=lambda p=p: engine.generate(p, max_tokens=8))
-        for p in prompts[:2]]
-    for t in _warm:
-        t.start()
-    for t in _warm:
-        t.join()
+    # deterministic warmup: compile the prefill bucket (both padded batch
+    # shapes) and BOTH decode-span programs, then one tiny generate for
+    # the install/scatter path — the timed run never compiles
+    engine.warmup(buckets=[prompt_len])
+    engine.generate(prompts[0], max_tokens=4)
 
     results: list = [None] * n_req
     errors: list = [None] * n_req

@@ -106,13 +106,8 @@ def init(
         _cw.set_runtime(_existing_runtime)
         return _existing_runtime
     rt = Runtime()
-    node_resources = dict(resources or {})
-    node_resources.setdefault("CPU", num_cpus if num_cpus is not None else float(os.cpu_count() or 8))
-    if num_tpus is None:
-        num_tpus = _detect_local_tpu_chips()
-    if num_tpus:
-        node_resources.setdefault("TPU", float(num_tpus))
-    rt.add_node(resources=node_resources, is_head=True)
+    rt.add_node(resources=default_node_resources(num_cpus, num_tpus, resources),
+                is_head=True)
     _cw.set_runtime(rt)
     atexit.register(shutdown)
     if resume_from:
@@ -142,6 +137,24 @@ def init(
         )
         enable_cross_host(rt)
     return rt
+
+
+def default_node_resources(
+    num_cpus: Optional[float],
+    num_tpus: Optional[float],
+    resources: Optional[Dict[str, float]],
+) -> Dict[str, float]:
+    """One resource-defaulting rule for every node this process hosts
+    (head via init(), worker via init(address=...)): explicit resources
+    win, CPU falls back to the host count, TPU to local chip detection."""
+    node_resources = dict(resources or {})
+    node_resources.setdefault(
+        "CPU", num_cpus if num_cpus is not None else float(os.cpu_count() or 8))
+    if num_tpus is None:
+        num_tpus = _detect_local_tpu_chips()
+    if num_tpus:
+        node_resources.setdefault("TPU", float(num_tpus))
+    return node_resources
 
 
 def _detect_local_tpu_chips() -> float:

@@ -136,14 +136,20 @@ class ControlPlane:
         logger.warning("node %s marked DEAD: %s", node_id, reason)
         self.pubsub.publish("node", ("DEAD", info))
 
-    def heartbeat(self, node_id: NodeID, resources_available: Optional[Dict[str, float]] = None) -> None:
+    def heartbeat(self, node_id: NodeID, resources_available: Optional[Dict[str, float]] = None) -> bool:
+        """-> True if the node is ALIVE in the table. False tells the
+        sender it has been reaped (or was never known): a worker whose
+        partition outlived the health timeout must learn it is DEAD and
+        shut down instead of zombie-heartbeating forever (reference: a
+        raylet killed on GCS death declaration)."""
         with self._lock:
             info = self._nodes.get(node_id)
-            if info is None:
-                return
+            if info is None or info.state is not NodeState.ALIVE:
+                return False
             info.last_heartbeat = time.monotonic()
             if resources_available is not None:
                 info.resources_available = dict(resources_available)
+            return True
 
     def alive_nodes(self) -> List[NodeInfo]:
         with self._lock:

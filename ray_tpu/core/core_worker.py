@@ -509,6 +509,19 @@ class Runtime:
                     self._pending.extend(leftover)
                 time.sleep(0.002)
 
+    def _usable_agent(self, node_id: Optional[NodeID]):
+        """Agent for node_id, or None if absent or stopped. A stopped
+        agent (e.g. a remote proxy whose connection dropped before the
+        health check reaps the node) must read as 'unavailable now' —
+        submitting to it would fail instantly and burn the task's whole
+        retry budget in milliseconds instead of failing over."""
+        if node_id is None:
+            return None
+        agent = self.agents.get(node_id)
+        if agent is None or agent._stopped.is_set():
+            return None
+        return agent
+
     def _try_place(self, item: _PendingTask) -> bool:
         spec = item.spec
         strategy = spec.options.scheduling_strategy
@@ -525,7 +538,7 @@ class Runtime:
                 return True
             if actor.state is not ActorState.ALIVE or actor.node_id is None:
                 return False  # wait for (re)start
-            agent = self.agents.get(actor.node_id)
+            agent = self._usable_agent(actor.node_id)
             if agent is None:
                 return False
             self._mark_task(spec.task_id, "RUNNING")
@@ -543,7 +556,7 @@ class Runtime:
             return True
         if node_id is None:
             return False
-        agent = self.agents.get(node_id)
+        agent = self._usable_agent(node_id)
         if agent is None:
             return False
         item.target_node = node_id
@@ -584,7 +597,7 @@ class Runtime:
             if not pg.try_acquire(idx, demand):
                 continue
             node_id = pg.bundle_node(idx)
-            agent = self.agents.get(node_id)
+            agent = self._usable_agent(node_id)
             if agent is None:
                 pg.release(idx, demand)
                 continue

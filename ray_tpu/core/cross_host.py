@@ -280,9 +280,14 @@ class RemoteNodeAgent:
                 f"connection to node {self.node_id.hex()[:8]} lost"))
 
     def _fail_outstanding(self, error: BaseException) -> None:
-        self._stopped.set()
-        cbs = list(self._done_cbs.values())
-        self._done_cbs.clear()
+        # under _send_lock: _send registers callbacks under the same lock
+        # and checks _stopped first, so a registration either lands before
+        # this snapshot (and is failed here) or observes _stopped and
+        # raises — no callback can be silently dropped between the two
+        with self._send_lock:
+            self._stopped.set()
+            cbs = list(self._done_cbs.values())
+            self._done_cbs.clear()
         with self._reply_cv:
             self._replies[-1] = {"ok": False, "error": repr(error), "exc": None}
             self._reply_cv.notify_all()
@@ -301,8 +306,12 @@ class RemoteNodeAgent:
             is_application_error=bool(payload.get("is_application_error")),
         )
 
-    def _send(self, method: str, *, done: Optional[Callable] = None, **fields) -> int:
+    def _send(self, method: str, *, done: Optional[Callable] = None,
+              **fields) -> int:
         with self._send_lock:
+            if self._stopped.is_set():
+                raise WorkerCrashedError(
+                    f"connection to node {self.node_id.hex()[:8]} lost")
             self._next_id += 1
             req_id = self._next_id
             if done is not None:
@@ -402,9 +411,18 @@ def enable_cross_host(runtime) -> ObjectTransferServer:
         _AgentStoreAdapter(runtime),
         host=config.control_plane_rpc_host,
     )
+    # the ADVERTISED address must be reachable from workers: a wildcard
+    # bind (0.0.0.0) would advertise an address that resolves to the
+    # WORKER's own host — substitute the head's cluster-facing node_host
+    bind_host, _, bind_port = transfer.address.rpartition(":")
+    if bind_host in ("0.0.0.0", "::", ""):
+        advertised = f"{config.node_host}:{bind_port}"
+    else:
+        advertised = transfer.address
+
     # one address serves every local (virtual) node's store
     def _advertise_local(node_id: NodeID) -> None:
-        runtime.control_plane.kv_put(KV_PREFIX + node_id.hex(), transfer.address)
+        runtime.control_plane.kv_put(KV_PREFIX + node_id.hex(), advertised)
 
     with runtime._lock:
         local_ids = list(runtime.agents)
@@ -474,6 +492,7 @@ class RemoteDirectoryClient:
         # read loop delivers the replies — firing inline would deadlock the
         # whole worker (pull hangs, heartbeats wedge)
         self._fire_queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._last_fire: Dict[str, float] = {}
         threading.Thread(
             target=self._fire_loop, daemon=True, name="dir-obj-ready"
         ).start()
@@ -483,6 +502,16 @@ class RemoteDirectoryClient:
             oid_hex = self._fire_queue.get()
             if oid_hex is None:
                 return
+            # throttle per object: a pull that keeps failing against a
+            # stale location (dead holder not yet reaped) re-subscribes and
+            # immediately re-fires — unthrottled, that hammers the head
+            # with dir_locations/kv_get RPCs for the whole reap window
+            gap = 0.1 - (time.monotonic() - self._last_fire.get(oid_hex, 0.0))
+            if gap > 0:
+                time.sleep(gap)
+            if len(self._last_fire) > 4096:
+                self._last_fire.clear()
+            self._last_fire[oid_hex] = time.monotonic()
             self._fire(oid_hex)
 
     def add_location(self, object_id: ObjectID, node_id: NodeID) -> None:
@@ -675,22 +704,14 @@ class WorkerRuntime:
         labels: Optional[Dict[str, str]] = None,
         node_host: Optional[str] = None,
     ):
-        import os
+        from ..api import default_node_resources
 
         if node_host is None:
             node_host = config.node_host
 
         self.head_address = address
         self.control_plane = RemoteControlPlane(address)
-        node_resources = dict(resources or {})
-        node_resources.setdefault(
-            "CPU", num_cpus if num_cpus is not None else float(os.cpu_count() or 8))
-        if num_tpus is None:
-            from ..api import _detect_local_tpu_chips
-
-            num_tpus = _detect_local_tpu_chips()
-        if num_tpus:
-            node_resources.setdefault("TPU", float(num_tpus))
+        node_resources = default_node_resources(num_cpus, num_tpus, resources)
         self.info = NodeInfo(
             node_id=NodeID.generate(),
             address=f"{node_host}",
@@ -722,10 +743,16 @@ class WorkerRuntime:
         period = config.health_check_period_ms / 1000.0
         while not self._stopped.is_set():
             try:
-                self.control_plane.heartbeat(
+                alive = self.control_plane.heartbeat(
                     self.node_id, self.agent.resources.available())
             except (WireError, OSError, RuntimeError):
                 logger.warning("head unreachable; shutting worker down")
+                self.shutdown()
+                return
+            if alive is False:
+                # the head reaped us (e.g. a partition outlived the health
+                # timeout): stop serving rather than zombie on
+                logger.warning("head declared this node DEAD; shutting down")
                 self.shutdown()
                 return
             if self.dispatch_server.owner_requested_stop.is_set():
