@@ -502,6 +502,13 @@ class RemoteDirectoryClient:
             oid_hex = self._fire_queue.get()
             if oid_hex is None:
                 return
+            with self._lock:
+                has_waiters = bool(self._waiters.get(oid_hex))
+            if not has_waiters:
+                # duplicate enqueue (subscribe-check + pubsub event race):
+                # nothing to fire, and sleeping here would head-of-line
+                # delay ready callbacks for unrelated objects
+                continue
             # throttle per object: a pull that keeps failing against a
             # stale location (dead holder not yet reaped) re-subscribes and
             # immediately re-fires — unthrottled, that hammers the head

@@ -99,33 +99,35 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 def param_axes(cfg: ModelConfig) -> Params:
     """Logical-axis tree matching init_params' structure exactly.
 
-    Leading None on layer entries is the stacked-layer axis (mapped to
-    "stage" under pipeline parallelism; unsharded otherwise).
+    The leading "stage" on layer entries is the stacked-layer axis:
+    sharded over pp/dcn_pp when the mesh has those axes (params live
+    pp-sharded from birth, so the pipelined train step round-trips state
+    without resharding); unsharded on every other mesh.
     """
     layer = {
-        "ln1": (None, "norm"),
-        "wq": (None, "embed", "heads", None),
-        "wk": (None, "embed", "heads", None),
-        "wv": (None, "embed", "heads", None),
-        "wo": (None, "heads", None, "embed"),
-        "ln2": (None, "norm"),
+        "ln1": ("stage", "norm"),
+        "wq": ("stage", "embed", "heads", None),
+        "wk": ("stage", "embed", "heads", None),
+        "wv": ("stage", "embed", "heads", None),
+        "wo": ("stage", "heads", None, "embed"),
+        "ln2": ("stage", "norm"),
     }
     if cfg.norm == "layernorm":
-        layer["ln1_b"] = (None, "norm")
-        layer["ln2_b"] = (None, "norm")
+        layer["ln1_b"] = ("stage", "norm")
+        layer["ln2_b"] = ("stage", "norm")
     if cfg.is_moe:
-        layer["router"] = (None, "embed", None)
-        layer["w_in"] = (None, "expert", "embed", "expert_mlp")
-        layer["w_gate"] = (None, "expert", "embed", "expert_mlp")
-        layer["w_out"] = (None, "expert", "expert_mlp", "embed")
+        layer["router"] = ("stage", "embed", None)
+        layer["w_in"] = ("stage", "expert", "embed", "expert_mlp")
+        layer["w_gate"] = ("stage", "expert", "embed", "expert_mlp")
+        layer["w_out"] = ("stage", "expert", "expert_mlp", "embed")
     else:
-        layer["w_in"] = (None, "embed", "mlp")
-        layer["w_out"] = (None, "mlp", "embed")
+        layer["w_in"] = ("stage", "embed", "mlp")
+        layer["w_out"] = ("stage", "mlp", "embed")
         if cfg.activation == "swiglu":
-            layer["w_gate"] = (None, "embed", "mlp")
+            layer["w_gate"] = ("stage", "embed", "mlp")
         else:
-            layer["b_in"] = (None, "mlp")
-            layer["b_out"] = (None, "norm")
+            layer["b_in"] = ("stage", "mlp")
+            layer["b_out"] = ("stage", "norm")
     axes: Params = {
         "embed": ("vocab", "embed"),
         "layers": layer,
@@ -283,6 +285,30 @@ def _embed_lookup(table: jax.Array, tokens: jax.Array, dtype, mesh=None) -> jax.
     return jnp.einsum("btv,vd->btd", onehot, table.astype(dtype))
 
 
+def _prologue(params, tokens, cfg, positions=None, mesh=None):
+    """Shared embed + positional prologue -> (x [B,T,D], rope_tables)."""
+    dtype = jnp.dtype(cfg.dtype)
+    T = tokens.shape[1]
+    x = _embed_lookup(params["embed"], tokens, dtype, mesh=mesh)
+    if cfg.positional == "learned":
+        pos = positions if positions is not None else jnp.arange(T)[None, :]
+        x = x + params["pos_emb"][pos].astype(dtype)
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
+    return constrain(x, ("batch", "seq", "embed")), rope_tables
+
+
+def _lm_head(x, params, cfg) -> jax.Array:
+    """Shared final-norm + head epilogue -> logits [B,T,V] f32."""
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), head.astype(jnp.float32))
+    if cfg.logits_softcap:
+        logits = cfg.logits_softcap * jnp.tanh(logits / cfg.logits_softcap)
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -290,16 +316,7 @@ def forward(
     positions: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens [B, T] -> (logits [B, T, V] f32, aux_loss scalar)."""
-    dtype = jnp.dtype(cfg.dtype)
-    B, T = tokens.shape
-    x = _embed_lookup(params["embed"], tokens, dtype)  # [B,T,D]
-    if cfg.positional == "learned":
-        pos = positions if positions is not None else jnp.arange(T)[None, :]
-        x = x + params["pos_emb"][pos].astype(dtype)
-        rope_tables = None
-    else:
-        rope_tables = rope_frequencies(cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
-    x = constrain(x, ("batch", "seq", "embed"))
+    x, rope_tables = _prologue(params, tokens, cfg, positions)
 
     def body(carry, lp):
         y, aux = _block(carry, lp, cfg, rope_tables, positions)
@@ -308,13 +325,68 @@ def forward(
     if cfg.remat:
         body = jax.checkpoint(body)
     x, aux = jax.lax.scan(body, x, params["layers"])
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), head.astype(jnp.float32))
-    if cfg.logits_softcap:
-        logits = cfg.logits_softcap * jnp.tanh(logits / cfg.logits_softcap)
-    logits = constrain(logits, ("batch", "seq", "vocab"))
-    return logits, jnp.sum(aux)
+    return _lm_head(x, params, cfg), jnp.sum(aux)
+
+
+def forward_pp(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipeline-parallel forward: embed + head replicated compute on every
+    pp rank; the layer stack GPipe-pipelined over the `pp` mesh axis
+    (parallel/pipeline.py — microbatches flow stage-to-stage by ppermute
+    inside one lax.scan). Mathematically identical to forward():
+    microbatching only reorders the schedule, so pp losses match dp-only
+    losses on the same seed (the dryrun asserts it).
+
+    Reference status per SURVEY §2.4: upstream has no native PP (deferred
+    to DeepSpeed); here it is a first-class primitive on the flagship
+    model. MoE aux losses are not threaded through the pipeline yet."""
+    from ..parallel.pipeline import pipelined
+    from ..parallel.sharding import no_constrain
+
+    assert not cfg.is_moe, "forward_pp does not support MoE yet"
+    for ax in ("fsdp", "sp"):
+        # the shard_map in_specs here are dp/pp only: an fsdp or sp axis
+        # would silently all-gather ZeRO-sharded params into every stage
+        # rank (HBM blowup) and replicate compute — refuse loudly
+        assert mesh.shape.get(ax, 1) == 1, (
+            f"forward_pp does not compose with the {ax!r} mesh axis yet; "
+            "use dp x pp meshes"
+        )
+    S = mesh.shape[axis_name]
+    L = cfg.n_layers
+    assert L % S == 0, f"{L} layers not divisible by {S} pipeline stages"
+    x, rope_tables = _prologue(params, tokens, cfg, mesh=mesh)
+
+    def stage_fn(lp_stage, h):
+        # per-shard body: constrain() must be inert here (manual axes)
+        with no_constrain():
+            def body(carry, lp):
+                y, _aux = _block(carry, lp, cfg, rope_tables, None)
+                return y, None
+
+            if cfg.remat:
+                body = jax.checkpoint(body)
+            h, _ = jax.lax.scan(body, h, lp_stage)
+            return h
+
+    # [L, ...] stacked layers -> [S, L/S, ...]: contiguous blocks per
+    # stage, so the existing over-leading-axis pp sharding maps 1:1
+    stage_params = jax.tree.map(
+        lambda p: p.reshape(S, L // S, *p.shape[1:]), params["layers"]
+    )
+    from jax.sharding import PartitionSpec
+
+    data_spec = PartitionSpec("dp") if "dp" in mesh.axis_names else PartitionSpec()
+    run = pipelined(stage_fn, mesh, num_microbatches, axis_name=axis_name,
+                    data_spec=data_spec)
+    x = run(stage_params, x)
+    return _lm_head(x, params, cfg), jnp.zeros((), jnp.float32)
 
 
 def loss_fn(
@@ -322,9 +394,14 @@ def loss_fn(
     batch: Dict[str, jax.Array],
     cfg: ModelConfig,
     z_loss_coef: float = 1e-4,
+    forward_fn=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """batch: tokens [B,T], targets [B,T], optional mask [B,T]."""
-    logits, aux = forward(params, batch["tokens"], cfg)
+    """batch: tokens [B,T], targets [B,T], optional mask [B,T].
+
+    forward_fn overrides the forward (e.g. a pipeline-parallel
+    functools.partial(forward_pp, mesh=..., num_microbatches=...))."""
+    fwd = forward_fn if forward_fn is not None else forward
+    logits, aux = fwd(params, batch["tokens"], cfg)
     targets = batch["targets"]
     mask = batch.get("mask")
     if mask is None:

@@ -99,8 +99,36 @@ def tree_shardings(
     )
 
 
+import threading as _threading
+
+_constrain_disabled = _threading.local()  # at import: lazy check-then-assign
+# from two first-caller threads would orphan one thread's flag
+
+
+def no_constrain():
+    """Context manager: constrain() becomes identity while tracing inside.
+
+    Needed for shard_map bodies (pipeline stages): with_sharding_constraint
+    over manual mesh axes is illegal there, and per-shard code already IS
+    the sharding. Thread-local, so concurrent traces don't interfere."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = getattr(_constrain_disabled, "on", False)
+        _constrain_disabled.on = True
+        try:
+            yield
+        finally:
+            _constrain_disabled.on = prev
+
+    return ctx()
+
+
 def constrain(x: jax.Array, axes: Sequence[Optional[str]], rules: Optional[Rules] = None) -> jax.Array:
     """In-jit sharding constraint by logical axes (activation annotations)."""
+    if getattr(_constrain_disabled, "on", False):
+        return x
     mesh = _current_mesh()
     if mesh is None:
         return x

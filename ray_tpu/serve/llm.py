@@ -56,6 +56,12 @@ class LLMServer:
                 devices=devices[:tensor_parallel],
             )
         self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
+        # compile every decode-span program at replica init: the
+        # adaptive policy's busy_span would otherwise jit mid-traffic,
+        # stalling the whole active batch exactly under prefill
+        # pressure (prefill buckets still compile on first use —
+        # warming every bucket would multiply startup time)
+        self.engine.warmup(buckets=[])
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         return self.engine.generate(

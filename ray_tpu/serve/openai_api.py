@@ -103,6 +103,12 @@ class OpenAIServer:
             devices = jax.devices()[:tensor_parallel]
             mesh = build_mesh(MeshSpec.create(tp=tensor_parallel), devices=devices)
         self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
+        # compile every decode-span program at replica init: the
+        # adaptive policy's busy_span would otherwise jit mid-traffic,
+        # stalling the whole active batch exactly under prefill
+        # pressure (prefill buckets still compile on first use —
+        # warming every bucket would multiply startup time)
+        self.engine.warmup(buckets=[])
 
     # ------------------------------------------------------------- routes
 

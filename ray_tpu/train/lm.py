@@ -99,13 +99,15 @@ def init_train_state(
     return state, state_shardings
 
 
-def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation):
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
+                    forward_fn=None):
     """Returns step(state, batch) -> (state, metrics). Jit it under the mesh
-    (donate state for in-place HBM update)."""
+    (donate state for in-place HBM update). forward_fn overrides the model
+    forward (see make_pp_train_step)."""
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         def lossf(params):
-            return loss_fn(params, batch, cfg)
+            return loss_fn(params, batch, cfg, forward_fn=forward_fn)
 
         (_, metrics), grads = jax.value_and_grad(lossf, has_aux=True)(state["params"])
         updates, new_opt = optimizer.update(
@@ -121,6 +123,27 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation):
         )
 
     return step
+
+
+def make_pp_train_step(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    num_microbatches: int,
+):
+    """Pipeline-parallel train step on the real transformer: the layer
+    stack runs as a GPipe microbatch pipeline over the mesh's `pp` axis
+    (models.transformer.forward_pp), embed/head replicated per stage.
+    Same TrainState/shardings as make_train_step — init_train_state on a
+    pp mesh already shards the stacked layer axis over pp ("stage" rule,
+    parallel/sharding.py). num_microbatches must divide the PER-SHARD
+    batch (global batch / dp)."""
+    from ..models.transformer import forward_pp
+
+    def fwd(params, tokens, _cfg):
+        return forward_pp(params, tokens, _cfg, mesh, num_microbatches)
+
+    return make_train_step(cfg, optimizer, forward_fn=fwd)
 
 
 def make_eval_step(cfg: ModelConfig):

@@ -75,3 +75,76 @@ class TestPipeline:
             y = jax.jit(run)(params, x)
         ref = _sequential(params, x, S)
         np.testing.assert_allclose(y, ref, atol=5e-4, rtol=5e-4)
+
+
+class TestPipelineRealModel:
+    """forward_pp on the actual transformer (VERDICT r3 #3): the pipelined
+    train step must be the SAME computation as the dp-only step — GPipe is
+    a schedule, not a different model."""
+
+    def test_pp_train_step_loss_matches_dp_only(self, cpu_mesh_devices):
+        import dataclasses
+
+        from ray_tpu.comm.mesh import set_mesh
+        from ray_tpu.models import get_config
+        from ray_tpu.train.lm import (
+            batch_shardings,
+            init_train_state,
+            make_optimizer,
+            make_pp_train_step,
+            make_train_step,
+            synthetic_batch,
+        )
+
+        cfg = dataclasses.replace(get_config("tiny-llama"), n_layers=4)
+        batch = synthetic_batch(cfg, 8, 32)
+        losses = {}
+        for name, sizes, maker in (
+            ("dp", {"dp": 8}, lambda m: make_train_step(cfg, opt)),
+            ("pp", {"dp": 2, "pp": 4},
+             lambda m: make_pp_train_step(cfg, opt, m, num_microbatches=2)),
+        ):
+            mesh = build_mesh(MeshSpec.create(**sizes), devices=cpu_mesh_devices)
+            set_mesh(mesh)
+            opt = make_optimizer(total_steps=10)
+            state, shardings = init_train_state(
+                cfg, mesh, jax.random.PRNGKey(0), opt)
+            step = jax.jit(maker(mesh), donate_argnums=0,
+                           in_shardings=(shardings, batch_shardings(mesh)))
+            with mesh:
+                state, metrics = step(state, batch)
+                state, metrics = step(state, batch)  # second step: grads applied
+            losses[name] = float(metrics["loss"])
+        assert losses["pp"] == pytest.approx(losses["dp"], abs=2e-3), losses
+
+    def test_pp_microbatch_count_is_schedule_only(self, cpu_mesh_devices):
+        import dataclasses
+
+        from ray_tpu.comm.mesh import set_mesh
+        from ray_tpu.models import get_config
+        from ray_tpu.train.lm import (
+            batch_shardings,
+            init_train_state,
+            make_optimizer,
+            make_pp_train_step,
+            synthetic_batch,
+        )
+
+        cfg = dataclasses.replace(get_config("tiny-llama"), n_layers=2)
+        batch = synthetic_batch(cfg, 8, 32)
+        losses = []
+        mesh = build_mesh(
+            MeshSpec.create(dp=4, pp=2), devices=cpu_mesh_devices)
+        set_mesh(mesh)
+        for mb in (1, 2):
+            opt = make_optimizer(total_steps=10)
+            state, shardings = init_train_state(
+                cfg, mesh, jax.random.PRNGKey(0), opt)
+            step = jax.jit(
+                make_pp_train_step(cfg, opt, mesh, num_microbatches=mb),
+                donate_argnums=0,
+                in_shardings=(shardings, batch_shardings(mesh)))
+            with mesh:
+                _, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[0] == pytest.approx(losses[1], abs=1e-4), losses
