@@ -18,7 +18,11 @@ for train, "serve_anchor"/"data_anchor" for the rest); missing anchor -> 1.0.
 
 Env knobs: RAY_TPU_BENCH_MODEL, RAY_TPU_BENCH_BATCH, RAY_TPU_BENCH_SEQ,
 RAY_TPU_BENCH_STEPS, RAY_TPU_BENCH_SCAN (0 disables the scanned metric),
-RAY_TPU_BENCH_SUITE (comma list of train,serve,data; default all).
+RAY_TPU_BENCH_SUITE (comma list of train,train2b,serve,data; default all;
+train2b is the pinned ~2B stepping-stone run, anchored separately).
+
+vs_baseline for train divides by "bench_anchor" (llama-600m) or the
+per-model "bench_anchor_<model>" key (e.g. bench_anchor_llama_2b).
 """
 
 from __future__ import annotations
@@ -175,7 +179,8 @@ def bench_data() -> None:
           lower_is_better=True)
 
 
-def bench_train() -> None:
+def bench_train(model=None, batch=None, seq=None, steps=None, span=None,
+                factored: bool = False, bf16_params: bool = False) -> None:
     import jax
     import jax.numpy as jnp  # noqa: F401
 
@@ -188,19 +193,31 @@ def bench_train() -> None:
         synthetic_batch,
     )
 
-    model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
-    batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
-    seq = int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
-    steps = int(os.environ.get("RAY_TPU_BENCH_STEPS", "20"))
-    span = int(os.environ.get("RAY_TPU_BENCH_SCAN", "5"))
+    model = model or os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
+    batch = batch or int(os.environ.get("RAY_TPU_BENCH_BATCH", "8"))
+    seq = seq or int(os.environ.get("RAY_TPU_BENCH_SEQ", "2048"))
+    steps = steps or int(os.environ.get("RAY_TPU_BENCH_STEPS", "20"))
+    if span is None:
+        span = int(os.environ.get("RAY_TPU_BENCH_SCAN", "5"))
     span = max(0, min(span, steps))
 
     cfg = get_config(model)
     n_dev = len(jax.devices())
     mesh = build_mesh(MeshSpec.create(dp=-1), devices=jax.devices())
     set_mesh(mesh)
-    opt = make_optimizer(total_steps=4 * steps + 20)
+    opt = make_optimizer(total_steps=4 * steps + 20, factored=factored)
     state, _ = init_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+    if bf16_params:
+        # single-chip 2B: f32 master + f32 grads alone are 8 bytes/param
+        # (14.6GB at 1.8B) and blow the 16GB HBM. bf16 master + FACTORED
+        # f32 adafactor stats halves both the resident state and the grad
+        # tree; multi-chip deployments keep f32 masters and shard them
+        # over fsdp instead (the dryrun path).
+        state["params"] = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x,
+            state["params"],
+        )
     one_step = make_train_step(cfg, opt)
     data = synthetic_batch(cfg, batch, seq)
 
@@ -216,7 +233,13 @@ def bench_train() -> None:
             f"batch={batch} seq={seq} dt={dt:.2f}s loss={loss:.3f} mfu={mfu:.2%}",
             file=sys.stderr,
         )
-        _emit(tag, tokens_per_sec, "tokens/s", "bench_anchor")
+        # per-model anchors: the generic bench_anchor is the llama-600m
+        # round-1 number; other sizes get their own key (missing -> 1.0)
+        anchor_key = (
+            "bench_anchor" if model == "llama-600m"
+            else f"bench_anchor_{mname}"
+        )
+        _emit(tag, tokens_per_sec, "tokens/s", anchor_key)
 
     mname = model.replace("-", "_")
     with mesh:
@@ -261,15 +284,26 @@ def bench_train() -> None:
 
 
 def main() -> None:
-    suite = os.environ.get("RAY_TPU_BENCH_SUITE", "train,serve,data")
+    suite = os.environ.get(
+        "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data")
     wanted = {s.strip() for s in suite.split(",") if s.strip()}
     model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
-    if "train" in wanted:
-        bench_train()
+    # serve runs FIRST: the 2B train bench leaves the tunnel-attached
+    # chip's HBM fragmented enough to wreck subsequent serve latency
+    # (measured: p50 TTFT 1.3s standalone vs 12.9s after train2b)
     if "serve" in wanted:
         bench_serve(model)
     if "data" in wanted:
         bench_data()
+    if "train" in wanted:
+        bench_train()
+    if "train2b" in wanted:
+        # scale stepping stone (VERDICT r3 #4): ~2B params, remat on,
+        # factored optimizer state — MFU must survive the size jump.
+        # Every knob pinned: this run compares against a fixed anchor
+        # (bench_anchor_llama_2b) and must not inherit env overrides.
+        bench_train(model="llama-2b", batch=4, seq=2048, steps=8, span=4,
+                    factored=True, bf16_params=True)
 
 
 if __name__ == "__main__":

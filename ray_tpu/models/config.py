@@ -134,6 +134,21 @@ register(ModelConfig(
     rope_theta=500000.0,
 ))
 
+register(ModelConfig(
+    name="llama-2b",
+    # ~2B Llama-3 family member: the single-chip scale stepping stone
+    # toward llama3-8b (BASELINE.md workload #2). remat (on by default)
+    # plus a FACTORED optimizer (train.lm.make_optimizer(factored=True),
+    # adafactor second moments) is what fits f32 master state + grads in
+    # one 16GB v5e chip — adamw moments alone would be 2x params.
+    vocab_size=32000,
+    d_model=2560, n_layers=24, n_heads=20, n_kv_heads=5,
+    head_dim=128, d_ff=6912,
+    max_seq_len=4096,
+    norm="rmsnorm", activation="swiglu", positional="rope",
+    rope_theta=500000.0,
+))
+
 # tiny variants for tests / CPU-mesh dry runs
 register(ModelConfig(
     name="tiny-llama",

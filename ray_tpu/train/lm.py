@@ -30,10 +30,34 @@ def make_optimizer(
     b1: float = 0.9,
     b2: float = 0.95,
     grad_clip: float = 1.0,
+    factored: bool = False,
 ) -> optax.GradientTransformation:
+    """factored=True swaps adamw for adafactor (factored second moments,
+    no first moment): optimizer state shrinks from 2x params to ~O(rows +
+    cols) — the standard TPU answer for fitting billion-param single-chip
+    state (T5's recipe), used by the llama-2b bench config. NOTE: the
+    factored path runs momentum-less and undecayed — b1/b2/weight_decay
+    do not apply (adafactor's weight_decay_rate is a per-step
+    multiplicative decay, not adamw's lr-scaled decoupled decay)."""
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
+    if factored:
+        # Two adafactor traps, both measured fatal on the LM task:
+        # - multiply_by_parameter_scale makes updates proportional to
+        #   weight norms; with 0.02-scale init that freezes learning at LM
+        #   learning rates — scale by the schedule directly instead.
+        # - weight_decay_rate is a PER-STEP multiplicative decay (NOT
+        #   lr-scaled like adamw's decoupled decay): 0.1 shrinks every
+        #   weight 10%/step and cancels all learning. Run undecayed (the
+        #   T5 recipe also trains adafactor without decay).
+        return optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adafactor(
+                schedule, weight_decay_rate=None,
+                multiply_by_parameter_scale=False,
+            ),
+        )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
         optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
