@@ -11,6 +11,7 @@ TPU-first architecture rather than a port.
 from .api import (  # noqa: F401
     GetTimeoutError,
     ObjectRef,
+    ObjectRefGenerator,
     RayActorError,
     RayTaskError,
     available_resources,

@@ -20,6 +20,7 @@ from .core.control_plane import ActorState
 from .core.core_worker import (
     GetTimeoutError,
     ObjectRef,
+    ObjectRefGenerator,
     RayActorError,
     RayTaskError,
     Runtime,
@@ -48,6 +49,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RayTaskError",
     "RayActorError",
     "GetTimeoutError",
@@ -220,7 +222,11 @@ def _make_options(kwargs: Dict[str, Any]) -> TaskOptions:
     topo = kwargs.pop("topology", None)
     if topo is not None and not isinstance(topo, TopologyRequest):
         topo = TopologyRequest(tuple(topo))
+    nr = kwargs.pop("num_returns", 1)
+    if nr != "streaming" and not isinstance(nr, int):
+        raise TypeError(f"num_returns must be an int or 'streaming', got {nr!r}")
     opts = TaskOptions(
+        num_returns=nr,
         num_cpus=kwargs.pop("num_cpus", 1.0),
         num_tpus=kwargs.pop("num_tpus", 0.0),
         topology=topo,
@@ -229,7 +235,6 @@ def _make_options(kwargs: Dict[str, Any]) -> TaskOptions:
         retry_exceptions=kwargs.pop("retry_exceptions", False),
         max_restarts=kwargs.pop("max_restarts", 0),
         max_task_retries=kwargs.pop("max_task_retries", 0),
-        num_returns=kwargs.pop("num_returns", 1),
         name=kwargs.pop("name", ""),
         scheduling_strategy=kwargs.pop("scheduling_strategy", None) or TaskOptions().scheduling_strategy,
         runtime_env=kwargs.pop("runtime_env", None),
@@ -250,7 +255,8 @@ class RemoteFunction:
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         rt = _auto_init()
         task_id = TaskID.of()
-        n = max(1, self._options.num_returns)
+        streaming = self._options.num_returns == "streaming"
+        n = 0 if streaming else max(1, self._options.num_returns)
         spec = TaskSpec(
             task_id=task_id,
             job_id=rt.job_id,
@@ -262,6 +268,9 @@ class RemoteFunction:
             return_ids=[ObjectID.for_task_return(task_id, i) for i in range(n)],
             dependencies=_cw._collect_deps(args, kwargs),
         )
+        if streaming:
+            # generator task: refs stream back while it runs
+            return rt.submit_streaming_task(spec)
         refs = rt.submit_task(spec)
         if self._options.num_returns == 1:
             return refs[0]
@@ -315,6 +324,11 @@ class ActorMethod:
     def options(self, num_returns: int = 1, **kwargs):
         if kwargs:
             raise TypeError(f"unsupported actor-method options: {sorted(kwargs)}")
+        if not isinstance(num_returns, int):
+            raise TypeError(
+                "actor methods do not support streaming returns yet; "
+                f"num_returns must be an int, got {num_returns!r}"
+            )
         return ActorMethod(self._handle, self._name, num_returns)
 
     def bind(self, *args):

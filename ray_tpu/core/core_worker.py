@@ -146,6 +146,55 @@ class _PendingTask:
     submitted_at: float = field(default_factory=time.monotonic)
     target_node: Optional[NodeID] = None
     pg_lease: Optional[Tuple[Any, int, Dict[str, float]]] = None
+    # streaming tasks: per-item callback (index, ObjectID) threaded down to
+    # the executing agent (None for ordinary tasks)
+    stream: Optional[Callable[[int, ObjectID], None]] = None
+
+
+class _StreamRecord:
+    """Owner-side state of one streaming task's output sequence."""
+
+    __slots__ = ("cv", "refs", "done", "error")
+
+    def __init__(self):
+        self.cv = threading.Condition()
+        self.refs: List["ObjectRef"] = []
+        self.done = False
+        self.error: Optional[BaseException] = None
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs, yielding each as soon
+    as the producer seals it — the consumer runs concurrently with the
+    still-executing task (reference: ObjectRefGenerator /
+    num_returns="streaming"). A producer error raises HERE, after every
+    item produced before the failure has been yielded."""
+
+    def __init__(self, runtime: "Runtime", task_id: TaskID, record: _StreamRecord):
+        self._runtime = runtime
+        self.task_id = task_id
+        self._record = record
+        self._idx = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        rec = self._record
+        with rec.cv:
+            while True:
+                if self._idx < len(rec.refs):
+                    ref = rec.refs[self._idx]
+                    self._idx += 1
+                    return ref
+                if rec.done:
+                    if rec.error is not None:
+                        raise rec.error
+                    raise StopIteration
+                rec.cv.wait(timeout=1.0)
+
+    def completed(self) -> bool:
+        return self._record.done
 
 
 class _Future:
@@ -292,6 +341,37 @@ class Runtime:
         )
         self._enqueue_pending(pending)
         return refs
+
+    def submit_streaming_task(self, spec: TaskSpec) -> ObjectRefGenerator:
+        """Submit a generator task; returns the ref generator immediately.
+
+        Streaming tasks do not retry or reconstruct (a partially-consumed
+        stream cannot be transparently replayed); the consumer sees the
+        producer's failure at the end of the yielded prefix."""
+        record = _StreamRecord()
+
+        def on_item(index: int, oid: ObjectID) -> None:
+            ref = ObjectRef(oid, self)
+            with record.cv:
+                # index is authoritative: items may arrive batched but
+                # never out of order (single producer)
+                record.refs.append(ref)
+                record.cv.notify_all()
+
+        with self._lock:
+            self._task_table[spec.task_id] = {
+                "name": spec.name,
+                "state": "PENDING",
+                "kind": spec.kind.value,
+                "attempt": 0,
+                "ts_submit": _timeline_now_us(),
+            }
+            self._streams = getattr(self, "_streams", {})
+            self._streams[spec.task_id] = record
+        self._enqueue_pending(_PendingTask(
+            spec, retries_left=0, retry_exceptions=False, stream=on_item,
+        ))
+        return ObjectRefGenerator(self, spec.task_id, record)
 
     def create_actor(self, cls, args, kwargs, options: TaskOptions) -> "ActorInfo":
         actor_id = ActorID.of(self.job_id)
@@ -542,7 +622,8 @@ class Runtime:
             if agent is None:
                 return False
             self._mark_task(spec.task_id, "RUNNING")
-            agent.submit(spec, lambda result: self._on_task_done(item, result))
+            agent.submit(spec, lambda result: self._on_task_done(item, result),
+                         stream=item.stream)
             return True
 
         try:
@@ -563,7 +644,8 @@ class Runtime:
         if spec.kind is TaskKind.ACTOR_CREATION:
             self.control_plane.update_actor(spec.actor_id, ActorState.STARTING, node_id)
         self._mark_task(spec.task_id, "RUNNING")
-        agent.submit(spec, lambda result: self._on_task_done(item, result))
+        agent.submit(spec, lambda result: self._on_task_done(item, result),
+                         stream=item.stream)
         return True
 
     def _try_place_in_pg(self, item: _PendingTask, strategy) -> bool:
@@ -607,7 +689,8 @@ class Runtime:
             if spec.kind is TaskKind.ACTOR_CREATION:
                 self.control_plane.update_actor(spec.actor_id, ActorState.STARTING, node_id)
             self._mark_task(spec.task_id, "RUNNING")
-            agent.submit(spec, lambda result: self._on_task_done(item, result))
+            agent.submit(spec, lambda result: self._on_task_done(item, result),
+                         stream=item.stream)
             return True
         return False
 
@@ -631,6 +714,7 @@ class Runtime:
             spec.skip_node_resources = False
         if result.ok:
             self._mark_task(spec.task_id, "FINISHED")
+            self._finish_stream(spec.task_id, None)
             if spec.kind is TaskKind.ACTOR_CREATION:
                 if killed_during_init:
                     # tear the fresh runner back down; DEAD stays DEAD
@@ -733,8 +817,22 @@ class Runtime:
         else:
             self._on_actor_death(actor, WorkerCrashedError("killed"))
 
+    def _finish_stream(self, task_id: TaskID, error: Optional[BaseException]) -> None:
+        # pop, don't get: nothing writes a finished record again, and the
+        # consumer's ObjectRefGenerator holds its own reference — keeping
+        # it in the table would leak every stream's refs for the runtime's
+        # lifetime
+        record = getattr(self, "_streams", {}).pop(task_id, None)
+        if record is None:
+            return
+        with record.cv:
+            record.error = error
+            record.done = True
+            record.cv.notify_all()
+
     def _fail_task(self, item: _PendingTask, error: BaseException) -> None:
         self._mark_task(item.spec.task_id, "FAILED")
+        self._finish_stream(item.spec.task_id, error)
         if item.spec.kind is TaskKind.ACTOR_CREATION:
             # a failed creation must kill the actor record, or pending method
             # calls wait forever for a start that will never come

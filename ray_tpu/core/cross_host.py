@@ -226,6 +226,7 @@ class RemoteNodeAgent:
         self._send_lock = threading.Lock()
         self._next_id = 0
         self._done_cbs: Dict[int, Callable[[TaskResult], None]] = {}
+        self._stream_cbs: Dict[int, Callable] = {}
         self._replies: Dict[int, dict] = {}
         self._reply_cv = threading.Condition()
         # Completions run OFF the read loop: _on_task_done may call back
@@ -262,7 +263,20 @@ class RemoteNodeAgent:
                 if msg_type != MSG_RESPONSE:
                     continue
                 req_id = payload.get("id")
+                if "stream_item" in payload:
+                    scb = self._stream_cbs.get(req_id)
+                    if scb is not None:
+                        # completions queue keeps item order and the final
+                        # done strictly after the last item
+                        self._completions.put((
+                            lambda _r, _s=scb, _p=payload: _s(
+                                _p["stream_item"],
+                                ObjectID.from_hex(_p["oid_hex"])),
+                            None,
+                        ))
+                    continue
                 cb = self._done_cbs.pop(req_id, None)
+                self._stream_cbs.pop(req_id, None)
                 if cb is not None:
                     self._completions.put((cb, self._to_task_result(payload)))
                 else:
@@ -288,6 +302,7 @@ class RemoteNodeAgent:
             self._stopped.set()
             cbs = list(self._done_cbs.values())
             self._done_cbs.clear()
+            self._stream_cbs.clear()
         with self._reply_cv:
             self._replies[-1] = {"ok": False, "error": repr(error), "exc": None}
             self._reply_cv.notify_all()
@@ -307,7 +322,7 @@ class RemoteNodeAgent:
         )
 
     def _send(self, method: str, *, done: Optional[Callable] = None,
-              **fields) -> int:
+              stream: Optional[Callable] = None, **fields) -> int:
         with self._send_lock:
             if self._stopped.is_set():
                 raise WorkerCrashedError(
@@ -316,11 +331,16 @@ class RemoteNodeAgent:
             req_id = self._next_id
             if done is not None:
                 self._done_cbs[req_id] = done
+            if stream is not None:
+                # registered BEFORE the frame ships: a stream item can
+                # race back before this method returns
+                self._stream_cbs[req_id] = stream
             try:
                 send_msg(self._sock, MSG_REQUEST,
                          {"id": req_id, "method": method, **fields})
             except (WireError, OSError) as e:
                 self._done_cbs.pop(req_id, None)
+                self._stream_cbs.pop(req_id, None)
                 raise WorkerCrashedError(
                     f"dispatch to node {self.node_id.hex()[:8]} failed: {e}")
         return req_id
@@ -343,7 +363,8 @@ class RemoteNodeAgent:
         return resp.get("value")
 
     # -- NodeAgent duck surface --------------------------------------------
-    def submit(self, spec, done: Callable[[TaskResult], None]) -> None:
+    def submit(self, spec, done: Callable[[TaskResult], None],
+               stream: Optional[Callable] = None) -> None:
         if self._stopped.is_set():
             done(TaskResult(spec.task_id, ok=False,
                             error=WorkerCrashedError("remote node disconnected")))
@@ -354,7 +375,8 @@ class RemoteNodeAgent:
             done(result)
 
         try:
-            self._send("submit", done=on_result, spec_blob=_dumps(spec))
+            self._send("submit", done=on_result, stream=stream,
+                       spec_blob=_dumps(spec))
         except WorkerCrashedError as e:
             done(TaskResult(spec.task_id, ok=False, error=e))
 
@@ -637,10 +659,16 @@ class _WorkerDispatchHandler(socketserver.BaseRequestHandler):
                         "is_application_error": result.is_application_error,
                     })
 
+            stream_cb = None
+            if spec.options.num_returns == "streaming":
+                def stream_cb(i, oid):
+                    reply({"id": req_id, "stream_item": i, "oid_hex": oid.hex()})
+
             # off the read loop: submit() pulls missing dependencies inline,
             # which must not serialize behind other dispatches
             threading.Thread(
-                target=agent.submit, args=(spec, done), daemon=True,
+                target=agent.submit, args=(spec, done),
+                kwargs={"stream": stream_cb}, daemon=True,
                 name=f"dispatch-{spec.task_id.hex()[:8]}",
             ).start()
         elif method == "kill_actor":

@@ -192,6 +192,8 @@ class NodeAgent:
         # tasks currently running, for cancellation/failure injection
         self._running: Dict[TaskID, threading.Event] = {}
         self._pending_actor_dones: Dict[TaskID, DoneCallback] = {}
+        # per-item callbacks for streaming tasks, keyed by task id
+        self._stream_cbs: Dict[TaskID, Callable[[int, ObjectID], None]] = {}
         # CPU-task process pool (config.worker_processes > 0): created lazily
         # on the first eligible task so thread-mode runtimes pay nothing —
         # but the forkserver itself pre-boots in the background at agent
@@ -211,13 +213,20 @@ class NodeAgent:
         self.suspend_heartbeat = False
 
     # ------------------------------------------------------------------ api
-    def submit(self, spec: TaskSpec, done: DoneCallback) -> None:
+    def submit(self, spec: TaskSpec, done: DoneCallback,
+               stream: Optional[Callable[[int, ObjectID], None]] = None) -> None:
         """Dispatch once dependencies are local. Resources are acquired by the
         executing worker thread (dependency-first, like the reference's
-        dispatch order: args ready -> acquire -> pop worker)."""
+        dispatch order: args ready -> acquire -> pop worker).
+
+        stream: per-item callback for num_returns="streaming" tasks,
+        invoked as each yielded value seals into the store."""
         if self._stopped.is_set():
             done(TaskResult(spec.task_id, ok=False, error=WorkerCrashedError("node stopped")))
             return
+        if stream is not None:
+            with self._lock:
+                self._stream_cbs[spec.task_id] = stream
         missing = [d for d in spec.dependencies if not self.store.contains(d)]
         if not missing:
             self._enqueue(spec, done)
@@ -281,6 +290,8 @@ class NodeAgent:
     def _execute(self, spec: TaskSpec) -> TaskResult:
         if spec.kind is TaskKind.ACTOR_CREATION:
             return self._execute_actor_creation(spec)
+        if spec.options.num_returns == "streaming":
+            return self._execute_streaming(spec)
         kill_event = threading.Event()
         with self._lock:
             self._running[spec.task_id] = kill_event
@@ -299,6 +310,49 @@ class NodeAgent:
             return TaskResult(
                 spec.task_id, ok=False, error=e, is_application_error=True
             )
+        finally:
+            _running_gauge.add(-1, {"node": self.node_id.hex()[:8]})
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+
+    def _execute_streaming(self, spec: TaskSpec) -> TaskResult:
+        """Generator task: each yielded value seals into the store under
+        ObjectID.for_task_return(task_id, i) and the owner's stream
+        callback fires immediately — the consumer iterates while this
+        loop still runs. Runs in-process (never on the worker-process
+        pool: a generator cannot cross that boundary incrementally).
+        On a mid-stream exception the already-sealed prefix stays valid;
+        the owner surfaces the error after it."""
+        kill_event = threading.Event()
+        with self._lock:
+            self._running[spec.task_id] = kill_event
+            stream_cb = self._stream_cbs.pop(spec.task_id, None)
+        _running_gauge.add(1, {"node": self.node_id.hex()[:8]})
+        try:
+            args, kwargs = self._materialize_args(spec)
+            gen = spec.func(*args, **kwargs)
+            if not hasattr(gen, "__next__"):
+                raise TypeError(
+                    f"num_returns='streaming' task {spec.name} must be a "
+                    f"generator; got {type(gen).__name__}"
+                )
+            for i, value in enumerate(gen):
+                if kill_event.is_set():
+                    raise WorkerCrashedError("worker killed during streaming")
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                self.store.put(oid, seal_value(value, spec.name))
+                self._directory.add_location(oid, self.node_id)
+                if stream_cb is not None:
+                    stream_cb(i, oid)
+            _tasks_counter.inc(tags={"outcome": "ok"})
+            return TaskResult(spec.task_id, ok=True, values=None)
+        except WorkerCrashedError as e:
+            _tasks_counter.inc(tags={"outcome": "crashed"})
+            return TaskResult(spec.task_id, ok=False, error=e)
+        except BaseException as e:  # noqa: BLE001 — user generators raise anything
+            _tasks_counter.inc(tags={"outcome": "error"})
+            return TaskResult(spec.task_id, ok=False, error=e,
+                              is_application_error=True)
         finally:
             _running_gauge.add(-1, {"node": self.node_id.hex()[:8]})
             with self._lock:
@@ -662,6 +716,7 @@ class NodeAgent:
         with self._lock:
             pending = list(self._pending_actor_dones.items())
             self._pending_actor_dones.clear()
+            self._stream_cbs.clear()
         for task_id, done in pending:
             done(TaskResult(task_id, ok=False,
                             error=WorkerCrashedError("node stopped")))

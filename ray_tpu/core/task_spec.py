@@ -74,7 +74,11 @@ class TaskOptions:
     retry_exceptions: bool = False
     max_restarts: int = 0  # actors only
     max_task_retries: int = 0  # actors only
-    num_returns: int = 1
+    # int, or "streaming": the task is a GENERATOR whose yields seal into
+    # the object plane one by one; the caller consumes an ObjectRefGenerator
+    # while the task still runs (reference: num_returns="streaming",
+    # core-worker streaming generator returns in task_manager.cc)
+    num_returns: Any = 1
     name: str = ""
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     runtime_env: Optional[Dict[str, Any]] = None

@@ -43,6 +43,20 @@ def _run_read(task: Callable[[], Block]) -> Block:
     return task()
 
 
+@api.remote(num_returns="streaming")
+def _run_read_stream(task: Callable[[], Any]):
+    """Streaming read: a task producing SEVERAL blocks (generator) seals
+    each into the object plane as it materializes, so downstream stages
+    start on block 0 while the read still runs (reference: Data read
+    tasks consumed as core-worker streaming generators). Single-block
+    tasks stream their one block."""
+    out = task()
+    if hasattr(out, "__next__"):
+        yield from out
+    else:
+        yield out
+
+
 @api.remote
 def _run_stage(stage: Callable[[Block], Block], block: Block) -> Block:
     return stage(block)
@@ -107,13 +121,23 @@ def _block_meta(block: Block):
     return (m.num_rows, m.size_bytes, m.schema)
 
 
-def _windowed(submit_fns: List[Callable[[], Any]], max_in_flight: int) -> Iterator[Any]:
-    """Submit lazily with a bounded window; yield refs in order."""
+def _windowed_gen(read_tasks: List[Callable], max_in_flight: int) -> Iterator[Any]:
+    """Submit read tasks with a bounded window; yield one REF ITERATOR per
+    task, in order. Tasks marked ``.streaming`` (generators of blocks) run
+    as streaming-generator tasks — their refs surface while the task still
+    executes; plain tasks take the ordinary path (worker-process pool,
+    retries)."""
+
+    def submit(t):
+        if getattr(t, "streaming", False):
+            return _run_read_stream.remote(t)  # ObjectRefGenerator
+        return [_run_read.remote(t)]
+
     pending: List[Any] = []
     idx = 0
-    while idx < len(submit_fns) or pending:
-        while idx < len(submit_fns) and len(pending) < max_in_flight:
-            pending.append(submit_fns[idx]())
+    while idx < len(read_tasks) or pending:
+        while idx < len(read_tasks) and len(pending) < max_in_flight:
+            pending.append(submit(read_tasks[idx]))
             idx += 1
         yield pending.pop(0)
 
@@ -131,11 +155,11 @@ class StreamingExecutor:
 
         if isinstance(source, Read):
             def gen():
-                for ref in _windowed(
-                    [lambda t=t: _run_read.remote(t) for t in source.read_tasks],
-                    self.max_in_flight,
-                ):
-                    yield ref
+                # generator-valued read tasks stream their blocks out
+                # incrementally; plain tasks go through the ordinary task
+                # path (worker-process pool, retries)
+                for t in _windowed_gen(source.read_tasks, self.max_in_flight):
+                    yield from t
             stream: Iterator[Any] = gen()
         elif isinstance(source, InputData):
             stream = iter(list(source.blocks))

@@ -111,13 +111,21 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
 
     def make_task(f):
         def task():
+            # GENERATOR: one block per row group, streamed out of the task
+            # as each materializes (executor._run_read_stream) — a consumer
+            # sees the first row group while the rest of the file reads
+            import builtins  # this module shadows `range` with the factory
+
             import pyarrow.parquet as pq
 
-            table = pq.read_table(f, columns=columns)
-            return {
-                c: table.column(c).to_numpy(zero_copy_only=False)
-                for c in table.column_names
-            }
+            pf = pq.ParquetFile(f)
+            for rg in builtins.range(pf.num_row_groups):
+                table = pf.read_row_group(rg, columns=columns)
+                yield {
+                    c: table.column(c).to_numpy(zero_copy_only=False)
+                    for c in table.column_names
+                }
+        task.streaming = True
         return task
 
     return _make([make_task(f) for f in files], "read_parquet")

@@ -227,3 +227,26 @@ def test_gang_spans_two_runtimes_real_train_step():
     losses = [float(m) for m in re.findall(r"GANG_LOSS rank=\d ([\d.]+)", out)]
     assert len(losses) == 2 and losses[0] == pytest.approx(losses[1]), out
     assert "XH-GANG-OK" in out
+
+
+class TestCrossHostStreaming:
+    def test_streaming_task_on_remote_node(self, head_with_worker):
+        """Streaming generator refs flow back over the dispatch channel
+        while the remote task still runs (stream_item frames before the
+        final done frame)."""
+        rt, proc = head_with_worker
+
+        @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1},
+                        num_returns="streaming")
+        def produce():
+            for i in range(3):
+                yield {"i": i, "pid": os.getpid()}
+                time.sleep(0.2)
+
+        gen = produce.remote()
+        first = ray_tpu.get(next(gen), timeout=60)
+        assert first["i"] == 0
+        assert first["pid"] == proc.pid  # really executed on the worker
+        assert not gen.completed()  # producer still running after item 0
+        rest = [ray_tpu.get(r, timeout=60)["i"] for r in gen]
+        assert rest == [1, 2]

@@ -1,0 +1,127 @@
+"""Streaming generator returns (reference: num_returns="streaming" /
+ObjectRefGenerator, core-worker streaming generators in task_manager.cc;
+VERDICT r3 #5).
+
+What runs for real: generator tasks seal each yielded value into the
+object plane while still executing; the consumer iterates concurrently,
+receives block 0 BEFORE the producer finishes, and producer errors
+surface after the yielded prefix. Data's parquet reads stream one block
+per row group through the same machinery."""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+class TestStreamingCore:
+    def test_refs_arrive_before_producer_finishes(self, ray_start_regular):
+        marker = os.path.join(tempfile.mkdtemp(), "done")
+
+        @ray_tpu.remote(num_returns="streaming")
+        def produce():
+            for i in range(3):
+                yield {"i": i}
+                time.sleep(0.3)
+            open(marker, "w").write("done")
+
+        gen = produce.remote()
+        assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+        first = next(gen)
+        v0 = ray_tpu.get(first, timeout=10)
+        # the criterion: item 0 consumed while the producer still runs
+        assert v0 == {"i": 0}
+        assert not os.path.exists(marker), "producer finished before item 0 use"
+        rest = [ray_tpu.get(r, timeout=10) for r in gen]
+        assert rest == [{"i": 1}, {"i": 2}]
+        deadline = time.monotonic() + 5
+        while not os.path.exists(marker) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert os.path.exists(marker)
+
+    def test_error_surfaces_after_yielded_prefix(self, ray_start_regular):
+        @ray_tpu.remote(num_returns="streaming")
+        def flaky():
+            yield 1
+            yield 2
+            raise ValueError("stream blew up")
+
+        gen = flaky.remote()
+        assert ray_tpu.get(next(gen), timeout=10) == 1
+        assert ray_tpu.get(next(gen), timeout=10) == 2
+        with pytest.raises(ray_tpu.RayTaskError) as ei:
+            for _ in gen:
+                pass
+        assert isinstance(ei.value.cause, ValueError)
+
+    def test_non_generator_function_fails(self, ray_start_regular):
+        @ray_tpu.remote(num_returns="streaming")
+        def not_a_gen():
+            return [1, 2, 3]
+
+        gen = not_a_gen.remote()
+        with pytest.raises(ray_tpu.RayTaskError) as ei:
+            next(gen)
+        assert isinstance(ei.value.cause, TypeError)
+
+    def test_streamed_ref_as_dependency(self, ray_start_regular):
+        @ray_tpu.remote(num_returns="streaming")
+        def produce():
+            yield list(range(100))
+
+        @ray_tpu.remote
+        def consume(x):
+            return sum(x)
+
+        ref = next(produce.remote())
+        assert ray_tpu.get(consume.remote(ref), timeout=10) == sum(range(100))
+
+
+class TestStreamingData:
+    def test_parquet_row_groups_stream(self, ray_start_regular, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        from ray_tpu import data as rd
+
+        path = str(tmp_path / "t.parquet")
+        table = pa.table({"x": np.arange(4000)})
+        pq.write_table(table, path, row_group_size=1000)  # 4 row groups
+
+        ds = rd.read_parquet(path)
+        it = iter(ds.iter_batches(batch_size=1000))
+        first = next(it)
+        assert len(first["x"]) == 1000
+        total = len(first["x"]) + sum(len(b["x"]) for b in it)
+        assert total == 4000
+
+    def test_consumer_gets_block0_before_read_task_finishes(
+            self, ray_start_regular, tmp_path):
+        """VERDICT r3 #5 done-criterion, at the Data layer: a slow
+        multi-block read task's first block reaches the consumer while
+        the task is still producing later blocks."""
+        from ray_tpu.data.read_api import _make
+        from ray_tpu import data as rd  # noqa: F401 — package import side effects
+
+        marker = str(tmp_path / "producer_done")
+
+        def slow_read():
+            for i in range(3):
+                yield {"part": np.full(10, i)}
+                time.sleep(0.4)
+            open(marker, "w").write("done")
+
+        slow_read.streaming = True
+        ds = _make([slow_read], "slow_read", num_rows=30)
+        it = iter(ds.iter_batches(batch_size=10))
+        first = next(it)
+        assert list(first["part"]) == [0] * 10
+        assert not os.path.exists(marker), (
+            "first block only arrived after the producer task finished"
+        )
+        remaining = list(it)
+        assert len(remaining) == 2
