@@ -345,9 +345,11 @@ class Runtime:
     def submit_streaming_task(self, spec: TaskSpec) -> ObjectRefGenerator:
         """Submit a generator task; returns the ref generator immediately.
 
-        Streaming tasks do not retry or reconstruct (a partially-consumed
-        stream cannot be transparently replayed); the consumer sees the
-        producer's failure at the end of the yielded prefix."""
+        Crash retries apply only while the stream is EMPTY (a worker dying
+        before the first yield replays transparently, matching ordinary
+        read-task resilience); once any item has sealed, a partial stream
+        cannot replay and the failure surfaces after the yielded prefix.
+        No lineage reconstruction for streamed objects."""
         record = _StreamRecord()
 
         def on_item(index: int, oid: ObjectID) -> None:
@@ -368,8 +370,13 @@ class Runtime:
             }
             self._streams = getattr(self, "_streams", {})
             self._streams[spec.task_id] = record
+        retries = (
+            spec.options.max_retries
+            if spec.options.max_retries is not None
+            else config.task_max_retries
+        )
         self._enqueue_pending(_PendingTask(
-            spec, retries_left=0, retry_exceptions=False, stream=on_item,
+            spec, retries_left=retries, retry_exceptions=False, stream=on_item,
         ))
         return ObjectRefGenerator(self, spec.task_id, record)
 
@@ -743,6 +750,12 @@ class Runtime:
             if actor is not None and actor.state is ActorState.ALIVE:
                 self._on_actor_death(actor, result.error)
 
+        if item.stream is not None:
+            record = getattr(self, "_streams", {}).get(spec.task_id)
+            if record is not None and record.refs:
+                # items already streamed to the consumer: a replay would
+                # duplicate them — no retry past the first yield
+                item.retries_left = 0
         retriable = not result.is_application_error or item.retry_exceptions
         if retriable and item.retries_left > 0:
             item.retries_left -= 1

@@ -112,6 +112,21 @@ class ResourceTracker:
             return dict(self._available)
 
 
+def _is_async_actor(cls) -> bool:
+    """An actor class with ANY async method runs on an asyncio event loop
+    (reference: async actors in `core_worker.cc` / `actor.py` — the
+    presence of coroutine methods selects the event-loop execution mode).
+    getmembers walks the MRO, so inherited async methods count too."""
+    import inspect
+
+    if not inspect.isclass(cls):
+        return False
+    return any(
+        inspect.iscoroutinefunction(m)
+        for _, m in inspect.getmembers(cls, callable)
+    )
+
+
 class _ActorRunner:
     """Dedicated execution lane for one actor: FIFO mailbox + instance state.
 
@@ -129,6 +144,9 @@ class _ActorRunner:
         self.death_cause: Optional[BaseException] = None
         self.threads: List[threading.Thread] = []
         self.max_concurrency = max(1, max_concurrency)
+        # task ids whose done callbacks are registered but not yet claimed
+        # by a runner lane — swept on kill so no caller hangs
+        self.pending_ids: set = set()
 
     def start(self, run_one: Callable[["_ActorRunner", TaskSpec, Callable[[], None]], None]) -> None:
         for i in range(self.max_concurrency):
@@ -160,6 +178,76 @@ class _ActorRunner:
     def stop(self) -> None:
         for _ in self.threads:
             self.mailbox.put(None)
+
+
+class _AsyncActorRunner(_ActorRunner):
+    """Event-loop lane for an async actor: tasks run as coroutines on ONE
+    asyncio loop; max_concurrency bounds concurrent AWAITS (a semaphore),
+    so a replica overlaps slow requests wherever they await instead of
+    burning a thread per slot (reference: the async actor event loop in
+    `core_worker.cc`; concurrency groups collapse to the semaphore)."""
+
+    def start(self, run_one) -> None:
+        import asyncio
+
+        self.loop = asyncio.new_event_loop()
+        self._run_one = run_one
+
+        def loop_main():
+            asyncio.set_event_loop(self.loop)
+            self.loop.run_forever()
+
+        loop_thread = threading.Thread(
+            target=loop_main, daemon=True,
+            name=f"actor-loop-{self.actor_id.hex()[:8]}",
+        )
+        loop_thread.start()
+        # the semaphore must be created ON the loop
+        fut = asyncio.run_coroutine_threadsafe(self._make_sem(), self.loop)
+        fut.result(timeout=10)
+        dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"actor-dispatch-{self.actor_id.hex()[:8]}",
+        )
+        dispatcher.start()
+        self.threads = [loop_thread, dispatcher]
+
+    async def _make_sem(self):
+        import asyncio
+
+        self._sem = asyncio.Semaphore(self.max_concurrency)
+
+    def _dispatch_loop(self) -> None:
+        import asyncio
+
+        while True:
+            item = self.mailbox.get()
+            if item is None:
+                # cancel in-flight awaits so callers get actor-death errors
+                # instead of hanging, then stop the loop
+                def _cancel_and_stop():
+                    for t in asyncio.all_tasks(self.loop):
+                        t.cancel()
+                    self.loop.call_soon(self.loop.stop)
+
+                self.loop.call_soon_threadsafe(_cancel_and_stop)
+                return
+            asyncio.run_coroutine_threadsafe(self._handle(item), self.loop)
+
+    async def _handle(self, item) -> None:
+        import inspect
+
+        async with self._sem:
+            if item[0] == "__direct__":
+                try:
+                    res = item[1](self.instance)
+                    if inspect.isawaitable(res):
+                        await res
+                except Exception:  # noqa: BLE001
+                    logger.exception("direct async actor submit failed")
+                return
+            spec, _release = item
+            await self._run_one(self, spec)
 
 
 class NodeAgent:
@@ -368,15 +456,7 @@ class NodeAgent:
         out = self._invoke(spec, func, args, kwargs)
         if kill_event.is_set():
             raise WorkerCrashedError("worker killed during execution")
-        n = spec.options.num_returns
-        if n == 1:
-            return [out]
-        if out is None and n == 0:
-            return []
-        if not isinstance(out, tuple) or len(out) != n:
-            raise ValueError(f"task {spec.name} declared num_returns={n} but "
-                             f"returned {type(out).__name__}")
-        return list(out)
+        return self._shape_returns(spec, out)
 
     def _invoke(self, spec: TaskSpec, func, args, kwargs):
         """Route execution: stateless CPU-only tasks go to the worker-process
@@ -486,6 +566,10 @@ class NodeAgent:
         are exempt by contract (a child importing jax races the parent for
         the TPU client), and high-concurrency actors (serve replicas, trial
         runners — streaming returns, shared batchers) stay in-process."""
+        if _is_async_actor(spec.func):
+            # the event loop and its coroutines cannot cross an
+            # ActorProcess boundary; async actors are in-process by mode
+            return False
         if spec.options.in_process is not None:
             return not spec.options.in_process
         return (
@@ -546,7 +630,13 @@ class NodeAgent:
             self._running[spec.task_id] = kill_event
         try:
             args, kwargs = self._materialize_args(spec)
-            runner = _ActorRunner(spec.actor_id, spec.options.max_concurrency)
+            if _is_async_actor(spec.func):
+                runner = _AsyncActorRunner(
+                    spec.actor_id, spec.options.max_concurrency)
+                run_one = self._run_actor_task_async
+            else:
+                runner = _ActorRunner(spec.actor_id, spec.options.max_concurrency)
+                run_one = self._run_actor_task
             runner.instance, runner.process = self._build_actor_instance(
                 spec, args, kwargs
             )
@@ -556,7 +646,7 @@ class NodeAgent:
                 if runner.process is not None:
                     runner.process.terminate()
                 raise WorkerCrashedError("node died during actor creation")
-            runner.start(self._run_actor_task)
+            runner.start(run_one)
             with self._lock:
                 self._actors[spec.actor_id] = runner
             self._seal_returns(spec, [None])
@@ -583,10 +673,12 @@ class NodeAgent:
             return
         # actor tasks do not re-acquire the actor's placement resources
         self._pending_actor_dones[spec.task_id] = done
+        runner.pending_ids.add(spec.task_id)
         runner.mailbox.put((spec, lambda: None))
 
     def _run_actor_task(self, runner: _ActorRunner, spec: TaskSpec, release: Callable[[], None]) -> None:
         done = self._pending_actor_dones.pop(spec.task_id, None)
+        runner.pending_ids.discard(spec.task_id)
         if done is None:
             return
         if runner.dead:
@@ -617,6 +709,72 @@ class NodeAgent:
             with self._lock:
                 self._running.pop(spec.task_id, None)
 
+    @staticmethod
+    def _shape_returns(spec: TaskSpec, out: Any) -> List[Any]:
+        """num_returns shaping shared by the thread and event-loop lanes."""
+        n = spec.options.num_returns
+        if n == 1:
+            return [out]
+        if out is None and n == 0:
+            return []
+        if not isinstance(out, tuple) or len(out) != n:
+            raise ValueError(f"task {spec.name} declared num_returns={n} but "
+                             f"returned {type(out).__name__}")
+        return list(out)
+
+    async def _run_actor_task_async(self, runner: "_AsyncActorRunner",
+                                    spec: TaskSpec) -> None:
+        """Async-actor variant of _run_actor_task: the method's coroutine is
+        awaited on the actor's event loop, so overlapping requests
+        interleave at their await points. Arg materialization and return
+        sealing (pickling) run in a thread — a large payload must not
+        freeze every other in-flight request on the loop. Cancellation
+        (actor kill) surfaces as an actor-death error, never a hang."""
+        import asyncio
+        import inspect
+
+        done = self._pending_actor_dones.pop(spec.task_id, None)
+        runner.pending_ids.discard(spec.task_id)
+        if done is None:
+            return
+        if runner.dead:
+            done(TaskResult(spec.task_id, ok=False,
+                            error=WorkerCrashedError(f"actor is dead: {runner.death_cause}")))
+            return
+        kill_event = threading.Event()
+        with self._lock:
+            self._running[spec.task_id] = kill_event
+        try:
+            args, kwargs = await asyncio.to_thread(self._materialize_args, spec)
+            func = getattr(runner.instance, spec.method_name)
+            out = func(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if kill_event.is_set():
+                raise WorkerCrashedError("worker killed during execution")
+            values = self._shape_returns(spec, out)
+            await asyncio.to_thread(self._seal_returns, spec, values)
+            _tasks_counter.inc(tags={"outcome": "ok"})
+            done(TaskResult(spec.task_id, ok=True, values=values))
+        except asyncio.CancelledError:
+            _tasks_counter.inc(tags={"outcome": "crashed"})
+            done(TaskResult(spec.task_id, ok=False,
+                            error=WorkerCrashedError(
+                                f"actor stopped: {runner.death_cause}")))
+        except (WorkerCrashedError, ActorProcessCrash) as e:
+            runner.dead = True
+            runner.death_cause = e
+            _tasks_counter.inc(tags={"outcome": "crashed"})
+            done(TaskResult(spec.task_id, ok=False,
+                            error=WorkerCrashedError(str(e))))
+        except BaseException as e:  # noqa: BLE001
+            _tasks_counter.inc(tags={"outcome": "error"})
+            done(TaskResult(spec.task_id, ok=False, error=e,
+                            is_application_error=True))
+        finally:
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+
     def submit_direct(self, actor_id: ActorID, fn: Callable[[Any], None]) -> None:
         """Enqueue fn(instance) on the actor's mailbox (compiled-graph path).
         Raises if the actor is not alive here."""
@@ -640,7 +798,20 @@ class NodeAgent:
             self.resources.release(runner.held_resources)
             runner.held_resources = {}
             self._sync_load()
+        self._sweep_actor_pending(runner)
         return True
+
+    def _sweep_actor_pending(self, runner: _ActorRunner) -> None:
+        """Fail any task whose done callback is still registered for a
+        stopped runner — a callback a dead lane will never claim (e.g. a
+        coroutine cancelled before its first step) must not hang its
+        caller."""
+        for task_id in list(runner.pending_ids):
+            runner.pending_ids.discard(task_id)
+            done = self._pending_actor_dones.pop(task_id, None)
+            if done is not None:
+                done(TaskResult(task_id, ok=False, error=WorkerCrashedError(
+                    f"actor is dead: {runner.death_cause}")))
 
     def has_actor(self, actor_id: ActorID) -> bool:
         with self._lock:

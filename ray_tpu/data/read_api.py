@@ -119,6 +119,14 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
             import pyarrow.parquet as pq
 
             pf = pq.ParquetFile(f)
+            if pf.metadata.num_row_groups == 0:
+                # empty file: one empty block so the schema survives
+                table = pf.schema_arrow.empty_table()
+                yield {
+                    c: table.column(c).to_numpy(zero_copy_only=False)
+                    for c in table.column_names
+                }
+                return
             for rg in builtins.range(pf.num_row_groups):
                 table = pf.read_row_group(rg, columns=columns)
                 yield {

@@ -21,6 +21,7 @@ class ServeReplica:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        self._sem = None  # asyncio.Semaphore, created on the actor's loop
         import inspect
 
         if inspect.isclass(cls_or_fn):
@@ -30,20 +31,39 @@ class ServeReplica:
             self._callable = cls_or_fn
             self._is_function = True
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       multiplexed_model_id: str = "") -> Any:
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             multiplexed_model_id: str = "") -> Any:
+        """Async-actor entry (reference: serve replicas run on the async
+        actor event loop): async user handlers are awaited — overlapping
+        requests interleave at their awaits on ONE replica — and sync
+        handlers run in a thread (asyncio.to_thread propagates the
+        multiplex contextvar) so they can't stall the loop."""
+        import asyncio
+        import inspect
+
         from .multiplex import _current_model_id
 
+        if self._sem is None:
+            # lazily bound to the replica's event loop
+            self._sem = asyncio.Semaphore(max(1, self.max_ongoing_requests))
         with self._lock:
+            # counts queued + executing: the autoscaler's load signal must
+            # see pressure beyond max_ongoing, not just what's running
             self._ongoing += 1
             self._total += 1
         token = _current_model_id.set(multiplexed_model_id)
         try:
-            if self._is_function:
-                target = self._callable
-            else:
-                target = getattr(self._callable, method or "__call__")
-            return target(*args, **(kwargs or {}))
+            # max_ongoing_requests is the CONCURRENCY contract: excess
+            # requests queue here (visible in queue_len) instead of fanning
+            # out unboundedly into handler threads
+            async with self._sem:
+                if self._is_function:
+                    target = self._callable
+                else:
+                    target = getattr(self._callable, method or "__call__")
+                if inspect.iscoroutinefunction(target):
+                    return await target(*args, **(kwargs or {}))
+                return await asyncio.to_thread(target, *args, **(kwargs or {}))
         finally:
             _current_model_id.reset(token)
             with self._lock:

@@ -1,0 +1,147 @@
+"""Async actors: asyncio event-loop execution (reference: async actor
+event loop in `core_worker.cc` / `actor.py`; VERDICT r3 #7).
+
+What runs for real: an actor with async methods executes them as
+coroutines on one event loop; max_concurrency bounds concurrent awaits,
+so overlapping slow calls on ONE actor interleave instead of queueing;
+serve replicas ride the same machinery (one replica absorbs two
+overlapping slow requests)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+class TestAsyncActors:
+    def test_overlapping_awaits_interleave(self, ray_start_regular):
+        @ray_tpu.remote(max_concurrency=4)
+        class Sleeper:
+            async def nap(self, s):
+                import asyncio
+
+                t0 = time.monotonic()
+                await asyncio.sleep(s)
+                return time.monotonic() - t0
+
+        a = Sleeper.remote()
+        t0 = time.monotonic()
+        refs = [a.nap.remote(0.5) for _ in range(4)]
+        out = ray_tpu.get(refs, timeout=30)
+        wall = time.monotonic() - t0
+        assert all(0.45 < d < 2.0 for d in out)
+        # four 0.5s naps on ONE actor: concurrent -> ~0.5s, serial -> 2s
+        assert wall < 1.5, wall
+
+    def test_state_is_shared_across_interleaved_calls(self, ray_start_regular):
+        @ray_tpu.remote(max_concurrency=2)
+        class Accum:
+            def __init__(self):
+                self.log = []
+
+            async def slow_add(self, x):
+                import asyncio
+
+                self.log.append(("start", x))
+                await asyncio.sleep(0.3)
+                self.log.append(("end", x))
+                return x
+
+            async def peek(self):
+                return list(self.log)
+
+        a = Accum.remote()
+        r1 = a.slow_add.remote(1)
+        r2 = a.slow_add.remote(2)
+        assert sorted(ray_tpu.get([r1, r2], timeout=30)) == [1, 2]
+        log = ray_tpu.get(a.peek.remote(), timeout=30)
+        # both started before either ended = true interleaving on one loop
+        starts = [e for e in log[:2] if e[0] == "start"]
+        assert len(starts) == 2, log
+
+    def test_sync_methods_work_on_async_actor(self, ray_start_regular):
+        @ray_tpu.remote
+        class Mixed:
+            def __init__(self):
+                self.v = 0
+
+            def bump(self):
+                self.v += 1
+                return self.v
+
+            async def abump(self):
+                self.v += 10
+                return self.v
+
+        m = Mixed.remote()
+        assert ray_tpu.get(m.bump.remote(), timeout=30) == 1
+        assert ray_tpu.get(m.abump.remote(), timeout=30) == 11
+        assert ray_tpu.get(m.bump.remote(), timeout=30) == 12
+
+    def test_async_actor_error_propagates(self, ray_start_regular):
+        @ray_tpu.remote
+        class Boom:
+            async def go(self):
+                raise ValueError("async kaboom")
+
+        b = Boom.remote()
+        with pytest.raises(ray_tpu.RayTaskError) as ei:
+            ray_tpu.get(b.go.remote(), timeout=30)
+        assert isinstance(ei.value.cause, ValueError)
+
+    def test_kill_async_actor(self, ray_start_regular):
+        @ray_tpu.remote
+        class K:
+            async def ping(self):
+                return "pong"
+
+        k = K.remote()
+        assert ray_tpu.get(k.ping.remote(), timeout=30) == "pong"
+        ray_tpu.kill(k)
+        with pytest.raises(ray_tpu.RayActorError):
+            ray_tpu.get(k.ping.remote(), timeout=30)
+
+
+class TestServeAsyncReplica:
+    def test_one_replica_overlaps_slow_sync_requests(self, ray_start_regular):
+        """VERDICT r3 #7 done-criterion: a single replica handles two
+        overlapping slow requests concurrently (sync handler runs in a
+        thread off the replica's event loop)."""
+        from ray_tpu import serve
+
+        @serve.deployment(max_ongoing_requests=4)
+        def slow(req):
+            time.sleep(0.6)
+            return {"ok": True}
+
+        try:
+            serve.run(slow.bind(), name="slowapp", route_prefix="/slowapp")
+            handle = serve.get_deployment_handle("slow")
+            t0 = time.monotonic()
+            futs = [handle.remote({"i": i}) for i in range(2)]
+            out = [f.result(timeout=30) for f in futs]
+            wall = time.monotonic() - t0
+            assert all(o == {"ok": True} for o in out)
+            assert wall < 1.1, f"requests serialized: {wall:.2f}s"
+        finally:
+            serve.shutdown()
+
+    def test_async_deployment_handler(self, ray_start_regular):
+        from ray_tpu import serve
+
+        @serve.deployment
+        class AsyncApp:
+            async def __call__(self, req):
+                import asyncio
+
+                await asyncio.sleep(0.1)
+                return {"echo": req.get("x")}
+
+        try:
+            serve.run(AsyncApp.bind(), name="aapp", route_prefix="/aapp")
+            handle = serve.get_deployment_handle("AsyncApp")
+            assert handle.remote({"x": 7}).result(timeout=30) == {"echo": 7}
+        finally:
+            serve.shutdown()
