@@ -83,6 +83,126 @@ class FakeNodeProvider(NodeProvider):
         return dict(self._types)
 
 
+class SubprocessNodeProvider(NodeProvider):
+    """Provisions REAL worker runtimes: each node is an OS process that
+    joins the head over the cross-host execution plane (core/cross_host.py,
+    `init(address=...)`) and executes dispatched tasks/actors.
+
+    This is the executable shape of the reference's provider matrix
+    (`autoscaler/_private/node_provider.py` implementations): swap the
+    subprocess spawn for a cloud API call and the rest of the loop is
+    unchanged. Demand-driven scale-up launches a joiner; idle scale-down
+    stops it through the head's dispatch channel (worker exits cleanly).
+    """
+
+    def __init__(self, runtime=None, extra_env: Optional[Dict[str, str]] = None):
+        from . import api
+
+        self.runtime = runtime or api._auto_init()
+        cp_server = getattr(self.runtime, "_cp_server", None)
+        if cp_server is None:
+            raise RuntimeError(
+                "SubprocessNodeProvider needs a joinable head: init with "
+                "system_config={'control_plane_rpc_port': 0}"
+            )
+        self.head_address = cp_server.address
+        self.extra_env = dict(extra_env or {})
+        self._procs: Dict[str, Any] = {}  # provider id -> Popen
+        self._types: Dict[str, str] = {}
+        self._nodes: Dict[str, Any] = {}  # provider id -> NodeID (lazy)
+        self._counter = 0
+
+    def create_nodes(self, node_type: NodeType, count: int) -> List[str]:
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        out = []
+        for _ in range(count):
+            for _h in range(node_type.num_hosts):
+                self._counter += 1
+                pid = f"sub-{node_type.name}-{self._counter}"
+                code = textwrap.dedent(f"""
+                    from ray_tpu.core.cross_host import join_cluster
+                    w = join_cluster(
+                        {self.head_address!r},
+                        num_cpus={node_type.resources.get("CPU", 1.0)},
+                        num_tpus={node_type.resources.get("TPU", 0.0)},
+                        resources={ {k: v for k, v in node_type.resources.items()
+                                     if k not in ("CPU", "TPU")} !r},
+                        labels={{"provider_node_id": {pid!r}}},
+                    )
+                    w.wait()
+                """)
+                env = dict(os.environ)
+                env.setdefault("JAX_PLATFORMS", "cpu")
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+                env.update(self.extra_env)
+                proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+                self._procs[pid] = proc
+                self._types[pid] = node_type.name
+                out.append(pid)
+                logger.info("provisioned worker %s (pid %d) joining %s",
+                            pid, proc.pid, self.head_address)
+        return out
+
+    def _resolve_node_id(self, pid: str):
+        nid = self._nodes.get(pid)
+        if nid is not None:
+            return nid
+        for node in self.runtime.control_plane.alive_nodes():
+            if node.labels.get("provider_node_id") == pid:
+                self._nodes[pid] = node.node_id
+                return node.node_id
+        return None
+
+    def terminate_node(self, node_id: str) -> None:
+        nid = self._nodes.get(node_id) or self._resolve_node_id(node_id)
+        proc = self._procs.pop(node_id, None)
+        self._types.pop(node_id, None)
+        self._nodes.pop(node_id, None)
+        graceful = nid is not None and nid in self.runtime.agents
+        if graceful:
+            # remove_node stops the proxy, which tells the worker to exit
+            self.runtime.remove_node(nid)
+        if proc is not None:
+            try:
+                # short grace only when the worker was actually told to
+                # exit; a not-yet-joined worker has nothing to hear
+                proc.wait(timeout=5 if graceful else 0.1)
+            except Exception:  # noqa: BLE001 — escalate
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001 — last resort, and reap
+                    proc.kill()
+                    proc.wait(timeout=5)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        # reap silently-died joiners so the scaler re-launches capacity
+        for pid, proc in list(self._procs.items()):
+            if proc.poll() is not None:
+                logger.warning("provisioned worker %s exited rc=%s",
+                               pid, proc.returncode)
+                self._procs.pop(pid, None)
+                self._types.pop(pid, None)
+                self._nodes.pop(pid, None)
+        # refresh the NodeID mapping (used by idle scale-down) from ONE
+        # alive-nodes snapshot rather than one scan per unresolved pid
+        unresolved = [p for p in self._types if p not in self._nodes]
+        if unresolved:
+            by_label = {
+                n.labels.get("provider_node_id"): n.node_id
+                for n in self.runtime.control_plane.alive_nodes()
+            }
+            for pid in unresolved:
+                nid = by_label.get(pid)
+                if nid is not None:
+                    self._nodes[pid] = nid
+        return dict(self._types)
+
+
 class Autoscaler:
     """Reconciles pending resource demand against provisioned capacity.
 
@@ -109,6 +229,12 @@ class Autoscaler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._idle_since: Dict[str, float] = {}
+        # capacity launched but not yet joined: absorbs repeat demand so a
+        # slow-joining node (SubprocessNodeProvider: seconds) isn't
+        # re-launched every tick. Entries expire after launch_grace_s —
+        # a joiner that never arrives is eventually retried.
+        self.launch_grace_s = 30.0
+        self._launching: List[tuple] = []  # (monotonic_ts, remaining_cap)
 
     # -- demand → decisions --------------------------------------------------
 
@@ -129,7 +255,40 @@ class Autoscaler:
         launched: Dict[str, int] = {}
         demands = [d for d in self.pending_demand() if not self._cluster_can_fit(d)]
         by_type = self.provider.non_terminated_nodes()
+        # In-flight launch capacity absorbs repeat demand (bin-packing-
+        # lite, the reference's resource_demand_scheduler shape): a
+        # 2-member gang provisions ONE fitting node, and a node still
+        # JOINING (async providers) isn't re-launched every tick. A fresh
+        # copy of each unexpired cap is spent per pass — the same pending
+        # demand re-absorbs into it next tick instead of draining it.
+        now = time.monotonic()
+        alive_ids = {n.node_id for n in self.runtime.control_plane.alive_nodes()}
+        # retire a launch entry as soon as SOME node that wasn't alive at
+        # launch time joins (one join clears one entry, oldest first);
+        # grace expiry covers joiners that die before registering
+        assigned: set = set()
+        kept = []
+        for ts, cap, known in sorted(self._launching, key=lambda e: e[0]):
+            new = alive_ids - known - assigned
+            if new:
+                assigned.add(next(iter(new)))
+                continue
+            if now - ts < self.launch_grace_s:
+                kept.append((ts, cap, known))
+        self._launching = kept
+        pending_caps: List[Dict[str, float]] = [
+            dict(cap) for _ts, cap, _known in self._launching
+        ]
         for demand in demands:
+            absorbed = False
+            for cap in pending_caps:
+                if self._fits(demand, cap):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    absorbed = True
+                    break
+            if absorbed:
+                continue
             for t in self.node_types.values():
                 existing = sum(1 for v in by_type.values() if v == t.name)
                 if existing >= t.max_workers:
@@ -138,6 +297,11 @@ class Autoscaler:
                     self.provider.create_nodes(t, 1)
                     launched[t.name] = launched.get(t.name, 0) + 1
                     by_type[f"_pending{len(by_type)}"] = t.name
+                    cap = dict(t.resources)
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    pending_caps.append(cap)
+                    self._launching.append((now, dict(t.resources), set(alive_ids)))
                     break
         self._scale_down()
         return launched

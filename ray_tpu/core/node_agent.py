@@ -664,16 +664,22 @@ class NodeAgent:
                 self._running.pop(spec.task_id, None)
 
     def _submit_actor_task(self, spec: TaskSpec, done: DoneCallback) -> None:
+        # dead-check and registration are ONE critical section against
+        # kill_actor's sweep: checking dead outside it would let a kill
+        # land between the check and the registration, leaving a done
+        # callback nothing will ever claim (caller hangs)
         with self._lock:
             runner = self._actors.get(spec.actor_id)
-        if runner is None or runner.dead:
+            dead = runner is None or runner.dead
+            if not dead:
+                # actor tasks do not re-acquire placement resources
+                self._pending_actor_dones[spec.task_id] = done
+                runner.pending_ids.add(spec.task_id)
+        if dead:
             cause = runner.death_cause if runner else None
             done(TaskResult(spec.task_id, ok=False,
                             error=WorkerCrashedError(f"actor is dead: {cause}")))
             return
-        # actor tasks do not re-acquire the actor's placement resources
-        self._pending_actor_dones[spec.task_id] = done
-        runner.pending_ids.add(spec.task_id)
         runner.mailbox.put((spec, lambda: None))
 
     def _run_actor_task(self, runner: _ActorRunner, spec: TaskSpec, release: Callable[[], None]) -> None:
@@ -787,10 +793,13 @@ class NodeAgent:
     def kill_actor(self, actor_id: ActorID, cause: str = "killed") -> bool:
         with self._lock:
             runner = self._actors.get(actor_id)
-        if runner is None:
-            return False
-        runner.dead = True
-        runner.death_cause = WorkerCrashedError(cause)
+            if runner is None:
+                return False
+            # dead flips INSIDE the lock: paired with _submit_actor_task's
+            # locked check-and-register, so no registration can slip
+            # between this and the sweep below
+            runner.dead = True
+            runner.death_cause = WorkerCrashedError(cause)
         runner.stop()
         if runner.process is not None:
             runner.process.terminate()
@@ -805,13 +814,18 @@ class NodeAgent:
         """Fail any task whose done callback is still registered for a
         stopped runner — a callback a dead lane will never claim (e.g. a
         coroutine cancelled before its first step) must not hang its
-        caller."""
-        for task_id in list(runner.pending_ids):
-            runner.pending_ids.discard(task_id)
-            done = self._pending_actor_dones.pop(task_id, None)
-            if done is not None:
-                done(TaskResult(task_id, ok=False, error=WorkerCrashedError(
-                    f"actor is dead: {runner.death_cause}")))
+        caller. Callbacks collected under the lock, invoked outside it
+        (done callbacks re-enter the agent, e.g. kill on creation)."""
+        to_fail = []
+        with self._lock:
+            for task_id in list(runner.pending_ids):
+                runner.pending_ids.discard(task_id)
+                done = self._pending_actor_dones.pop(task_id, None)
+                if done is not None:
+                    to_fail.append((task_id, done))
+        for task_id, done in to_fail:
+            done(TaskResult(task_id, ok=False, error=WorkerCrashedError(
+                f"actor is dead: {runner.death_cause}")))
 
     def has_actor(self, actor_id: ActorID) -> bool:
         with self._lock:

@@ -121,10 +121,12 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
             pf = pq.ParquetFile(f)
             if pf.metadata.num_row_groups == 0:
                 # empty file: one empty block so the schema survives
+                # (same column selection as the row-group path)
                 table = pf.schema_arrow.empty_table()
+                selected = columns if columns is not None else table.column_names
                 yield {
                     c: table.column(c).to_numpy(zero_copy_only=False)
-                    for c in table.column_names
+                    for c in selected
                 }
                 return
             for rg in builtins.range(pf.num_row_groups):
