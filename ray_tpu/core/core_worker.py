@@ -318,7 +318,38 @@ class Runtime:
             return self.agents[self.head_node_id]
 
     # ------------------------------------------------------------ submission
+    def _prepare_runtime_env(self, spec: TaskSpec) -> None:
+        """Ship working_dir through the control-plane KV at submission, so
+        the spec carries a content-addressed uri any executing node — a
+        joined host included — can resolve (runtime_env.package_working_dir
+        / resolve; reference: GCS package upload in working_dir.py)."""
+        renv = spec.options.runtime_env
+        if not renv or not renv.get("working_dir"):
+            return
+        import dataclasses
+
+        from . import runtime_env
+
+        wd = renv["working_dir"]
+        cache = getattr(self, "_wd_uri_cache", None)
+        if cache is None:
+            cache = self._wd_uri_cache = {}
+        uri = cache.get(wd)
+        if uri is not None:
+            # once per distinct dir, not per task: content-addressed uri
+            # reused (snapshot-at-first-submission semantics, like the
+            # reference's once-per-job package upload)
+            packaged = dict(renv)
+            packaged.pop("working_dir")
+            packaged["working_dir_uri"] = uri
+        else:
+            packaged = runtime_env.package_working_dir(renv, self.control_plane)
+            cache[wd] = packaged["working_dir_uri"]
+        # replace, never mutate: options objects are shared across calls
+        spec.options = dataclasses.replace(spec.options, runtime_env=packaged)
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._prepare_runtime_env(spec)
         refs = [ObjectRef(oid, self) for oid in spec.return_ids]
         retries = (
             spec.options.max_retries
@@ -350,6 +381,7 @@ class Runtime:
         read-task resilience); once any item has sealed, a partial stream
         cannot replay and the failure surfaces after the yielded prefix.
         No lineage reconstruction for streamed objects."""
+        self._prepare_runtime_env(spec)
         record = _StreamRecord()
 
         def on_item(index: int, oid: ObjectID) -> None:
@@ -395,6 +427,7 @@ class Runtime:
             actor_id=actor_id,
             dependencies=_collect_deps(args, kwargs),
         )
+        self._prepare_runtime_env(spec)
         info = ActorInfo(
             actor_id=actor_id,
             name=options.name,

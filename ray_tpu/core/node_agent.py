@@ -464,9 +464,12 @@ class NodeAgent:
         model); device tasks and actors stay on threads in the device-owning
         process (node_agent docstring). Tasks that can't cross the process
         boundary (unpicklable closures) fall back to in-process execution."""
-        from .runtime_env import validate
+        from .runtime_env import resolve, validate
 
         renv = validate(spec.options.runtime_env)
+        # kv:// working_dir (shipped by a possibly-remote driver) becomes a
+        # local cached extraction before the worker sees it
+        renv = resolve(renv, self._cp)
         if (
             spec.kind is TaskKind.NORMAL
             and config.worker_processes > 0
@@ -587,13 +590,14 @@ class NodeAgent:
                 ActorProcess,
                 _InstanceProxy,
             )
-            from .runtime_env import validate
+            from .runtime_env import resolve, validate
 
             try:
                 proc = ActorProcess(
                     spec.func, args, kwargs,
                     max_concurrency=spec.options.max_concurrency,
-                    runtime_env=validate(spec.options.runtime_env),
+                    runtime_env=resolve(
+                        validate(spec.options.runtime_env), self._cp),
                 )
                 _actors_isolated_counter.inc(tags={"mode": "process"})
                 return _InstanceProxy(

@@ -250,3 +250,48 @@ class TestCrossHostStreaming:
         assert not gen.completed()  # producer still running after item 0
         rest = [ray_tpu.get(r, timeout=60)["i"] for r in gen]
         assert rest == [1, 2]
+
+
+class TestCrossHostRuntimeEnv:
+    def test_working_dir_ships_to_joined_host(self, tmp_path):
+        """VERDICT r3 #6 done-criterion: a task runs on the 'remote'
+        runtime with a working_dir it fetched from the control-plane KV —
+        the joined host never saw the driver's filesystem path."""
+        rt = ray_tpu.init(
+            num_cpus=1, num_tpus=0,
+            system_config={"control_plane_rpc_port": 0, "worker_processes": 0},
+        )
+        env = _worker_env()
+        env["RAY_TPU_WORKER_PROCESSES"] = "1"  # renv needs a pool worker
+        env["RAY_TPU_ENV_CACHE"] = str(tmp_path / "worker_cache")
+        code = textwrap.dedent(f"""
+            import ray_tpu
+            w = ray_tpu.init(address={rt._cp_server.address!r}, num_cpus=4,
+                             num_tpus=0, resources={{"magic": 1.0}})
+            w.wait(timeout=300)
+        """)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            _wait_nodes(rt, 2)
+            wd = tmp_path / "proj"
+            wd.mkdir()
+            (wd / "payload.txt").write_text("came over the KV")
+
+            @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1},
+                            runtime_env={"working_dir": str(wd)})
+            def read():
+                import os
+
+                return os.getpid(), open("payload.txt").read()
+
+            pid, content = ray_tpu.get(read.remote(), timeout=120)
+            assert content == "came over the KV"
+            assert pid != __import__("os").getpid()  # ran off-driver
+        finally:
+            ray_tpu.shutdown()
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()

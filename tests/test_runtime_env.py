@@ -51,7 +51,7 @@ class TestRuntimeEnv:
         assert ray_tpu.get(use.remote()) == (42, "payload")
 
     def test_unknown_key_rejected(self, rt):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"deps": ["x"]}})
         def f():
             return 1
 
@@ -79,3 +79,88 @@ class TestRuntimeEnv:
                 ray_tpu.get(dev.remote())
         finally:
             ray_tpu.shutdown()
+
+
+def _write_wheel(path, name="streamlet", version="0.9"):
+    """Minimal pure-python wheel, built by hand so the test needs no
+    network (zero-egress box): pip installs wheels without any build."""
+    import zipfile
+
+    dist = f"{name}-{version}.dist-info"
+    whl = os.path.join(str(path), f"{name}-{version}-py3-none-any.whl")
+    record = f"{name}/__init__.py,,\n{dist}/METADATA,,\n{dist}/WHEEL,,\n{dist}/RECORD,,\n"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", "MAGIC = 777\n")
+        zf.writestr(f"{dist}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n")
+        zf.writestr(f"{dist}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+                    "Tag: py3-none-any\n")
+        zf.writestr(f"{dist}/RECORD", record)
+    return whl
+
+
+class TestPipEnv:
+    def test_pinned_wheel_in_pool_worker_without_driver_env(self, rt, tmp_path,
+                                                            monkeypatch):
+        """VERDICT r3 #6 done-criterion: install a pinned wheel in a pool
+        worker; the driver process never sees the package."""
+        monkeypatch.setenv("RAY_TPU_ENV_CACHE", str(tmp_path / "cache"))
+        whl = _write_wheel(tmp_path)
+
+        @ray_tpu.remote(runtime_env={"pip": [whl]})
+        def use():
+            import streamlet
+
+            return streamlet.MAGIC
+
+        assert ray_tpu.get(use.remote(), timeout=120) == 777
+        with pytest.raises(ImportError):
+            import streamlet  # noqa: F401 — must NOT leak into the driver
+
+        # cached: second task reuses the installed env (fast path)
+        assert ray_tpu.get(use.remote(), timeout=60) == 777
+
+    def test_env_restored_between_tasks(self, rt, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_ENV_CACHE", str(tmp_path / "cache"))
+        whl = _write_wheel(tmp_path, name="otherlet", version="1.0")
+
+        @ray_tpu.remote(runtime_env={"pip": [whl]})
+        def with_env():
+            import otherlet
+
+            return otherlet.MAGIC
+
+        @ray_tpu.remote
+        def without_env():
+            try:
+                import otherlet  # noqa: F401
+
+                return "leaked"
+            except ImportError:
+                return "clean"
+
+        assert ray_tpu.get(with_env.remote(), timeout=120) == 777
+        assert ray_tpu.get(without_env.remote(), timeout=60) == "clean"
+
+
+class TestWorkingDirShipping:
+    def test_working_dir_travels_through_kv(self, rt, tmp_path, monkeypatch):
+        """The spec carries a kv:// uri, not a filesystem path: the
+        executing node extracts from the control-plane KV (the cross-host
+        code-shipping path, exercised here against the same machinery)."""
+        monkeypatch.setenv("RAY_TPU_ENV_CACHE", str(tmp_path / "cache"))
+        wd = tmp_path / "proj"
+        (wd / "sub").mkdir(parents=True)
+        (wd / "config.txt").write_text("shipped")
+        (wd / "sub" / "n.txt").write_text("nested")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+        def read():
+            return open("config.txt").read(), open("sub/n.txt").read()
+
+        ref = read.remote()
+        # the KV now holds the package (content-addressed)
+        keys = rt.control_plane.kv_keys("runtime_env/pkg/")
+        assert keys, "working_dir was not uploaded to the control-plane KV"
+        assert ray_tpu.get(ref, timeout=60) == ("shipped", "nested")
