@@ -201,6 +201,12 @@ class MemoryObjectStore:
         for cb in callbacks:
             cb()
 
+    def nbytes_of(self, object_id: ObjectID):
+        """Size of a resident object, or None (backpressure accounting)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry.nbytes if entry is not None else None
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             return object_id in self._entries

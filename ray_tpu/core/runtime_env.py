@@ -206,6 +206,7 @@ def applied(renv: Optional[Dict[str, Any]]):
         saved_env[k] = os.environ.get(k)
         os.environ[k] = str(v)
     added_paths = []
+    modules_before = set(sys.modules)
     if pip_dir is not None:
         sys.path.insert(0, pip_dir)
         added_paths.append(pip_dir)
@@ -222,6 +223,13 @@ def applied(renv: Optional[Dict[str, Any]]):
     try:
         yield
     finally:
+        # purge modules imported FROM the env's paths: a cached
+        # sys.modules entry would leak the package (or a stale pinned
+        # version) into the next task on this serially-reused worker
+        for name in set(sys.modules) - modules_before:
+            mod_file = getattr(sys.modules.get(name), "__file__", None) or ""
+            if any(mod_file.startswith(p + os.sep) for p in added_paths):
+                sys.modules.pop(name, None)
         for p in added_paths:
             try:
                 sys.path.remove(p)

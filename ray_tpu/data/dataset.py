@@ -48,10 +48,28 @@ class Dataset:
         batch_size: Optional[int] = None,
         batch_format: str = "numpy",
         fn_kwargs: Optional[dict] = None,
+        compute: Optional[str] = None,
+        concurrency: int = 2,
         **_ignored,
     ) -> "Dataset":
+        """compute="actors": the transform runs on a pool of `concurrency`
+        stateful workers; a callable CLASS fn is instantiated once per
+        worker (per-actor state, e.g. a loaded model — reference:
+        ActorPoolMapOperator). Default "tasks" runs stateless."""
+        import inspect
+
+        if compute is None:
+            compute = "actors" if inspect.isclass(fn) else "tasks"
+        if compute not in ("tasks", "actors"):
+            raise ValueError(
+                f"compute must be 'tasks' or 'actors', got {compute!r}")
+        if inspect.isclass(fn) and compute != "actors":
+            raise ValueError(
+                "a callable-class fn needs map_batches(compute='actors')")
         return Dataset(self._plan.with_op(
-            MapBatches("map_batches", fn, batch_size, batch_format, fn_kwargs or {})
+            MapBatches("map_batches", fn, batch_size, batch_format,
+                       fn_kwargs or {}, compute=compute,
+                       concurrency=concurrency)
         ))
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":

@@ -24,6 +24,7 @@ from .logical import (
     InputData,
     Limit,
     LogicalPlan,
+    MapBatches,
     RandomShuffle,
     Read,
     Repartition,
@@ -36,6 +37,61 @@ from .logical import (
 logger = get_logger("data.executor")
 
 DEFAULT_MAX_IN_FLIGHT = 16
+# byte budget for READY-but-unconsumed blocks per streaming stage: a slow
+# consumer halts upstream submission once this much output is parked
+# (reference: execution/resource_manager.py per-op memory backpressure)
+DEFAULT_MAX_IN_FLIGHT_BYTES = 256 << 20
+
+
+def _ready_info(refs: List[Any]):
+    """-> (ready_bytes, n_ready): size and count of completed-but-
+    unconsumed results among `refs` (block metadata from the object
+    plane)."""
+    if not refs:
+        return 0, 0
+    from ..core import core_worker as _cw
+
+    try:
+        rt = _cw.get_runtime()
+    except RuntimeError:
+        return 0, 0
+    done, _ = api.wait(list(refs), num_returns=len(refs), timeout=0)
+    total = 0
+    for ref in done:
+        for nid in rt.directory.locations(ref.object_id):
+            agent = rt.agents.get(nid)
+            store = getattr(agent, "store", None)
+            n = store.nbytes_of(ref.object_id) if hasattr(store, "nbytes_of") else None
+            if n is not None:
+                total += n
+                break
+    return total, len(done)
+
+
+class _ByteBudget:
+    """Per-stage memory gate (reference: resource_manager.py per-op
+    budgets): admits a new submission only while parked output bytes plus
+    the PROJECTED bytes of still-running tasks (running average of
+    completed output sizes) stay under the budget. Before any output size
+    is known, the in-flight warmup is capped so the first burst can't
+    blow the budget either."""
+
+    WARMUP_INFLIGHT = 4
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self._avg = None
+
+    def may_submit(self, pending: List[Any]) -> bool:
+        ready_bytes, n_ready = _ready_info(pending)
+        inflight = len(pending) - n_ready
+        if n_ready:
+            # always refresh from what is parked NOW: a frozen early
+            # average (small header blocks) would under-project forever
+            self._avg = ready_bytes / n_ready
+        if self._avg is None:
+            return inflight < self.WARMUP_INFLIGHT
+        return ready_bytes + inflight * self._avg < self.budget
 
 
 @api.remote
@@ -60,6 +116,33 @@ def _run_read_stream(task: Callable[[], Any]):
 @api.remote
 def _run_stage(stage: Callable[[Block], Block], block: Block) -> Block:
     return stage(block)
+
+
+@api.remote(num_cpus=0, in_process=True)
+class _MapPoolWorker:
+    """One stateful worker of an actor-pool map stage: a callable-class
+    fn constructs ONCE here, then transforms every block this worker is
+    assigned (reference: ActorPoolMapOperator's per-actor UDF init)."""
+
+    def __init__(self, op_blob: bytes):
+        import dataclasses
+        import inspect
+
+        import cloudpickle
+
+        from .logical import compile_stage
+
+        op = cloudpickle.loads(op_blob)
+        if inspect.isclass(op.fn):
+            op = dataclasses.replace(op, fn=op.fn())  # per-actor state
+        self._stage = compile_stage([op])
+
+    def apply(self, block: Block) -> Block:
+        return self._stage(block)
+
+    def ping(self) -> bool:
+        """FIFO barrier: completes only after all prior applies."""
+        return True
 
 
 @api.remote
@@ -145,9 +228,11 @@ def _windowed_gen(read_tasks: List[Callable], max_in_flight: int) -> Iterator[An
 class StreamingExecutor:
     """Executes a LogicalPlan, yielding block ObjectRefs."""
 
-    def __init__(self, plan: LogicalPlan, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+    def __init__(self, plan: LogicalPlan, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+                 max_in_flight_bytes: int = DEFAULT_MAX_IN_FLIGHT_BYTES):
         self.plan = plan
         self.max_in_flight = max_in_flight
+        self.max_in_flight_bytes = max_in_flight_bytes
 
     def execute(self) -> Iterator[Any]:
         segments = fuse(self.plan)
@@ -166,13 +251,17 @@ class StreamingExecutor:
         elif isinstance(source, Union):
             def gen_union():
                 for plan in source.plans:
-                    yield from StreamingExecutor(plan, self.max_in_flight).execute()
+                    yield from StreamingExecutor(
+                        plan, self.max_in_flight,
+                        self.max_in_flight_bytes).execute()
             stream = gen_union()
         else:
             raise TypeError(f"bad source {source}")
 
         for seg in segments[1:]:
-            if callable(seg):
+            if isinstance(seg, MapBatches):  # actor-pool compute stage
+                stream = self._map_stream_actors(stream, seg)
+            elif callable(seg):
                 stream = self._map_stream(stream, seg)
             elif isinstance(seg, RandomShuffle):
                 stream = self._shuffle(stream, seg.seed)
@@ -228,11 +317,18 @@ class StreamingExecutor:
 
     def _map_stream(self, upstream: Iterator[Any], stage) -> Iterator[Any]:
         def gen():
+            budget = _ByteBudget(self.max_in_flight_bytes)
             pending: List[Any] = []
             exhausted = False
             it = iter(upstream)
             while not exhausted or pending:
-                while not exhausted and len(pending) < self.max_in_flight:
+                while (
+                    not exhausted
+                    and len(pending) < self.max_in_flight
+                    # memory backpressure: parked + projected in-flight
+                    # output bytes must stay under the stage budget
+                    and budget.may_submit(pending)
+                ):
                     try:
                         ref = next(it)
                     except StopIteration:
@@ -241,6 +337,57 @@ class StreamingExecutor:
                     pending.append(_run_stage.remote(stage, ref))
                 if pending:
                     yield pending.pop(0)
+        return gen()
+
+    def _map_stream_actors(self, upstream: Iterator[Any], op) -> Iterator[Any]:
+        """map_batches(compute="actors"): the stage runs on a pool of
+        stateful workers — a callable-class fn instantiates ONCE per
+        worker (model loads amortize across its blocks). Ordered output;
+        same count + byte backpressure as the task path. (reference:
+        execution/operators/actor_pool_map_operator.py)"""
+        import cloudpickle
+
+        op_blob = cloudpickle.dumps(op)
+
+        def gen():
+            workers = [
+                _MapPoolWorker.remote(op_blob)
+                for _ in range(max(1, op.concurrency))
+            ]
+            budget = _ByteBudget(self.max_in_flight_bytes)
+            try:
+                pending: List[Any] = []
+                exhausted = False
+                it = iter(upstream)
+                i = 0
+                while not exhausted or pending:
+                    while (
+                        not exhausted
+                        and len(pending) < self.max_in_flight
+                        and budget.may_submit(pending)
+                    ):
+                        try:
+                            ref = next(it)
+                        except StopIteration:
+                            exhausted = True
+                            break
+                        worker = workers[i % len(workers)]
+                        i += 1
+                        pending.append(worker.apply.remote(ref))
+                    if pending:
+                        yield pending.pop(0)
+            finally:
+                # FIFO ping barrier: yielded-but-unfinished applies must
+                # complete before their worker dies
+                try:
+                    api.get([w.ping.remote() for w in workers], timeout=300)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                for w in workers:
+                    try:
+                        api.kill(w)
+                    except Exception:  # noqa: BLE001
+                        pass
         return gen()
 
     # -- all-to-all barriers -------------------------------------------------
@@ -299,7 +446,8 @@ class StreamingExecutor:
         the faithful degenerate case for in-memory scale)."""
         left = _concat_blocks.remote(*list(upstream))
         right_refs = list(
-            StreamingExecutor(op.other, self.max_in_flight).execute()
+            StreamingExecutor(op.other, self.max_in_flight,
+                              self.max_in_flight_bytes).execute()
         )
         right = _concat_blocks.remote(*right_refs)
         return iter([_zip_blocks.remote(left, right)])

@@ -42,6 +42,11 @@ class MapBatches(Operator):
     batch_size: Optional[int] = None
     batch_format: str = "numpy"
     fn_kwargs: dict = dataclasses.field(default_factory=dict)
+    # "tasks" (default) or "actors": actor compute runs the stage on a
+    # pool of stateful workers — REQUIRED when fn is a callable class
+    # (instantiated once per actor; reference: ActorPoolMapOperator)
+    compute: str = "tasks"
+    concurrency: int = 2
 
 
 @dataclasses.dataclass
@@ -175,7 +180,8 @@ def fuse(plan: LogicalPlan) -> List[Any]:
     segments: List[Any] = [source]
     run: List[Operator] = []
     for op in plan.operators[1:]:
-        if op.is_one_to_one():
+        needs_actor_stage = isinstance(op, MapBatches) and op.compute == "actors"
+        if op.is_one_to_one() and not needs_actor_stage:
             run.append(op)
         else:
             if run:

@@ -2,11 +2,14 @@
 iteration, splits, file IO, and device prefetch."""
 
 import builtins
+import os
+import time
 
 import numpy as np
 import pytest
 
 from ray_tpu import data
+from ray_tpu import get as ray_get
 from ray_tpu.data.logical import fuse
 
 
@@ -321,3 +324,73 @@ class TestFromPandasArrow:
         blocks = list(ds._stream_refs())
         assert len(blocks) == 4
         assert ds.sum("x") == 45
+
+
+class TestBackpressureAndActorPool:
+    """VERDICT r3 #9: per-op in-flight byte budget + actor-pool compute."""
+
+    def test_slow_consumer_bounds_producer_memory(self, ray_start_regular):
+        from ray_tpu.data.executor import StreamingExecutor
+
+        block_bytes = 1 << 20  # 1MB blocks
+        n_blocks = 24
+        budget = 4 << 20
+
+        ds = (
+            data.range(n_blocks * 10, parallelism=n_blocks)
+            .map_batches(lambda b: {"x": np.zeros(block_bytes // 8)})
+        )
+        ex = StreamingExecutor(ds._plan, max_in_flight=n_blocks,
+                               max_in_flight_bytes=budget)
+        it = ex.execute()
+        rt = ray_start_regular
+        peak = 0
+        consumed = []
+        for ref in it:
+            # slow consumer: sample the driver store while blocks pile up
+            time.sleep(0.05)
+            used = sum(
+                a.store._used for a in rt.agents.values()
+                if hasattr(a.store, "_used")
+            )
+            peak = max(peak, used)
+            consumed.append(ray_get(ref))
+            del ref
+        assert len(consumed) == n_blocks
+        # budget + one window of in-execution blocks of slack; without
+        # backpressure all 24MB would materialize up front
+        assert peak < budget + 8 * block_bytes, f"peak {peak} bytes"
+
+    def test_actor_pool_map_with_per_actor_state(self, ray_start_regular):
+        class Enricher:
+            def __init__(self):
+                # per-actor state: constructed once per pool worker (the
+                # "loaded model"); counts blocks THIS worker transformed
+                self.instance_id = os.getpid() * 1000 + id(self) % 1000
+                self.calls = 0
+
+            def __call__(self, batch):
+                self.calls += 1
+                return {
+                    "y": np.asarray(batch["id"]) * 2,
+                    "worker": np.full(len(batch["id"]), self.instance_id),
+                    "call_no": np.full(len(batch["id"]), self.calls),
+                }
+
+        ds = data.range(400, parallelism=8).map_batches(
+            Enricher, compute="actors", concurrency=2)
+        rows = ds.take_all()
+        assert len(rows) == 400
+        assert {r["y"] for r in rows} == {i * 2 for i in range(400)}
+        workers = {r["worker"] for r in rows}
+        assert len(workers) == 2  # exactly the pool's actors did the work
+        # per-actor call counters advanced: state persisted across blocks
+        assert max(r["call_no"] for r in rows) >= 2
+
+    def test_callable_class_requires_actor_compute(self, ray_start_regular):
+        class C:
+            def __call__(self, b):
+                return b
+
+        with pytest.raises(ValueError, match="actors"):
+            data.range(10).map_batches(C, compute="tasks")
