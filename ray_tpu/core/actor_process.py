@@ -48,6 +48,9 @@ def _child_main(req_q, resp_q, log_dir: str = "") -> None:
     Runs max_concurrency threads over one request queue so blocking methods
     (queues, batchers) don't wedge the whole actor; per-call tags route
     responses. Imports stay minimal — user code decides what else loads."""
+    from ._pdeathsig import set_pdeathsig
+
+    set_pdeathsig()  # die with the runtime, never orphan (chaos tests)
     os.environ["RAY_TPU_IN_POOL_WORKER"] = "1"  # api.py guards private inits
     if log_dir:
         try:

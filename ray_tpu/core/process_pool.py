@@ -200,7 +200,12 @@ def _mp_context():
     jax/TPU handles). spawn is the fallback where forkserver is absent.
     Shared by the task pool and actor worker processes."""
     try:
-        return mp.get_context("forkserver")
+        ctx = mp.get_context("forkserver")
+        # the preload import arms PR_SET_PDEATHSIG inside the forkserver:
+        # a SIGKILLed runtime (chaos tests, crashed drivers) must not
+        # orphan the server + resource-tracker daemons forever
+        ctx.set_forkserver_preload(["ray_tpu.core._pdeathsig"])
+        return ctx
     except ValueError:
         return mp.get_context("spawn")
 
@@ -239,7 +244,10 @@ def _suppress_main_reimport():
 
 def _worker_main(store_name: str, req_q, resp_q, log_dir: str = "") -> None:
     """Entry point of a spawned worker. Imports stay minimal: no jax."""
+    from ._pdeathsig import set_pdeathsig
     from .shm_store import ShmObjectStore
+
+    set_pdeathsig()  # die with the forkserver/runtime, never orphan
 
     # Runtime API calls inside a pool worker would _auto_init a PRIVATE
     # runtime whose refs/handles are meaningless to the parent; api.py

@@ -4,6 +4,19 @@ tolerance, GRPO reward climbing on a tiny LM."""
 import numpy as np
 import pytest
 
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _rl_runtime():
+    """RL constructors auto-init on first .remote; pin a properly-sized
+    runtime and TEAR IT DOWN so the auto-inited singleton can't leak a
+    1-CPU runtime into later suites (the r3 serve flake's root cause)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
 from ray_tpu.rl import (
     GRPO,
     GRPOConfig,
