@@ -28,6 +28,7 @@ from .api import (  # noqa: F401
 )
 from .core.task_spec import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     SchedulingStrategy,
     SpreadSchedulingStrategy,

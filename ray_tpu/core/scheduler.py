@@ -22,6 +22,7 @@ from .control_plane import ControlPlane, NodeInfo, NodeState
 from .ids import NodeID
 from .task_spec import (
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     SchedulingStrategy,
     SpreadSchedulingStrategy,
@@ -91,6 +92,26 @@ class ClusterScheduler:
                     f"{strategy.node_id.hex()[:8]} which is not alive"
                 )
             return None
+
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            hard = [
+                n for n in nodes
+                if strategy._matches(strategy.hard, n.labels)
+                and _feasible(n, demand)
+            ]
+            if not hard:
+                raise ValueError(
+                    f"task {spec.name}: no alive node matches hard label "
+                    f"constraints {strategy.hard} with demand {demand}"
+                )
+            preferred = [
+                n for n in hard if strategy._matches(strategy.soft, n.labels)
+            ]
+            for pool in (preferred, hard):
+                avail = [n for n in pool if _available(n, demand)]
+                if avail:
+                    return min(avail, key=_utilization).node_id
+            return None  # feasible but busy: wait
 
         feasible = [n for n in nodes if _feasible(n, demand)]
         if not feasible:

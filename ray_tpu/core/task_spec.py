@@ -57,6 +57,30 @@ class NodeAffinitySchedulingStrategy(SchedulingStrategy):
 
 
 @dataclass(frozen=True)
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    """Label-constrained placement (reference:
+    `python/ray/util/scheduling_strategies.py :: NodeLabelSchedulingStrategy`
+    + the raylet label policy). hard: every expression must match for a
+    node to be eligible; soft: matching nodes preferred, any feasible
+    node otherwise. Expressions: {key: ("in", [v1, v2])} or
+    {key: ("not_in", [v1])} — exact string matching on NodeInfo.labels
+    (e.g. accelerator generation, zone, provider id)."""
+
+    hard: Any = None  # Dict[str, Tuple[str, List[str]]]
+    soft: Any = None
+
+    @staticmethod
+    def _matches(exprs, labels: Dict[str, str]) -> bool:
+        for key, (op, values) in (exprs or {}).items():
+            has = labels.get(key)
+            if op == "in" and has not in values:
+                return False
+            if op == "not_in" and has in values:
+                return False
+        return True
+
+
+@dataclass(frozen=True)
 class PlacementGroupSchedulingStrategy(SchedulingStrategy):
     placement_group_id: PlacementGroupID = None  # type: ignore[assignment]
     bundle_index: int = -1

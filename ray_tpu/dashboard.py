@@ -186,6 +186,20 @@ def _render_status_page() -> str:
     return "".join(parts)
 
 
+_job_client_singleton = None
+
+
+def _job_client():
+    """Shared in-process job client for the REST routes (the dashboard
+    runs in the head process, where the runtime lives)."""
+    global _job_client_singleton
+    if _job_client_singleton is None:
+        from .job_submission import JobSubmissionClient
+
+        _job_client_singleton = JobSubmissionClient()
+    return _job_client_singleton
+
+
 def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
     """Serve the dashboard; returns the bound port."""
     global _dash_server
@@ -202,6 +216,10 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
             self.end_headers()
             self.wfile.write(body)
 
+        def _json(self, code: int, payload) -> None:
+            self._send(code, json.dumps(payload, default=str).encode(),
+                       "application/json")
+
         def do_GET(self):
             try:
                 if self.path in ("/", "/index.html"):
@@ -213,23 +231,53 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                         200, metrics_registry.render_prometheus().encode(),
                         "text/plain; version=0.0.4",
                     )
+                # job REST surface (reference: dashboard job module,
+                # `dashboard/modules/job/job_head.py` HTTP routes)
+                if self.path.startswith("/api/jobs/"):
+                    rest = self.path[len("/api/jobs/"):].strip("/")
+                    client = _job_client()
+                    try:
+                        if rest.endswith("/logs"):
+                            job_id = rest[: -len("/logs")]
+                            return self._json(
+                                200, {"logs": client.get_job_logs(job_id)})
+                        return self._json(
+                            200, {"submission_id": rest,
+                                  "status": client.get_job_status(rest)})
+                    except ValueError as e:  # unknown job id -> 404, not 500
+                        return self._json(404, {"error": str(e)})
                 if self.path.startswith("/api/v0/"):
                     what = self.path[len("/api/v0/"):].strip("/")
                     payload = _state_payload(what)
-                    return self._send(
-                        200, json.dumps(payload, default=str).encode(),
-                        "application/json",
-                    )
+                    return self._json(200, payload)
                 return self._send(404, b'{"error": "not found"}',
                                   "application/json")
             except KeyError:
                 return self._send(404, b'{"error": "unknown resource"}',
                                   "application/json")
             except Exception as e:  # noqa: BLE001 — serialized to client
-                return self._send(
-                    500, json.dumps({"error": repr(e)}).encode(),
-                    "application/json",
-                )
+                return self._json(500, {"error": repr(e)})
+
+        def do_POST(self):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                client = _job_client()
+                if self.path in ("/api/jobs", "/api/jobs/"):
+                    job_id = client.submit_job(
+                        entrypoint=body["entrypoint"],
+                        runtime_env=body.get("runtime_env"),
+                        submission_id=body.get("submission_id"),
+                        metadata=body.get("metadata"),
+                    )
+                    return self._json(200, {"submission_id": job_id})
+                if self.path.startswith("/api/jobs/") and self.path.endswith("/stop"):
+                    job_id = self.path[len("/api/jobs/"):-len("/stop")].strip("/")
+                    return self._json(200, {"stopped": client.stop_job(job_id)})
+                return self._send(404, b'{"error": "not found"}',
+                                  "application/json")
+            except Exception as e:  # noqa: BLE001
+                return self._json(500, {"error": repr(e)})
 
     _dash_server = ThreadingHTTPServer((host, port), Handler)
     t = threading.Thread(target=_dash_server.serve_forever, daemon=True,
@@ -241,6 +289,8 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
 
 
 def stop_dashboard() -> None:
+    global _job_client_singleton
+    _job_client_singleton = None  # never serve a dead runtime's handles
     global _dash_server
     if _dash_server is not None:
         _dash_server.shutdown()

@@ -42,6 +42,8 @@ class JobSupervisor:
         self.returncode: Optional[int] = None
         self._log: List[str] = []
         self._proc: Optional[subprocess.Popen] = None
+        self._stop_requested = False
+        self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -51,10 +53,16 @@ class JobSupervisor:
         cwd = self.runtime_env.get("working_dir") or None
         self.status = JobStatus.RUNNING
         try:
-            self._proc = subprocess.Popen(
-                self.entrypoint, shell=True, cwd=cwd, env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            )
+            with self._lock:
+                # stop() can land before Popen on a loaded box: honor it
+                # instead of silently racing it away
+                if self._stop_requested:
+                    self.status = JobStatus.STOPPED
+                    return
+                self._proc = subprocess.Popen(
+                    self.entrypoint, shell=True, cwd=cwd, env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                )
             assert self._proc.stdout is not None
             for line in self._proc.stdout:
                 self._log.append(line)
@@ -76,24 +84,63 @@ class JobSupervisor:
         return "".join(self._log)
 
     def stop(self) -> bool:
-        if self._proc is not None and self._proc.poll() is None:
+        with self._lock:
+            self._stop_requested = True
+            proc = self._proc
+        if proc is None:
+            # not launched yet: _run observes the flag and marks STOPPED
+            return True
+        if proc.poll() is None:
             self.status = JobStatus.STOPPED
-            self._proc.terminate()
+            proc.terminate()
             try:
-                self._proc.wait(timeout=5)
+                proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
-                self._proc.kill()
+                proc.kill()
             return True
         return False
 
 
 class JobSubmissionClient:
-    """In-cluster job client (the reference's REST surface collapses to
-    actor calls — no separate dashboard process in this runtime)."""
+    """Job client. In-process by default (actor calls); pass an
+    ``http://host:port`` dashboard address to drive a RUNNING session
+    over its REST surface (reference: JobSubmissionClient against
+    `dashboard/modules/job/` routes) — submit/status/logs/stop work
+    from a separate shell with no runtime in this process."""
 
     def __init__(self, address: Optional[str] = None):
-        api._auto_init()
+        self._http = None
+        if address and address.startswith("http"):
+            self._http = address.rstrip("/")
+        else:
+            api._auto_init()
         self._supervisors: Dict[str, Any] = {}
+
+    def _rest(self, method: str, path: str, payload=None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._http + path, method=method,
+            data=_json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # surface the server's error detail, not a bare status line
+            try:
+                detail = _json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                detail = str(e)
+            if e.code == 404:
+                raise ValueError(detail) from None
+            raise RuntimeError(detail) from None
+        if isinstance(out, dict) and out.get("error"):
+            raise RuntimeError(out["error"])
+        return out
 
     def submit_job(
         self,
@@ -103,6 +150,11 @@ class JobSubmissionClient:
         submission_id: Optional[str] = None,
         metadata: Optional[Dict[str, str]] = None,
     ) -> str:
+        if self._http is not None:
+            return self._rest("POST", "/api/jobs", {
+                "entrypoint": entrypoint, "runtime_env": runtime_env,
+                "submission_id": submission_id, "metadata": metadata,
+            })["submission_id"]
         job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
         # num_cpus=0: the supervisor just babysits a subprocess (reference
         # JobSupervisor is likewise zero-CPU) — the entrypoint's own work
@@ -128,12 +180,18 @@ class JobSubmissionClient:
         return sup
 
     def get_job_status(self, job_id: str) -> str:
+        if self._http is not None:
+            return self._rest("GET", f"/api/jobs/{job_id}")["status"]
         return api.get(self._sup(job_id).get_status.remote())
 
     def get_job_logs(self, job_id: str) -> str:
+        if self._http is not None:
+            return self._rest("GET", f"/api/jobs/{job_id}/logs")["logs"]
         return api.get(self._sup(job_id).get_logs.remote())
 
     def stop_job(self, job_id: str) -> bool:
+        if self._http is not None:
+            return self._rest("POST", f"/api/jobs/{job_id}/stop")["stopped"]
         return api.get(self._sup(job_id).stop.remote())
 
     def wait_until_finish(self, job_id: str, timeout_s: float = 300.0) -> str:

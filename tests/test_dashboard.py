@@ -87,3 +87,44 @@ class TestGrafana:
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p
+
+
+class TestJobREST:
+    """Job submission over the dashboard's REST surface (reference:
+    dashboard/modules/job HTTP routes): a client with NO runtime in its
+    process drives submit/status/logs/stop against a running session."""
+
+    def test_submit_status_logs_over_http(self, ray_start_regular):
+        import sys
+
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        port = start_dashboard(port=0)
+        try:
+            url = f"http://127.0.0.1:{port}"
+            client = JobSubmissionClient(address=url)  # REST mode
+            job_id = client.submit_job(
+                entrypoint=f"{sys.executable} -c \"print('rest job ran')\"")
+            assert job_id.startswith("raytpu-job-")
+            status = client.wait_until_finish(job_id, timeout_s=120)
+            assert status == "SUCCEEDED"
+            assert "rest job ran" in client.get_job_logs(job_id)
+        finally:
+            stop_dashboard()
+
+    def test_stop_over_http(self, ray_start_regular):
+        import sys
+
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        port = start_dashboard(port=0)
+        try:
+            client = JobSubmissionClient(address=f"http://127.0.0.1:{port}")
+            job_id = client.submit_job(
+                entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"")
+            assert client.stop_job(job_id) is True
+            assert client.wait_until_finish(job_id, timeout_s=60) == "STOPPED"
+        finally:
+            stop_dashboard()
