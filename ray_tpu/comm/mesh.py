@@ -36,8 +36,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dcn_dp", "dcn_pp", "pp", "dp", "fsdp", "ep", "sp", "tp")
-DCN_AXES = ("dcn_dp", "dcn_pp")
+AXIS_ORDER = ("dcn_dp", "dcn_pp", "dcn_sp", "pp", "dp", "fsdp", "ep", "sp", "tp")
+DCN_AXES = ("dcn_dp", "dcn_pp", "dcn_sp")
 
 
 @dataclass(frozen=True)

@@ -31,6 +31,31 @@ from ..ops.attention import flash_attention_with_lse
 _NEG_INF = -1e30
 
 
+def _merge_block(o, lse, o_blk, lse_blk):
+    """logsumexp-merge one attended block into the running (o, lse)."""
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    alpha = jnp.exp(lse - lse_new)  # [B,H,Tq]; lse finite -> no nan
+    beta = jnp.exp(lse_blk - lse_new)
+    w_a = alpha.transpose(0, 2, 1)[..., None]
+    w_b = beta.transpose(0, 2, 1)[..., None]
+    return o * w_a + o_blk * w_b, lse_new
+
+
+def _attend(scale, q, k_blk, v_blk):
+    # scale rides a partial (static float): a traced operand would hit the
+    # kernel's custom_vjp nondiff_argnums
+    o_blk, lse_blk = flash_attention_with_lse(
+        q, k_blk, v_blk, causal=False, scale=scale
+    )
+    return o_blk.astype(jnp.float32), lse_blk
+
+
+def _skip(_scale, q, k_blk, v_blk):
+    # derived from q so both cond branches agree on device-varying axes
+    zero = q.astype(jnp.float32) * 0.0
+    return zero, zero[..., 0].transpose(0, 2, 1) + _NEG_INF
+
+
 def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -54,17 +79,6 @@ def ring_attention_local(
     o0, lse0 = flash_attention_with_lse(q, k, v, causal=causal, scale=scale)
     perm = [(i, (i - 1) % n) for i in range(n)]
 
-    def attend(q, k_blk, v_blk):
-        o_blk, lse_blk = flash_attention_with_lse(
-            q, k_blk, v_blk, causal=False, scale=scale
-        )
-        return o_blk.astype(jnp.float32), lse_blk
-
-    def skip(q, k_blk, v_blk):
-        # derived from q so both cond branches agree on device-varying axes
-        zero = q.astype(jnp.float32) * 0.0
-        return zero, zero[..., 0].transpose(0, 2, 1) + _NEG_INF
-
     def step(carry, s):
         o, lse, k_blk, v_blk = carry
         # rotate first: at scan step s (1..n-1) we hold block src
@@ -73,19 +87,92 @@ def ring_attention_local(
         src = (my_idx + s) % n
         if causal:
             # blocks from later ranks are fully masked — skip the kernel
-            o_blk, lse_blk = jax.lax.cond(src < my_idx, attend, skip, q, k_blk, v_blk)
+            o_blk, lse_blk = jax.lax.cond(
+                src < my_idx,
+                functools.partial(_attend, scale),
+                functools.partial(_skip, scale),
+                q, k_blk, v_blk)
         else:
-            o_blk, lse_blk = attend(q, k_blk, v_blk)
-        lse_new = jnp.logaddexp(lse, lse_blk)
-        alpha = jnp.exp(lse - lse_new)  # [B,H,Tq]; lse finite -> no nan
-        beta = jnp.exp(lse_blk - lse_new)
-        w_a = alpha.transpose(0, 2, 1)[..., None]
-        w_b = beta.transpose(0, 2, 1)[..., None]
-        o = o * w_a + o_blk * w_b
-        return (o, lse_new, k_blk, v_blk), None
+            o_blk, lse_blk = _attend(scale, q, k_blk, v_blk)
+        o, lse = _merge_block(o, lse, o_blk, lse_blk)
+        return (o, lse, k_blk, v_blk), None
 
     carry = (o0.astype(jnp.float32), lse0, k, v)
     (o, _, _, _), _ = jax.lax.scan(step, carry, jnp.arange(1, n))
+    return o.astype(q.dtype)
+
+
+def ring_attention_2level_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    inner_axis: str = "sp",
+    outer_axis: str = "dcn_sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """DCN-spanning context parallelism: a two-level ring (SURVEY §5.7's
+    cross-slice CP; the LWM-style hierarchy). The sequence is sharded over
+    (outer_axis x inner_axis), outer-major: inner rotations ride
+    single-hop ICI every step; ONE outer (DCN) hop happens per full inner
+    revolution, so the slow cross-slice link is amortized over n_inner
+    block computations — the bandwidth shape multi-slice long-context
+    needs. Per-rank body; call inside shard_map."""
+    n_in = jax.lax.psum(1, inner_axis)
+    n_out = jax.lax.psum(1, outer_axis)
+    my_in = jax.lax.axis_index(inner_axis)
+    my_out = jax.lax.axis_index(outer_axis)
+    my_global = my_out * n_in + my_in
+    B, Tq, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+
+    o0, lse0 = flash_attention_with_lse(q, k, v, causal=causal, scale=scale)
+    perm_in = [(i, (i - 1) % n_in) for i in range(n_in)]
+    perm_out = [(i, (i - 1) % n_out) for i in range(n_out)]
+
+    def block(o, lse, k_blk, v_blk, src_global):
+        if causal:
+            o_blk, lse_blk = jax.lax.cond(
+                src_global < my_global,
+                functools.partial(_attend, scale),
+                functools.partial(_skip, scale),
+                q, k_blk, v_blk)
+        else:
+            o_blk, lse_blk = _attend(scale, q, k_blk, v_blk)
+        return _merge_block(o, lse, o_blk, lse_blk)
+
+    o, lse = o0.astype(jnp.float32), lse0
+    k_blk, v_blk = k, v
+    # outer loop unrolled (n_out = slice count, small by construction);
+    # inner revolutions are lax.scan like the single-level ring. psum(1)
+    # over a mesh axis is static, so these are plain ints at trace time.
+    n_in_static = int(n_in)
+    steps = jnp.arange(1, n_in_static)  # every round; round 0's s=0 is local
+    for outer_s in range(int(n_out)):
+        src_out = (my_out + outer_s) % n_out
+
+        def step(carry, s, _src_out=src_out):
+            o, lse, k_blk, v_blk = carry
+            k_blk = jax.lax.ppermute(k_blk, inner_axis, perm_in)
+            v_blk = jax.lax.ppermute(v_blk, inner_axis, perm_in)
+            src_in = (my_in + s) % n_in
+            o, lse = block(o, lse, k_blk, v_blk, _src_out * n_in + src_in)
+            return (o, lse, k_blk, v_blk), None
+
+        if outer_s > 0:
+            # close the previous inner revolution (one extra ICI hop) so
+            # every rank is back to holding its HOME inner block, then one
+            # DCN hop hands the whole slice's blocks to the neighbor slice
+            k_blk = jax.lax.ppermute(k_blk, inner_axis, perm_in)
+            v_blk = jax.lax.ppermute(v_blk, inner_axis, perm_in)
+            k_blk = jax.lax.ppermute(k_blk, outer_axis, perm_out)
+            v_blk = jax.lax.ppermute(v_blk, outer_axis, perm_out)
+            # the arrived block is the neighbor slice's my_in block
+            o, lse = block(o, lse, k_blk, v_blk, src_out * n_in + my_in)
+        if n_in_static > 1:
+            (o, lse, k_blk, v_blk), _ = jax.lax.scan(
+                step, (o, lse, k_blk, v_blk), steps)
     return o.astype(q.dtype)
 
 
@@ -97,15 +184,25 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
 ) -> jax.Array:
-    """Global-view entry: q,k,v [B, T, H, D] with T sharded over axis_name.
+    """Global-view entry: q,k,v [B, T, H, D] with T sharded over axis_name
+    (and over "dcn_sp" too when the mesh has it: the two-level DCN ring).
 
-    Wraps ring_attention_local in shard_map; batch follows the data axes if
+    Wraps the per-rank body in shard_map; batch follows the data axes if
     present in the mesh.
     """
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     batch_part = data_axes if data_axes else None
-    spec = PartitionSpec(batch_part, axis_name, None, None)
-    body = functools.partial(ring_attention_local, axis_name=axis_name, causal=causal)
+    two_level = "dcn_sp" in mesh.axis_names and mesh.shape["dcn_sp"] > 1
+    if two_level:
+        seq_part = ("dcn_sp", axis_name)
+        body = functools.partial(
+            ring_attention_2level_local, inner_axis=axis_name,
+            outer_axis="dcn_sp", causal=causal)
+    else:
+        seq_part = axis_name
+        body = functools.partial(
+            ring_attention_local, axis_name=axis_name, causal=causal)
+    spec = PartitionSpec(batch_part, seq_part, None, None)
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

@@ -25,7 +25,7 @@ Rules = Dict[str, MeshAxes]
 #   sequence over sp for long-context; experts over ep.
 DEFAULT_RULES: Rules = {
     "batch": ("dcn_dp", "dp", "fsdp"),
-    "seq": "sp",
+    "seq": ("dcn_sp", "sp"),
     "embed": "fsdp",
     "heads": "tp",
     "kv": None,
