@@ -10,9 +10,11 @@ from .api import (  # noqa: F401
     delete,
     get_app_handle,
     get_deployment_handle,
+    grpc_port,
     http_port,
     run,
     shutdown,
+    start_grpc,
     status,
 )
 from .batching import batch  # noqa: F401

@@ -74,6 +74,43 @@ def http_port() -> Optional[int]:
         return _proxy.port if _proxy else None
 
 
+_grpc_proxy = None
+
+
+def start_grpc(port: int = 0) -> int:
+    """Start the gRPC ingress (reference: the proxy's gRPC server path).
+    Routes resolve live from the app table, so call this before or after
+    serve.run in any order. Returns the bound port."""
+    global _grpc_proxy
+    from .grpc_proxy import GrpcProxy
+
+    handle_cache: Dict[str, DeploymentHandle] = {}
+
+    def routes():
+        # handles cached per deployment: a fresh handle per request would
+        # re-sync against the controller every call and discard the pow-2
+        # router's replica/load state
+        with _state_lock:
+            out = {}
+            for dep_name, route in _apps.values():
+                h = handle_cache.get(dep_name)
+                if h is None:
+                    h = handle_cache[dep_name] = DeploymentHandle(dep_name)
+                out[route] = h
+            return out
+
+    with _state_lock:
+        if _grpc_proxy is None:
+            _grpc_proxy = GrpcProxy(routes, port=port)
+            _grpc_proxy.start()
+        return _grpc_proxy.port
+
+
+def grpc_port() -> Optional[int]:
+    with _state_lock:
+        return _grpc_proxy.port if _grpc_proxy else None
+
+
 def status() -> Dict[str, Any]:
     try:
         controller = core_api.get_actor(CONTROLLER_NAME)
@@ -95,11 +132,14 @@ def delete(name: str = "default") -> None:
 
 
 def shutdown() -> None:
-    global _proxy
+    global _proxy, _grpc_proxy
     with _state_lock:
         if _proxy is not None:
             _proxy.stop()
             _proxy = None
+        if _grpc_proxy is not None:
+            _grpc_proxy.stop()
+            _grpc_proxy = None
         _apps.clear()
     try:
         controller = core_api.get_actor(CONTROLLER_NAME)

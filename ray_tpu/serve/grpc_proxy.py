@@ -1,0 +1,108 @@
+"""gRPC ingress for serve (reference: `serve/_private/proxy.py`'s gRPC
+server path + `serve/grpc_util.py`).
+
+Proto-less generic contract so user services need no codegen: the gRPC
+method path IS the route — ``/<app_route>/<method>`` (method optional,
+defaults to the deployment's ``__call__``) — and request/response bodies
+are JSON bytes. Unary-unary only: a handler that returns a generator has
+its chunks collected into one JSON list (streaming responses stay on the
+HTTP/SSE ingress).
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    rpc = channel.unary_unary("/myapp/__call__")
+    out = json.loads(rpc(json.dumps({"x": 1}).encode()))
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+from ..core.logging import get_logger
+
+logger = get_logger("serve.grpc")
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class GrpcProxy:
+    """Generic-handler gRPC server routing to deployment handles.
+
+    Routes resolve through the SAME registry the HTTP proxy uses (the
+    callable passed in returns {route: handle}), so apps deployed or
+    deleted after startup are picked up without re-registration."""
+
+    def __init__(self, routes_fn, host: str = "127.0.0.1", port: int = 0):
+        self._routes_fn = routes_fn
+        self.host = host
+        self.port = port
+        self._server = None
+
+    def start(self) -> int:
+        from concurrent.futures import ThreadPoolExecutor
+
+        import grpc
+
+        proxy = self
+
+        class Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                parts = [p for p in details.method.split("/") if p]
+
+                def handle_unary(request: bytes, context):
+                    return proxy._dispatch(parts, request, context)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    handle_unary,
+                    request_deserializer=_identity,
+                    response_serializer=_identity,
+                )
+
+        self._server = grpc.server(
+            thread_pool=ThreadPoolExecutor(max_workers=16),
+        )
+        self._server.add_generic_rpc_handlers((Generic(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+        logger.info("gRPC proxy on %s:%d", self.host, self.port)
+        return self.port
+
+    def _dispatch(self, parts, request: bytes, context) -> bytes:
+        import grpc
+
+        from .http_proxy import resolve_route
+
+        handle, rest = resolve_route(parts, self._routes_fn())
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no app at /{'/'.join(parts)}")
+        if rest and rest != ["__call__"]:
+            handle = handle.options("_".join(rest))
+        try:
+            payload = json.loads(request) if request else {}
+        except json.JSONDecodeError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad json: {e}")
+        try:
+            result = handle.remote(payload).result(timeout=300.0)
+            if hasattr(result, "__next__"):
+                result = list(result)  # stream collected for the unary reply
+            return json.dumps(_jsonable(result)).encode()
+        except Exception as e:  # noqa: BLE001 — surfaced as gRPC status
+            logger.warning("grpc request failed", exc_info=True)
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    def stop(self) -> None:
+        if self._server is not None:
+            # stop() is non-blocking: wait out the drain so the port is
+            # actually free and no request resolves against cleared routes
+            self._server.stop(grace=1.0).wait()
+            self._server = None
+
+
+def _jsonable(x: Any) -> Any:
+    from .http_proxy import _jsonable as impl
+
+    return impl(x)

@@ -20,6 +20,19 @@ from ..core.logging import get_logger
 logger = get_logger("serve.proxy")
 
 
+def resolve_route(parts, routes):
+    """Longest-prefix route match -> (handle, rest) or (None, []).
+
+    i=0 tests the empty candidate so route_prefix "/" (route key "") is
+    reachable — the reference's DEFAULT prefix (ADVICE r3). Shared by the
+    HTTP and gRPC ingresses so resolution can never diverge."""
+    for i in range(len(parts), -1, -1):
+        candidate = "/".join(parts[:i])
+        if candidate in routes:
+            return routes[candidate], parts[i:]
+    return None, []
+
+
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self.host = host
@@ -62,17 +75,7 @@ class HTTPProxy:
                 # several segments, e.g. /api/v9); remaining segments map
                 # to underscored methods, so the OpenAI wire path
                 # /v1/chat/completions hits chat_completions
-                handle = None
-                rest: list = []
-                # i=0 tests the empty candidate so route_prefix "/" (route
-                # key "") is reachable — the reference's DEFAULT prefix
-                # (ADVICE r3).
-                for i in range(len(parts), -1, -1):
-                    candidate = "/".join(parts[:i])
-                    if candidate in proxy.routes:
-                        handle = proxy.routes[candidate]
-                        rest = parts[i:]
-                        break
+                handle, rest = resolve_route(parts, proxy.routes)
                 if handle is None:
                     return self._send(404, {"error": f"no app at {self.path}"})
                 if rest:

@@ -209,3 +209,29 @@ class TestReviewRegressions:
             serve.delete("rooted")
         finally:
             serve.shutdown()
+
+
+class TestGrpcIngress:
+    """gRPC ingress (reference: the proxy's gRPC server path): the method
+    path is the route, bodies are JSON bytes, no codegen needed."""
+
+    def test_grpc_roundtrip_and_errors(self, ray_start_regular, app_module):
+        grpc = pytest.importorskip("grpc")
+        from ray_tpu import serve
+
+        try:
+            app = build_app(ApplicationSchema(
+                name="gapp", import_path=f"{app_module}:app"))
+            serve.run(app, name="gapp", route_prefix="/gapp")
+            port = serve.start_grpc()
+            channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+            rpc = channel.unary_unary("/gapp/__call__")
+            out = json.loads(rpc(json.dumps({"who": "grpc"}).encode(),
+                                 timeout=60))
+            assert out == {"msg": "hello grpc"}
+            # unknown route -> NOT_FOUND status, not a hang or 500-ish blob
+            with pytest.raises(grpc.RpcError) as ei:
+                channel.unary_unary("/nosuchapp/__call__")(b"{}", timeout=30)
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        finally:
+            serve.shutdown()
