@@ -429,3 +429,52 @@ class TestSAC:
         # monotonic-ish reduction, not convergence
         assert float(aux["q1_loss"]) < first_loss * 0.7, (first_loss, aux)
         assert np.isfinite(float(aux["pi_loss"]))
+
+
+class TestGymnasiumIntegration:
+    """Real gymnasium envs through GymWrapper (r3 weak #8: the wrapper
+    existed but nothing imported real gymnasium)."""
+
+    def test_ppo_trains_on_real_gym_cartpole(self):
+        gym = pytest.importorskip("gymnasium")
+        from ray_tpu.rl import GymWrapper
+
+        def env_fn():
+            return GymWrapper(gym.make("CartPole-v1"))
+
+        env = env_fn()
+        assert env.observation_size == 4 and env.num_actions == 2
+        cfg = PPOConfig(env_fn=env_fn, num_env_runners=2,
+                        rollout_steps_per_runner=128, num_epochs=2,
+                        minibatch_size=64, seed=0)
+        algo = PPO(cfg)
+        first = algo.train()
+        for _ in range(3):
+            out = algo.train()
+        # learning signal present and rollouts flowed through gymnasium
+        assert out["timesteps_this_iter"] == 256
+        assert out["episode_return_mean"] > 0
+        assert np.isfinite(out["loss"])
+
+    def test_gym_wrapper_truncation_columns(self):
+        gym = pytest.importorskip("gymnasium")
+        from ray_tpu.rl import GymWrapper
+        from ray_tpu.rl.env_runner import EnvRunner
+        from ray_tpu.rl.module import init_mlp_module, mlp_forward_np
+
+        import jax
+        import ray_tpu
+
+        # gymnasium's TimeLimit emits truncated=True at max_episode_steps:
+        # the runner must carry it separately from terminated
+        def env_fn():
+            return GymWrapper(gym.make("CartPole-v1", max_episode_steps=12))
+
+        params = init_mlp_module(jax.random.PRNGKey(0), 4, 2, hidden=(16,))
+        r = EnvRunner.remote(env_fn, mlp_forward_np, seed=0)
+        ray_tpu.get(r.set_weights.remote(params))
+        ro = ray_tpu.get(r.sample.remote(64))
+        assert ro["dones"].any()
+        assert ((ro["terminateds"] | ro["truncateds"]) == ro["dones"]).all()
+        if ro["truncateds"].any():
+            assert (ro["truncation_values"][ro["truncateds"]] != 0).any()
