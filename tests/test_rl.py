@@ -451,10 +451,14 @@ class TestGymnasiumIntegration:
         first = algo.train()
         for _ in range(3):
             out = algo.train()
-        # learning signal present and rollouts flowed through gymnasium
+        # INTEGRATION scope: rollouts flow through real gymnasium, updates
+        # apply, and the policy doesn't collapse. (Actual learning-curve
+        # assertions live in TestPPO.test_learns_cartpole on the native
+        # env — 4 iterations is too few to demand improvement reliably.)
         assert out["timesteps_this_iter"] == 256
-        assert out["episode_return_mean"] > 0
         assert np.isfinite(out["loss"])
+        assert out["episode_return_mean"] > first["episode_return_mean"] * 0.5, (
+            first["episode_return_mean"], out["episode_return_mean"])
 
     def test_gym_wrapper_truncation_columns(self):
         gym = pytest.importorskip("gymnasium")
