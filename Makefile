@@ -31,7 +31,7 @@ LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 
 MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
-	tests/test_graft_entry.py
+	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all tsan shm
 
