@@ -99,3 +99,54 @@ def test_cmd_memory_lists_objects(capsys):
         assert "total:" in out
     finally:
         ray_tpu.shutdown()
+
+
+class TestStartAddressCLI:
+    def test_start_address_joins_as_worker(self, tmp_path):
+        """`ray-tpu start --address` is the operator's worker-join path
+        (cross-host plane): the process joins, serves dispatched tasks,
+        and exits when the head goes away."""
+        import subprocess
+        import sys
+        import textwrap
+        import time
+
+        import ray_tpu
+
+        rt = ray_tpu.init(
+            num_cpus=1, num_tpus=0,
+            system_config={"control_plane_rpc_port": 0, "worker_processes": 0},
+        )
+        try:
+            addr = rt._cp_server.address
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu",
+                       RAY_TPU_WORKER_PROCESSES="0")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.scripts", "start",
+                 "--address", addr, "--num-cpus", "3", "--num-tpus", "0"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env,
+            )
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if len(rt.control_plane.alive_nodes()) == 2:
+                    break
+                time.sleep(0.2)
+            nodes = rt.control_plane.alive_nodes()
+            assert len(nodes) == 2, nodes
+            assert any(n.resources_total.get("CPU") == 3.0 for n in nodes)
+
+            @ray_tpu.remote(num_cpus=2)  # only fits the CLI-joined worker
+            def where():
+                return os.getpid()
+
+            assert ray_tpu.get(where.remote(), timeout=60) == proc.pid
+        finally:
+            ray_tpu.shutdown()
+            try:
+                proc.wait(timeout=20)  # head death stops the worker
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        assert proc.returncode == 0
