@@ -325,6 +325,9 @@ def cmd_serve_run(args) -> int:
         app = getattr(importlib.import_module(module), attr or "app")
         serve.run(app, http_port=args.http_port)
         status = serve.status()
+    if getattr(args, "grpc_port", None) is not None:
+        port = serve.start_grpc(port=args.grpc_port)
+        print(f"gRPC ingress on 127.0.0.1:{port}", file=sys.stderr)
     print(json.dumps(status, indent=2, default=str))
     print(f"serving on http://127.0.0.1:{serve.http_port()} (ctrl-c to stop)",
           file=sys.stderr)
@@ -465,6 +468,8 @@ def main(argv=None) -> int:
     psr.add_argument("config_or_import_path",
                      help="a serve YAML/JSON config, or module:attr")
     psr.add_argument("--http-port", type=int, default=0)
+    psr.add_argument("--grpc-port", type=int, default=None,
+                     help="also serve the gRPC ingress (0 = ephemeral)")
     psr.set_defaults(fn=cmd_serve_run)
 
     args = p.parse_args(argv)
