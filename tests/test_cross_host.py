@@ -295,3 +295,51 @@ class TestCrossHostRuntimeEnv:
                 proc.wait(timeout=20)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+class TestCrossHostDataIngest:
+    def test_data_pipeline_reads_on_joined_host(self):
+        """Multi-host ingest (r3 weak #3's scale concern): Data read/map
+        tasks overflow onto a joined worker host by resource demand, their
+        blocks seal in the WORKER's store, and the consumer pulls them
+        back over the transfer plane."""
+        import numpy as np
+
+        from ray_tpu import data
+
+        # head CPU 0.5: a num_cpus=1 data task can NEVER fit it, so every
+        # read/map deterministically lands on the joined host
+        rt = ray_tpu.init(
+            num_cpus=0.5, num_tpus=0,
+            system_config={"control_plane_rpc_port": 0, "worker_processes": 0},
+        )
+        proc = _spawn_worker(rt._cp_server.address, resources="{}", num_cpus=6)
+        try:
+            _wait_nodes(rt, 2)
+            worker_node = [
+                n for n in rt.control_plane.alive_nodes()
+                if n.resources_total.get("CPU") == 6.0
+            ][0]
+
+            ds = data.range(50_000, parallelism=8).map_batches(
+                lambda b: {"y": np.asarray(b["id"]) * 3}
+            )
+            refs = list(ds._stream_refs())
+            rows = 0
+            remote_blocks = 0
+            for ref in refs:
+                # get() completes the task and pulls the value; the
+                # PRODUCER's location registration is untouched by the pull
+                rows += len(ray_tpu.get(ref, timeout=60)["y"])
+                if worker_node.node_id in rt.directory.locations(ref.object_id):
+                    remote_blocks += 1
+            assert rows == 50_000
+            # every block was produced on the joined host and crossed the
+            # transfer plane back to the consumer
+            assert remote_blocks == len(refs), (remote_blocks, len(refs))
+        finally:
+            ray_tpu.shutdown()
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
