@@ -417,21 +417,30 @@ class NodeAgent:
             stream_cb = self._stream_cbs.pop(spec.task_id, None)
         _running_gauge.add(1, {"node": self.node_id.hex()[:8]})
         try:
+            from .runtime_env import applied, resolve, validate
+
+            renv = resolve(validate(spec.options.runtime_env), self._cp)
             args, kwargs = self._materialize_args(spec)
-            gen = spec.func(*args, **kwargs)
-            if not hasattr(gen, "__next__"):
-                raise TypeError(
-                    f"num_returns='streaming' task {spec.name} must be a "
-                    f"generator; got {type(gen).__name__}"
-                )
-            for i, value in enumerate(gen):
-                if kill_event.is_set():
-                    raise WorkerCrashedError("worker killed during streaming")
-                oid = ObjectID.for_task_return(spec.task_id, i)
-                self.store.put(oid, seal_value(value, spec.name))
-                self._directory.add_location(oid, self.node_id)
-                if stream_cb is not None:
-                    stream_cb(i, oid)
+            # Streaming runs in-process (a generator can't cross the
+            # worker-pool boundary incrementally), so the env applies to
+            # this process for the stream's duration — same contract as
+            # the pool worker, scoped to the generator's lifetime.
+            with applied(renv):
+                gen = spec.func(*args, **kwargs)
+                if not hasattr(gen, "__next__"):
+                    raise TypeError(
+                        f"num_returns='streaming' task {spec.name} must be a "
+                        f"generator; got {type(gen).__name__}"
+                    )
+                for i, value in enumerate(gen):
+                    if kill_event.is_set():
+                        raise WorkerCrashedError(
+                            "worker killed during streaming")
+                    oid = ObjectID.for_task_return(spec.task_id, i)
+                    self.store.put(oid, seal_value(value, spec.name))
+                    self._directory.add_location(oid, self.node_id)
+                    if stream_cb is not None:
+                        stream_cb(i, oid)
             _tasks_counter.inc(tags={"outcome": "ok"})
             return TaskResult(spec.task_id, ok=True, values=None)
         except WorkerCrashedError as e:

@@ -16,6 +16,16 @@ worker for the task). TPU-native scope and its honest limits:
   that process's env/cwd would leak across every concurrent task. The
   reference can isolate these because every actor gets its own worker
   process — that is the documented gap, not silently dropped config.
+- **Streaming tasks**: applied in-process (a generator cannot cross the
+  pool boundary incrementally) under a process-wide mutual-exclusion lock
+  (`_apply_lock`) held for the stream's whole lifetime, so concurrent
+  appliers can never corrupt each other's save/restore. Two consequences:
+  unrelated tasks in the same process can observe the env for the
+  stream's duration (visibility, not corruption, is the accepted
+  in-process limit), and one renv stream must not block on another renv
+  stream's output on the same node — the second stream waits for the
+  lock, so such a dependency would deadlock until the consumer's timeout.
+  Keep renv streams independent (or give only one of them a runtime_env).
 
 Cross-host code shipping (reference: `runtime_env/working_dir.py` GCS
 package upload): at submission the driver zips `working_dir` into the
@@ -39,6 +49,7 @@ import hashlib
 import io
 import os
 import sys
+import threading
 import zipfile
 from typing import Any, Dict, Optional
 
@@ -190,13 +201,30 @@ def ensure_pip_env(reqs) -> str:
     return target
 
 
+# Serializes concurrent appliers in ONE process (streaming tasks in the
+# node agent): interleaved save/restore of env/cwd/sys.path would corrupt
+# both envs and leak the loser's values permanently. Pool workers run
+# serially, so there the lock is uncontended. The residual limit — other
+# non-renv tasks in the same process can OBSERVE the env while a stream
+# holds it — is the documented in-process tradeoff (module docstring).
+_apply_lock = threading.RLock()
+
+
 @contextlib.contextmanager
 def applied(renv: Optional[Dict[str, Any]]):
     """Apply a runtime_env for the duration of one task, then restore.
-    Only safe where the process runs tasks serially (pool workers)."""
+    Appliers are mutually exclusive per process (see _apply_lock); full
+    isolation needs a worker process."""
     if not renv:
         yield
         return
+    with _apply_lock:
+        with _applied_locked(renv):
+            yield
+
+
+@contextlib.contextmanager
+def _applied_locked(renv: Dict[str, Any]):
     # failure-prone setup FIRST, before any process mutation: a pip
     # install that raises must not leak env_vars into the serially-reused
     # worker (nothing below the mutations may raise outside the finally)

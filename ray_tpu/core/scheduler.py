@@ -66,6 +66,11 @@ class ClusterScheduler:
         Raises ValueError for permanently infeasible demands (no ALIVE node
         could ever satisfy the shape) so callers can fail fast instead of
         queueing forever — matching the reference's infeasible-task warning.
+        Exception: a hard-label constraint NO alive node carries returns
+        None (stays pending) rather than raising — a labeled node may join
+        or be autoscaled moments later, and labels (unlike resource shapes)
+        carry no capacity bound to prove infeasibility against. Once
+        label-matching nodes exist, an oversized demand fails fast as usual.
         """
         demand = spec.options.resource_demand()
         strategy = spec.options.scheduling_strategy
@@ -94,15 +99,22 @@ class ClusterScheduler:
             return None
 
         if isinstance(strategy, NodeLabelSchedulingStrategy):
-            hard = [
-                n for n in nodes
-                if strategy._matches(strategy.hard, n.labels)
-                and _feasible(n, demand)
+            labeled = [
+                n for n in nodes if strategy._matches(strategy.hard, n.labels)
             ]
+            if not labeled:
+                # Stay pending: a matching node may join (worker host,
+                # autoscaled provider node carrying labels) moments later —
+                # the reference keeps label-gated tasks as pending demand
+                # rather than failing them.
+                return None
+            hard = [n for n in labeled if _feasible(n, demand)]
             if not hard:
+                # labeled nodes exist but none can EVER fit the demand:
+                # same fail-fast contract as the unlabeled infeasible path
                 raise ValueError(
-                    f"task {spec.name}: no alive node matches hard label "
-                    f"constraints {strategy.hard} with demand {demand}"
+                    f"task {spec.name} demand {demand} is infeasible on "
+                    f"every node matching hard labels {strategy.hard}"
                 )
             preferred = [
                 n for n in hard if strategy._matches(strategy.soft, n.labels)

@@ -340,18 +340,42 @@ class TestLabelScheduling:
         # placement lands on the v5e node: drain its CPU and verify the
         # task table via the node's resource ledger
         assert ray_tpu.get(where.remote(), timeout=30)
-        # a hard constraint nothing matches fails fast
-        bad = ray_tpu.NodeLabelSchedulingStrategy(
+        # a hard constraint nothing matches yet stays PENDING (reference
+        # semantics: label demand waits for a joining/autoscaled node) —
+        # satisfied the moment a matching node arrives
+        later = ray_tpu.NodeLabelSchedulingStrategy(
             hard={"gen": ("in", ["v6e"])})
 
-        @ray_tpu.remote(num_cpus=1, scheduling_strategy=bad)
-        def nowhere():
-            return True
+        @ray_tpu.remote(num_cpus=1, scheduling_strategy=later)
+        def on_v6e():
+            return "v6e"
 
+        ref = on_v6e.remote()
+        import time as _time
+        _time.sleep(0.3)  # scheduler loop has run; task must still be queued
+        rt.add_node(resources={"CPU": 2.0}, labels={"gen": "v6e"})
+        assert ray_tpu.get(ref, timeout=30) == "v6e"
+
+    def test_labeled_but_infeasible_fails_fast(self, ray_start_regular):
+        """Labeled nodes EXIST but none could ever fit the demand: the
+        fail-fast contract applies (select_node docstring), unlike the
+        zero-labeled-nodes case which stays pending."""
         import pytest as _pytest
-        # infeasible label constraints fail fast with the scheduler's error
-        with _pytest.raises(ValueError, match="no alive node matches"):
-            ray_tpu.get(nowhere.remote(), timeout=30)
+
+        import ray_tpu
+
+        rt = ray_start_regular
+        rt.add_node(resources={"CPU": 2.0}, labels={"gen": "v5e"})
+        strat = ray_tpu.NodeLabelSchedulingStrategy(
+            hard={"gen": ("in", ["v5e"])})
+
+        @ray_tpu.remote(num_cpus=100, scheduling_strategy=strat)
+        def huge():
+            return 1
+
+        with _pytest.raises(ValueError,
+                            match="infeasible on every node matching"):
+            ray_tpu.get(huge.remote(), timeout=30)
 
     def test_soft_labels_prefer_but_fall_back(self, ray_start_regular):
         import ray_tpu

@@ -68,6 +68,17 @@ class TestStreamingCore:
             next(gen)
         assert isinstance(ei.value.cause, TypeError)
 
+    def test_streaming_respects_runtime_env(self, ray_start_regular):
+        """ADVICE r4 medium: a streaming task's runtime_env must be
+        applied (env_vars visible inside the generator), not silently
+        dropped by the in-process streaming path."""
+        @ray_tpu.remote(num_returns="streaming",
+                        runtime_env={"env_vars": {"STREAM_FLAG": "lit"}})
+        def produce():
+            yield os.environ.get("STREAM_FLAG")
+
+        assert ray_tpu.get(next(produce.remote()), timeout=10) == "lit"
+
     def test_streamed_ref_as_dependency(self, ray_start_regular):
         @ray_tpu.remote(num_returns="streaming")
         def produce():
