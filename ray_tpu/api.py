@@ -138,6 +138,12 @@ def init(
             port=config.control_plane_rpc_port,
         )
         enable_cross_host(rt)
+        # pool-worker children inherit the back-channel address (nested
+        # submission from pool tasks; api._pool_worker_client)
+        host, _, port = rt._cp_server.address.rpartition(":")
+        if host in ("0.0.0.0", "::", ""):
+            host = "127.0.0.1"
+        os.environ["RAY_TPU_HEAD_ADDRESS"] = f"{host}:{port}"
     return rt
 
 
@@ -178,7 +184,12 @@ def shutdown() -> None:
         _worker_runtime = None
         config.reset()
     if _cw.runtime_initialized():
-        _cw.get_runtime().shutdown()
+        rt = _cw.get_runtime()
+        if getattr(rt, "_cp_server", None) is not None:
+            addr = os.environ.get("RAY_TPU_HEAD_ADDRESS", "")
+            if addr.rpartition(":")[2] == rt._cp_server.address.rpartition(":")[2]:
+                os.environ.pop("RAY_TPU_HEAD_ADDRESS", None)
+        rt.shutdown()
         _cw.set_runtime(None)
         # init()-scoped system_config must not leak into the next runtime
         config.reset()
@@ -194,23 +205,74 @@ _worker_runtime = None  # WorkerRuntime when this process joined via address=
 def _auto_init() -> Runtime:
     if not _cw.runtime_initialized():
         if _worker_runtime is not None:
+            if _worker_runtime.is_running:
+                # joined-host process: the API proxies to the head's
+                # ownership tables (single-controller; core.worker_api)
+                return _worker_runtime.api_client()
+            # falling through to init() here would silently spin up a
+            # phantom one-node head in a worker process, masking the
+            # cluster death — fail loudly instead
             raise RuntimeError(
-                "this process joined a cluster as a WORKER host "
-                "(init(address=...)); the task-submission API lives with the "
-                "head driver. Submit from the head, or run a separate driver "
-                "process against the head."
+                "this process joined a cluster as a WORKER host and its "
+                "runtime has shut down (head died or stop was requested); "
+                "the API is unavailable. Re-join with init(address=...) "
+                "once a head is reachable."
             )
         if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
+            client = _pool_worker_client()
+            if client is not None:
+                return client
             raise RuntimeError(
                 "the ray_tpu API is not available inside worker processes "
-                "(pool tasks / isolated actors): a worker-local runtime's "
-                "refs/handles would be meaningless to the driver. Return "
-                "plain values instead; for an actor that must drive the "
+                "(pool tasks / isolated actors) unless the cluster serves "
+                "a control-plane RPC endpoint (the head back-channel). "
+                "Start the head with system_config="
+                "{'control_plane_rpc_port': 0} to enable nested submission, "
+                "or return plain values; for an actor that must drive the "
                 "runtime (spawn tasks/actors), create it with "
                 "@ray_tpu.remote(in_process=True)."
             )
         init()
     return _cw.get_runtime()
+
+
+_pool_client = None  # WorkerAPIClient inside a pool-worker subprocess
+_pool_client_lock = __import__("threading").Lock()
+
+
+def _pool_worker_client():
+    """Lazy ownership back-channel for pool workers: the head address is
+    inherited through the environment (set by the head's init() / a
+    WorkerRuntime join); no address or unreachable head -> None and the
+    caller raises the explanatory error."""
+    global _pool_client
+    addr = os.environ.get("RAY_TPU_HEAD_ADDRESS")
+    if not addr:
+        return None
+    with _pool_client_lock:
+        if (
+            _pool_client is not None
+            and _pool_client.is_alive
+            and _pool_client.head_address == addr
+        ):
+            return _pool_client
+        from .core.wire import WireError
+        from .core.worker_api import WorkerAPIClient
+
+        if _pool_client is not None:
+            # dead connection (head restarted on the same port) or a new
+            # head address: close the old client, or its socket + reader +
+            # free threads leak once per runtime cycle
+            _pool_client.close()
+            _pool_client = None
+        try:
+            _pool_client = WorkerAPIClient(addr)
+        except (OSError, WireError, RuntimeError) as e:
+            # covers refused connects AND a reachable-but-dying head whose
+            # server answers proxy_job_id with an error (RuntimeError)
+            logger.warning("head back-channel %s unavailable: %s", addr, e)
+            return None
+        return _pool_client
 
 
 # ---------------------------------------------------------------------------

@@ -68,7 +68,17 @@ def _child_main(req_q, resp_q, log_dir: str = "") -> None:
     if kind != "init":
         return
     try:
-        cls, args, kwargs, concurrency, renv = pickle.loads(payload)
+        cls, args, kwargs, concurrency, renv, head_addr = pickle.loads(payload)
+        # the back-channel address travels in the payload, not the spawn
+        # env: the forkserver snapshots env at ITS start (see
+        # process_pool._worker_main), so inheritance is unreliable
+        if head_addr:
+            os.environ["RAY_TPU_HEAD_ADDRESS"] = head_addr
+        else:
+            # clear a stale forkserver-snapshot value (same staleness fix
+            # as process_pool._worker_main): no back-channel must mean the
+            # clear error, not a connect to a dead/reused port
+            os.environ.pop("RAY_TPU_HEAD_ADDRESS", None)
         from .runtime_env import applied
 
         ctx = applied(renv)
@@ -129,7 +139,7 @@ class ActorProcess:
         try:
             payload = _cloudpickle_dumps(
                 (cls, tuple(args), dict(kwargs or {}), max(1, max_concurrency),
-                 runtime_env)
+                 runtime_env, os.environ.get("RAY_TPU_HEAD_ADDRESS", ""))
             )
         except Exception as e:
             raise ActorNotSerializableError(repr(e)) from e

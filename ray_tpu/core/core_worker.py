@@ -56,6 +56,12 @@ class RayTaskError(Exception):
         self.task_name = task_name
         self.cause = cause
 
+    def __reduce__(self):
+        # default Exception pickling replays only the formatted message —
+        # the two-arg constructor then fails at LOAD time and the error
+        # degrades to a generic RuntimeError on the far side of the wire
+        return (RayTaskError, (self.task_name, self.cause))
+
 
 class RayActorError(Exception):
     pass
@@ -82,6 +88,14 @@ class ObjectRef:
     def __reduce__(self):
         # Crossing into a task: the receiving side resolves by id. Ownership
         # transfer bookkeeping is handled at submission time (deps list).
+        runtime = self._runtime
+        if runtime is not None:
+            note = getattr(runtime, "note_escaped", None)
+            if note is not None:
+                # proxy-client refs (worker_api): an id leaving this
+                # process may be deserialized long after our local count
+                # hits zero — exempt it from auto-free
+                note(self.object_id)
         return (_deserialize_ref, (self.object_id.binary(),))
 
     def __eq__(self, other):

@@ -92,17 +92,163 @@ def _load_exc(blob: Optional[bytes]) -> Optional[BaseException]:
 
 class HeadService:
     """The head runtime's served surface: the ControlPlane plus directory
-    methods, so worker hosts can publish/resolve object locations.
+    methods (worker hosts publish/resolve object locations) plus the
+    ``proxy_*`` ownership back-channel (code running ON a joined host
+    submits nested work through the head's ownership tables —
+    `worker_api.WorkerAPIClient` is the client; reference:
+    `core_worker.h :: CoreWorker` ownership, collapsed to
+    single-controller).
 
     Served by ``rpc.serve_control_plane`` in place of the bare ControlPlane
     (same duck surface — unknown attributes forward to the control plane)."""
 
+    # pins of clients that stop beating for this long are reaped (a pool
+    # worker SIGKILLed mid-task, a joined host that died without close())
+    PROXY_CLIENT_STALE_S = 90.0
+
     def __init__(self, runtime):
         self._runtime = runtime
         self.pubsub = runtime.control_plane.pubsub
+        # oid hex -> (client_id, pinned ObjectRef): results a REMOTE caller
+        # owns must survive the head's own GC until the caller releases
+        # them — or until the caller itself is declared dead (keepalive)
+        self._proxy_refs: Dict[str, Tuple[str, Any]] = {}
+        self._proxy_clients: Dict[str, float] = {}
+        self._proxy_lock = threading.Lock()
 
     def __getattr__(self, name: str):
         return getattr(self._runtime.control_plane, name)
+
+    # -- ownership back-channel (worker -> head) ----------------------------
+    def _pin(self, refs, client_id: str) -> List[str]:
+        hexes = [r.object_id.hex() for r in refs]
+        now = time.monotonic()
+        with self._proxy_lock:
+            self._proxy_clients[client_id] = now
+            for h, r in zip(hexes, refs):
+                self._proxy_refs[h] = (client_id, r)
+        self._reap_stale_clients(now)
+        return hexes
+
+    def _reap_stale_clients(self, now: float) -> None:
+        """Lazy sweep (no dedicated thread): any proxy call pays a cheap
+        staleness check. A dead client's pins drop; objects some OTHER
+        holder still references survive the head's refcount — the only
+        loss window is a returned ref whose consumer never deserialized
+        it before the producer died, which without a borrower protocol is
+        unknowable (module docstring in worker_api)."""
+        with self._proxy_lock:
+            stale = [c for c, ts in self._proxy_clients.items()
+                     if now - ts > self.PROXY_CLIENT_STALE_S]
+            if not stale:
+                return
+            dead = set(stale)
+            for c in stale:
+                self._proxy_clients.pop(c, None)
+            dropped = [h for h, (c, _r) in self._proxy_refs.items() if c in dead]
+            refs = [self._proxy_refs.pop(h) for h in dropped]
+        if dropped:
+            logger.info("reaped %d pinned objects of %d stale proxy clients",
+                        len(dropped), len(dead))
+        del refs
+
+    def proxy_keepalive(self, client_id: str) -> bool:
+        now = time.monotonic()
+        with self._proxy_lock:
+            self._proxy_clients[client_id] = now
+        self._reap_stale_clients(now)
+        return True
+
+    def proxy_job_id(self):
+        return self._runtime.job_id
+
+    def proxy_submit_task(self, spec_blob: bytes, client_id: str = "") -> List[str]:
+        spec = pickle.loads(spec_blob)
+        return self._pin(self._runtime.submit_task(spec), client_id)
+
+    def proxy_create_actor(self, blob: bytes) -> Tuple[str, str, str]:
+        cls, args, kwargs, options = pickle.loads(blob)
+        info = self._runtime.create_actor(cls, args, kwargs, options)
+        return info.actor_id.hex(), info.name or "", info.class_name
+
+    def proxy_submit_actor_task(
+        self, actor_id_hex: str, method_name: str,
+        payload_blob: bytes, opts_blob: bytes, client_id: str = "",
+    ) -> List[str]:
+        args, kwargs = pickle.loads(payload_blob)
+        options = pickle.loads(opts_blob)
+        return self._pin(self._runtime.submit_actor_task(
+            ActorID.from_hex(actor_id_hex), method_name, args, kwargs, options),
+            client_id)
+
+    def proxy_kill_actor(self, actor_id_hex: str, no_restart: bool) -> bool:
+        self._runtime.kill_actor(ActorID.from_hex(actor_id_hex),
+                                 no_restart=no_restart)
+        return True
+
+    def proxy_ref_state(self, oid_hexes: List[str]) -> Dict[str, dict]:
+        """Nonblocking tri-state per ref: pending | ready | error(+blob).
+        Failed tasks seal nothing — the error lives only in the head's
+        future table, so worker-side get() must ask here."""
+        out: Dict[str, dict] = {}
+        rt = self._runtime
+        for h in oid_hexes:
+            oid = ObjectID.from_hex(h)
+            with rt._lock:
+                fut = rt._futures.get(oid)
+            if fut is None:
+                state = "ready" if rt.directory.locations(oid) else "pending"
+                out[h] = {"state": state, "error_blob": None}
+            elif not fut.event.is_set():
+                out[h] = {"state": "pending", "error_blob": None}
+            elif fut.error is not None:
+                out[h] = {"state": "error", "error_blob": _dump_exc(fut.error)}
+            else:
+                out[h] = {"state": "ready", "error_blob": None}
+        return out
+
+    def proxy_put(self, oid_hex: str, value_blob: bytes, client_id: str = "") -> bool:
+        """Pool-worker put: no serving store on that side, so the value
+        lands in the head driver's store (one copy, then normal pulls)."""
+        from .core_worker import ObjectRef
+        from .object_store import seal_value
+
+        oid = ObjectID.from_hex(oid_hex)
+        agent = self._runtime.driver_agent
+        agent.store.put(oid, seal_value(pickle.loads(value_blob)))
+        self._runtime.directory.add_location(oid, agent.node_id)
+        self._pin([ObjectRef(oid, self._runtime)], client_id)
+        return True
+
+    def proxy_pin(self, oid_hex: str, client_id: str = "") -> bool:
+        """Pin a worker-sealed object (put() on a joined host): head-side
+        consumers' ref churn must not free it while the remote owner
+        still holds it."""
+        from .core_worker import ObjectRef
+
+        self._pin([ObjectRef(ObjectID.from_hex(oid_hex), self._runtime)],
+                  client_id)
+        return True
+
+    def proxy_free(self, oid_hexes: List[str]) -> bool:
+        with self._proxy_lock:
+            refs = [self._proxy_refs.pop(h, None) for h in oid_hexes]
+        # dropping the pinned refs hands the decision to the head's
+        # ReferenceCounter (other head-side holders keep the object alive)
+        del refs
+        return True
+
+    def proxy_get_value(self, oid_hex: str, timeout: float) -> bytes:
+        """Fallback get: the head resolves (incl. lineage reconstruction)
+        and ships the value back over the RPC socket. Direct transfer-plane
+        pulls are the primary path; this exists for holder-died races.
+        Blocks THIS connection's handler thread — clients call it on a
+        dedicated short-lived connection (worker_api._get_via_head)."""
+        from .core_worker import ObjectRef
+
+        ref = ObjectRef(ObjectID.from_hex(oid_hex), self._runtime)
+        value = self._runtime.get([ref], timeout=min(timeout, 60.0))[0]
+        return _dumps(value)
 
     # -- directory ops (worker -> head) ------------------------------------
     def dir_add_location(self, oid_hex: str, node_id_hex: str) -> bool:
@@ -224,6 +370,11 @@ class RemoteNodeAgent:
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
+        # callback-map mutations get their OWN mutex, never held across a
+        # socket op: the read loop must not park behind _send_lock (held
+        # across a blocking send_msg) or a full-buffer send could deadlock
+        # the whole dispatch plane four ways (head write <-> worker write)
+        self._cb_lock = threading.Lock()
         self._next_id = 0
         self._done_cbs: Dict[int, Callable[[TaskResult], None]] = {}
         self._stream_cbs: Dict[int, Callable] = {}
@@ -264,22 +415,39 @@ class RemoteNodeAgent:
                     continue
                 req_id = payload.get("id")
                 if "stream_item" in payload:
-                    scb = self._stream_cbs.get(req_id)
-                    if scb is not None:
-                        # completions queue keeps item order and the final
-                        # done strictly after the last item
-                        self._completions.put((
-                            lambda _r, _s=scb, _p=payload: _s(
-                                _p["stream_item"],
-                                ObjectID.from_hex(_p["oid_hex"])),
-                            None,
-                        ))
+                    with self._cb_lock:
+                        if self._stopped.is_set():
+                            continue
+                        scb = self._stream_cbs.get(req_id)
+                        if scb is not None:
+                            # enqueued UNDER the lock: the failure sweep
+                            # enqueues its sentinel under the same lock, so
+                            # no item can land after the sentinel and be
+                            # silently dropped by the completion loop
+                            self._completions.put((
+                                lambda _r, _s=scb, _p=payload: _s(
+                                    _p["stream_item"],
+                                    ObjectID.from_hex(_p["oid_hex"])),
+                                None,
+                            ))
                     continue
-                cb = self._done_cbs.pop(req_id, None)
-                self._stream_cbs.pop(req_id, None)
-                if cb is not None:
-                    self._completions.put((cb, self._to_task_result(payload)))
-                else:
+                # pop AND enqueue under _cb_lock, mirroring
+                # _fail_outstanding: a reply racing stop()/connection-drop
+                # must land in exactly one of (this delivery, the failure
+                # sweep) — never both, never neither (an enqueue outside
+                # the lock could land after the sweep's stop sentinel and
+                # never run)
+                delivered = False
+                with self._cb_lock:
+                    if self._stopped.is_set():
+                        continue
+                    cb = self._done_cbs.pop(req_id, None)
+                    self._stream_cbs.pop(req_id, None)
+                    if cb is not None:
+                        self._completions.put(
+                            (cb, self._to_task_result(payload)))
+                        delivered = True
+                if not delivered:
                     with self._reply_cv:
                         self._replies[req_id] = payload
                         self._reply_cv.notify_all()
@@ -294,21 +462,25 @@ class RemoteNodeAgent:
                 f"connection to node {self.node_id.hex()[:8]} lost"))
 
     def _fail_outstanding(self, error: BaseException) -> None:
-        # under _send_lock: _send registers callbacks under the same lock
+        # under _cb_lock: _send registers callbacks under the same lock
         # and checks _stopped first, so a registration either lands before
         # this snapshot (and is failed here) or observes _stopped and
         # raises — no callback can be silently dropped between the two
-        with self._send_lock:
+        with self._cb_lock:
             self._stopped.set()
             cbs = list(self._done_cbs.values())
             self._done_cbs.clear()
             self._stream_cbs.clear()
+            # sweep + sentinel enqueued under the SAME lock the read loop
+            # enqueues deliveries under: the sentinel is provably last, so
+            # the completion loop never exits with work still queued
+            for cb in cbs:
+                self._completions.put(
+                    (cb, TaskResult(task_id=None, ok=False, error=error)))
+            self._completions.put(None)  # drain, then stop the thread
         with self._reply_cv:
             self._replies[-1] = {"ok": False, "error": repr(error), "exc": None}
             self._reply_cv.notify_all()
-        for cb in cbs:
-            self._completions.put((cb, TaskResult(task_id=None, ok=False, error=error)))
-        self._completions.put(None)  # drain, then stop the completion thread
 
     @staticmethod
     def _to_task_result(payload: dict) -> TaskResult:
@@ -324,23 +496,30 @@ class RemoteNodeAgent:
     def _send(self, method: str, *, done: Optional[Callable] = None,
               stream: Optional[Callable] = None, **fields) -> int:
         with self._send_lock:
-            if self._stopped.is_set():
-                raise WorkerCrashedError(
-                    f"connection to node {self.node_id.hex()[:8]} lost")
-            self._next_id += 1
-            req_id = self._next_id
-            if done is not None:
-                self._done_cbs[req_id] = done
-            if stream is not None:
-                # registered BEFORE the frame ships: a stream item can
-                # race back before this method returns
-                self._stream_cbs[req_id] = stream
+            with self._cb_lock:
+                if self._stopped.is_set():
+                    raise WorkerCrashedError(
+                        f"connection to node {self.node_id.hex()[:8]} lost")
+                self._next_id += 1
+                req_id = self._next_id
+                if done is not None:
+                    self._done_cbs[req_id] = done
+                if stream is not None:
+                    # registered BEFORE the frame ships: a stream item can
+                    # race back before this method returns
+                    self._stream_cbs[req_id] = stream
             try:
                 send_msg(self._sock, MSG_REQUEST,
                          {"id": req_id, "method": method, **fields})
             except (WireError, OSError) as e:
-                self._done_cbs.pop(req_id, None)
-                self._stream_cbs.pop(req_id, None)
+                with self._cb_lock:
+                    had_done = self._done_cbs.pop(req_id, None) is not None
+                    self._stream_cbs.pop(req_id, None)
+                if done is not None and not had_done:
+                    # the failure sweep raced in and already swept this
+                    # callback into the completions queue: delivery is the
+                    # sweep's; raising would make the caller deliver TWICE
+                    return req_id
                 raise WorkerCrashedError(
                     f"dispatch to node {self.node_id.hex()[:8]} failed: {e}")
         return req_id
@@ -725,10 +904,11 @@ class WorkerRuntime:
     ``ray-tpu start --address=...``.
 
     This process is a WORKER, not a driver: the head owns scheduling and
-    object futures, so the task-submission API is unavailable here (the
-    reference allows drivers anywhere because every worker runs a full
-    CoreWorker with ownership; single-controller keeps ownership at the
-    head — SURVEY §7.1)."""
+    object futures (single-controller, SURVEY §7.1). The task-submission
+    API still works here — it proxies to the head's ownership tables over
+    the back-channel (``api_client()`` / `worker_api.WorkerAPIClient`),
+    mirroring the reference's every-worker-is-a-CoreWorker pattern without
+    giving up the single scheduler."""
 
     def __init__(
         self,
@@ -767,12 +947,37 @@ class WorkerRuntime:
         self.control_plane.kv_put(
             KV_PREFIX + self.node_id.hex(), self.transfer_server.address)
         self.control_plane.register_node(self.info)
+        self._api_client = None
+        self._api_client_lock = threading.Lock()
+        # pool-worker children inherit this and build their own back-channel
+        # client lazily on first API touch (api._auto_init)
+        import os as _os
+
+        _os.environ["RAY_TPU_HEAD_ADDRESS"] = address
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="worker-heartbeat"
         )
         self._hb_thread.start()
         logger.info("joined cluster at %s as node %s (%s)",
                     address, self.node_id.hex()[:8], node_resources)
+
+    def api_client(self):
+        """The ownership back-channel for code running in THIS process
+        (in-process tasks/actors on a joined host): a Runtime-duck client
+        proxying submissions to the head (see `worker_api`). Lazy — the
+        dedicated connection only exists if the API is actually used."""
+        with self._api_client_lock:
+            if self._api_client is None:
+                if self._stopped.is_set():
+                    raise RuntimeError("worker runtime is shut down")
+                from .worker_api import WorkerAPIClient
+
+                self._api_client = WorkerAPIClient(
+                    self.head_address,
+                    local_store=self.agent.store,
+                    local_node_id=self.node_id,
+                )
+            return self._api_client
 
     def _heartbeat_loop(self) -> None:
         period = config.health_check_period_ms / 1000.0
@@ -808,6 +1013,14 @@ class WorkerRuntime:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        import os as _os
+
+        if _os.environ.get("RAY_TPU_HEAD_ADDRESS") == self.head_address:
+            _os.environ.pop("RAY_TPU_HEAD_ADDRESS", None)
+        with self._api_client_lock:
+            if self._api_client is not None:
+                self._api_client.close()
+                self._api_client = None
         try:
             self.control_plane.kv_del(NODE_SERVICE_PREFIX + self.node_id.hex())
             self.control_plane.kv_del(KV_PREFIX + self.node_id.hex())

@@ -275,7 +275,17 @@ def _worker_main(store_name: str, req_q, resp_q, log_dir: str = "") -> None:
             return
         task_tag, payload, buffer_ids, inline = item
         try:
-            fn, args, kwargs, renv = _load(store, payload, buffer_ids, inline)
+            fn, args, kwargs, renv, head_addr = _load(
+                store, payload, buffer_ids, inline)
+            # per-TASK, not per-spawn: the forkserver snapshots the
+            # environment at ITS start, so a spawn-time address would be
+            # stale (or absent) whenever runtimes cycle in one parent —
+            # the back-channel (api._pool_worker_client) needs the address
+            # of the head that submitted THIS task
+            if head_addr:
+                os.environ["RAY_TPU_HEAD_ADDRESS"] = head_addr
+            else:
+                os.environ.pop("RAY_TPU_HEAD_ADDRESS", None)
             from .runtime_env import applied
 
             with applied(renv):
@@ -416,7 +426,10 @@ class ProcessPool:
             tag = uuid.uuid4().hex
             try:
                 payload, buffer_ids, inline = _dump(
-                    self.store, (fn, args, kwargs, renv), use_cloudpickle=True
+                    self.store,
+                    (fn, args, kwargs, renv,
+                     os.environ.get("RAY_TPU_HEAD_ADDRESS", "")),
+                    use_cloudpickle=True,
                 )
             except TaskNotSerializableError as e:
                 # genuinely unpicklable task (see _dump's phase-based

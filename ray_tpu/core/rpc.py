@@ -37,6 +37,12 @@ _ALLOWED_METHODS: Set[str] = {
     "kv_put", "kv_get", "kv_del", "kv_keys",
     # object-directory ops for joined worker hosts (cross_host.HeadService)
     "dir_add_location", "dir_remove_location", "dir_locations",
+    # ownership back-channel: nested submission from joined-host code
+    # (cross_host.HeadService proxy_*, worker_api.WorkerAPIClient)
+    "proxy_job_id", "proxy_submit_task", "proxy_create_actor",
+    "proxy_submit_actor_task", "proxy_kill_actor", "proxy_ref_state",
+    "proxy_put", "proxy_pin", "proxy_free", "proxy_get_value",
+    "proxy_keepalive",
 }
 
 

@@ -147,22 +147,134 @@ class TestCrossHostDispatch:
             ray_tpu.get(boom.remote(), timeout=60)
         assert isinstance(ei.value.cause, ValueError)
 
-    def test_worker_api_is_blocked_on_joined_host(self, head_with_worker):
-        rt, _ = head_with_worker
+    def test_nested_submission_from_joined_host(self, head_with_worker):
+        """VERDICT r4 #2 done-criterion: a task running ON a joined host
+        uses the full API — put/get/wait and spawning a CHILD task that
+        the head schedules — through the ownership back-channel
+        (core.worker_api; reference: every worker embeds a CoreWorker,
+        `core_worker.h`, collapsed here to proxy-to-head)."""
+        rt, proc = head_with_worker
 
-        # submitting FROM the worker host must fail loudly, not hang: the
-        # head owns scheduling (single-controller)
         @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1})
-        def try_submit():
+        def parent():
+            import os
+
             import ray_tpu as r
 
-            try:
-                r.put(1)
-                return "allowed"
-            except RuntimeError as e:
-                return "blocked" if "WORKER host" in str(e) else f"wrong: {e}"
+            @r.remote(num_cpus=0.1)
+            def child(x):
+                return x * 2, os.getpid()
 
-        assert ray_tpu.get(try_submit.remote(), timeout=60) == "blocked"
+            ref = r.put(21)
+            val, child_pid = r.get(child.remote(r.get(ref, timeout=30)),
+                                   timeout=60)
+            ready, pending = r.wait([r.put("a"), r.put("b")],
+                                    num_returns=2, timeout=10)
+            return {"val": val, "child_pid": child_pid,
+                    "my_pid": os.getpid(), "n_ready": len(ready)}
+
+        out = ray_tpu.get(parent.remote(), timeout=120)
+        assert out["val"] == 42
+        assert out["my_pid"] == proc.pid  # parent really ran remotely
+        # the child had num_cpus=0.1 (no magic): the head scheduled it on
+        # the head node — proof the submission crossed back
+        assert out["child_pid"] != out["my_pid"]
+        assert out["n_ready"] == 2
+
+    def test_nested_actor_and_error_from_joined_host(self, head_with_worker):
+        rt, proc = head_with_worker
+
+        @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1})
+        def drive():
+            import ray_tpu as r
+
+            @r.remote(num_cpus=0.1, in_process=True)
+            class Acc:
+                def __init__(self):
+                    self.n = 0
+
+                def add(self, k):
+                    self.n += k
+                    return self.n
+
+            a = Acc.remote()
+            assert r.get(a.add.remote(5), timeout=30) == 5
+            total = r.get(a.add.remote(7), timeout=30)
+
+            @r.remote(num_cpus=0.1, max_retries=0)
+            def boom():
+                raise ValueError("inner")
+
+            try:
+                r.get(boom.remote(), timeout=30)
+                err = "no-error"
+            except r.RayTaskError as e:
+                # the typed error crossed the wire intact, cause included
+                err = repr(e.cause)
+            return total, err
+
+        total, err = ray_tpu.get(drive.remote(), timeout=120)
+        assert total == 12
+        assert err == "ValueError('inner')"
+
+    def test_named_actor_handle_call_from_joined_host(self, head_with_worker):
+        """A joined-host task resolves a NAMED actor created by the head
+        driver and calls it — the serve model-composition shape (replica
+        on host A calls a deployment handle owned by the head)."""
+        rt, proc = head_with_worker
+
+        @ray_tpu.remote(num_cpus=0.1, in_process=True, name="xh-shared")
+        class Shared:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        a = Shared.remote()
+        assert ray_tpu.get(a.add.remote(1), timeout=60) == 1
+
+        @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1})
+        def use_named():
+            import ray_tpu as r
+
+            return r.get(r.get_actor("xh-shared").add.remote(10), timeout=30)
+
+        assert ray_tpu.get(use_named.remote(), timeout=120) == 11
+
+
+class TestPoolWorkerBackChannel:
+    def test_nested_submission_from_pool_worker(self):
+        """A POOL-worker task (isolated subprocess, the default executor
+        for stateless CPU tasks) reaches the head through the inherited
+        back-channel address and spawns nested work — the Data-UDF-calls-
+        get() shape from VERDICT r4 missing #1."""
+        rt = ray_tpu.init(
+            num_cpus=4, num_tpus=0,
+            system_config={"control_plane_rpc_port": 0, "worker_processes": 2},
+        )
+        try:
+            @ray_tpu.remote(num_cpus=1)
+            def parent():
+                import os
+
+                import ray_tpu as r
+
+                @r.remote(num_cpus=1)
+                def child(x):
+                    return x + 1
+
+                v = r.get(child.remote(r.get(r.put(41), timeout=30)),
+                          timeout=60)
+                return v, os.getpid(), bool(os.environ.get(
+                    "RAY_TPU_IN_POOL_WORKER"))
+
+            v, pid, in_pool = ray_tpu.get(parent.remote(), timeout=120)
+            assert v == 42
+            assert in_pool and pid != os.getpid()
+        finally:
+            ray_tpu.shutdown()
 
 
 class TestCrossHostFailure:
