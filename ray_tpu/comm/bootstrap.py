@@ -24,19 +24,30 @@ _COORD_KEY = "comm/coordinator/{gang}"
 
 
 def _control_plane():
-    """The cluster KV, from whichever runtime this process hosts: the head
-    driver's, or (on a joined worker host, cross_host.WorkerRuntime) the
-    remote control-plane client — train workers run in-process on TPU hosts
-    (they own the chips), so the rendezvous must work from both."""
+    """The cluster KV, from whichever runtime this process can reach: the
+    head driver's, a joined worker host's remote client
+    (cross_host.WorkerRuntime), or — in a dedicated actor/pool worker
+    process — the head back-channel (api._pool_worker_client). Train
+    workers run either in the device-owning runtime process (real TPU) or
+    in per-member actor processes (ScalingConfig.workers_in_process=False),
+    so the rendezvous must work from all three."""
     if _cw.runtime_initialized():
         return _cw.get_runtime().control_plane
     from .. import api
 
     if api._worker_runtime is not None:
         return api._worker_runtime.control_plane
+    client = (
+        api._pool_worker_client()
+        if os.environ.get("RAY_TPU_IN_POOL_WORKER")
+        else None
+    )
+    if client is not None:
+        return client.control_plane
     raise RuntimeError(
         "no runtime in this process: gang rendezvous needs the cluster KV "
-        "(head driver or a joined worker host)"
+        "(head driver, a joined worker host, or a worker process with the "
+        "head back-channel)"
     )
 
 

@@ -230,8 +230,13 @@ class HeadService:
                   client_id)
         return True
 
-    def proxy_free(self, oid_hexes: List[str]) -> bool:
+    def proxy_free(self, oid_hexes: List[str], client_id: str = "") -> bool:
         with self._proxy_lock:
+            if client_id:
+                # a free IS liveness: a client whose churn keeps the free
+                # batches flowing may send no explicit keepalive for
+                # minutes — it must not be reaped as stale mid-churn
+                self._proxy_clients[client_id] = time.monotonic()
             refs = [self._proxy_refs.pop(h, None) for h in oid_hexes]
         # dropping the pinned refs hands the decision to the head's
         # ReferenceCounter (other head-side holders keep the object alive)
@@ -342,6 +347,37 @@ class RemoteStoreProxy:
         self._transfer.close()
 
 
+class _RemoteResources:
+    """NodeAgent.resources duck for a remote node: placement-group
+    reservations acquire/release on the WORKER's own ledger over the
+    dispatch plane, so its heartbeats (and its local task accounting)
+    see them — the head holding a shadow ledger would desync the moment
+    the worker heartbeat overwrote it. (Reference: bundle resources
+    live in the raylet's local resource manager,
+    `cluster_resource_manager.cc`.)"""
+
+    def __init__(self, owner: "RemoteNodeAgent"):
+        self._owner = owner
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        try:
+            return bool(self._owner._call("try_acquire", demand=dict(demand)))
+        except (WorkerCrashedError, RuntimeError):
+            return False
+
+    def release(self, demand: Dict[str, float]) -> None:
+        try:
+            self._owner._call("release", demand=dict(demand))
+        except (WorkerCrashedError, RuntimeError):
+            pass  # node gone: its ledger died with it
+
+    def available(self) -> Dict[str, float]:
+        try:
+            return dict(self._owner._call("resources_available"))
+        except (WorkerCrashedError, RuntimeError):
+            return {}
+
+
 class RemoteNodeAgent:
     """Head-side proxy with NodeAgent's duck surface, dispatching to a
     WorkerNodeServer on another host.
@@ -362,6 +398,7 @@ class RemoteNodeAgent:
         self.transfer_addr = transfer_addr
         self._stopped = threading.Event()
         self.store = RemoteStoreProxy(self)
+        self.resources = _RemoteResources(self)
         host, _, port = node_service_addr.rpartition(":")
         self._sock = socket.create_connection((host, int(port)), timeout=10.0)
         # connect timeout only — the dispatch connection is long-lived and
@@ -860,6 +897,18 @@ class _WorkerDispatchHandler(socketserver.BaseRequestHandler):
         elif method == "store_delete":
             agent.store.delete(ObjectID.from_hex(req["oid_hex"]))
             reply({"id": req_id, "ok": True, "value": True})
+        elif method == "try_acquire":
+            # placement-group bundle reservation on THIS node's ledger
+            ok = agent.resources.try_acquire(req["demand"])
+            agent._sync_load()
+            reply({"id": req_id, "ok": True, "value": ok})
+        elif method == "release":
+            agent.resources.release(req["demand"])
+            agent._sync_load()
+            reply({"id": req_id, "ok": True, "value": True})
+        elif method == "resources_available":
+            reply({"id": req_id, "ok": True,
+                   "value": agent.resources.available()})
         elif method == "kill_running_tasks":
             agent.kill_running_tasks()
             reply({"id": req_id, "ok": True, "value": True})

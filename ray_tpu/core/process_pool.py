@@ -66,9 +66,16 @@ def register_inline_only_types(*types: type) -> None:
 class _TaskPickler(cloudpickle.CloudPickler):
     def reducer_override(self, obj):
         if _INLINE_ONLY_TYPES and isinstance(obj, _INLINE_ONLY_TYPES):
-            raise TaskNotSerializableError(
-                f"{type(obj).__name__} cannot cross the process boundary"
-            )
+            # With a head back-channel (worker_api), refs and handles ARE
+            # resolvable inside worker/actor processes — let them cross.
+            # Without one they would re-resolve against a meaningless
+            # private runtime: keep the strict inline-only contract.
+            if not os.environ.get("RAY_TPU_HEAD_ADDRESS"):
+                raise TaskNotSerializableError(
+                    f"{type(obj).__name__} cannot cross the process boundary "
+                    "(no head back-channel; start the head with "
+                    "system_config={'control_plane_rpc_port': 0})"
+                )
         return super().reducer_override(obj)
 
 

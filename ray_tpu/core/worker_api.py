@@ -172,7 +172,11 @@ class WorkerAPIClient:
                     pass
             try:
                 if batch:
-                    self._cp.proxy_free([o.hex() for o in batch])
+                    # frees carry the client id: they refresh head-side
+                    # liveness, so a busy-freeing client never starves
+                    # its own keepalive
+                    self._cp.proxy_free([o.hex() for o in batch],
+                                        self.client_id)
                     last_beat = time.monotonic()
                 elif time.monotonic() - last_beat >= KEEPALIVE_PERIOD_S:
                     self._cp.proxy_keepalive(self.client_id)

@@ -34,6 +34,12 @@ class ScalingConfig:
     # (real multi-host pods): wires jax.distributed via the control-plane
     # rendezvous. Local/test gangs share one process and one jax runtime.
     distributed_bootstrap: bool = False
+    # None -> the node agent's isolation default. True pins the gang member
+    # into the runtime (device-owning) process — the real-TPU shape, where
+    # libtpu belongs to the host runtime. False forces a dedicated actor
+    # process per member — fresh jax.distributed world per gang attempt,
+    # so restarts re-rendezvous cleanly (CPU-mesh pods, chaos tests).
+    workers_in_process: Optional[bool] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -126,6 +126,7 @@ class WorkerGroup:
                 bundle = self.pg.bundles[rank]
                 opts = dict(
                     max_concurrency=2,
+                    in_process=self.scaling.workers_in_process,
                     num_cpus=bundle.get("CPU", 0.0),
                     num_tpus=bundle.get("TPU", 0.0),
                     scheduling_strategy=PlacementGroupSchedulingStrategy(
@@ -135,6 +136,7 @@ class WorkerGroup:
             else:
                 opts = dict(
                     max_concurrency=2,
+                    in_process=self.scaling.workers_in_process,
                     num_cpus=res.get("CPU", 1.0),
                     num_tpus=res.get("TPU", 0.0),
                 )
