@@ -74,16 +74,33 @@ class EngineConfig:
     max_pages: int = 512  # total pages in the cache pool (incl. trash page)
     max_seq_len: int = 1024
     prefill_buckets: tuple = (64, 128, 256, 512, 1024)
-    # >1: queued prompts prefill together in padded batches (two compiled
-    # shapes per bucket: B=1 and B=prefill_batch_size). Helps high-QPS
-    # short-prompt fleets (one dispatch amortizes many prompts). Round-3
-    # measured batch=4 hurting TTFT ~2x — but that was WITH fixed span 16;
-    # combined with adaptive_span (below) batched prefill is the dominant
-    # TTFT win on bursty arrivals (r4, 24-req burst on v5e: pbs=8+busy=4
-    # gives p50 TTFT 1.15s and 5.8 req/s vs 2.40s / 4.4 req/s fixed).
-    # Default stays 1 (steady low-QPS serving pays padding for nothing);
-    # bursty deployments should raise it.
+    # >1: queued prompts prefill together in padded batches. Helps
+    # high-QPS short-prompt fleets (one dispatch amortizes many prompts).
+    # Round-3 measured batch=4 hurting TTFT ~2x — but that was WITH fixed
+    # span 16; combined with adaptive_span (below) batched prefill is the
+    # dominant TTFT win on bursty arrivals (r4, 24-req burst on v5e:
+    # pbs=8+busy=4 gives p50 TTFT 1.15s and 5.8 req/s vs 2.40s / 4.4
+    # req/s fixed). Default stays 1 (steady low-QPS serving pays padding
+    # for nothing); bursty deployments should raise it.
     prefill_batch_size: int = 1
+    # Burst tiers: with prefill_batch_size=K, padded batch shapes compile
+    # at {1, K, 2K, 4K, ...} up to this cap, and the prefill thread
+    # drains the WHOLE queue into one dispatch at the smallest covering
+    # tier. A 24-request burst then pays ONE [32, bucket] prefill instead
+    # of three serial [8, bucket] rounds with decode spans interleaving —
+    # p50 TTFT collapses to ~one prefill's latency (r5; the r4 shape was
+    # the three-round version). 0 disables tiering (K stays the cap).
+    prefill_max_batch: int = 32
+    # Chunked prefill (vLLM-style): prompts longer than prefill_chunk are
+    # processed in prefill_chunk-token chunks ON THE DECODE THREAD, one
+    # chunk per engine iteration with decode spans between — a long
+    # prompt never monopolizes the device, so running requests keep their
+    # inter-token latency AND the long prompt's KV lands straight in its
+    # pages (no separate scatter). Also lifts the bucket cap: prompts up
+    # to max_seq_len serve even past the largest compiled bucket. Must be
+    # a multiple of page_size.
+    chunked_prefill: bool = True
+    prefill_chunk: int = 256
     eos_token_id: Optional[int] = None
     cache_dtype: str = "bfloat16"
     # Decode steps per device dispatch (vLLM multi-step scheduling
@@ -118,6 +135,24 @@ class EngineConfig:
     def pages_per_seq(self) -> int:
         return -(-self.max_seq_len // self.page_size)
 
+    def prefill_tiers(self) -> List[int]:
+        """Compiled padded-batch sizes: {1, K, 2K, 4K, ...} capped at
+        prefill_max_batch. Bounded count (log2 of the cap) keeps compile
+        cost predictable while every burst size pads to <2x itself.
+        prefill_batch_size=1 means batching is OFF — tiers stay [1]
+        (steady low-QPS serving pays padding and per-tier compiles for
+        nothing; the r3 measurement that motivated this default)."""
+        K = max(1, self.prefill_batch_size)
+        if K == 1:
+            return [1]
+        cap = max(K, self.prefill_max_batch) if self.prefill_max_batch else K
+        tiers = {1, K}
+        t = K
+        while t < cap:
+            t *= 2
+            tiers.add(min(t, cap))
+        return sorted(tiers)
+
 
 @dataclasses.dataclass
 class Request:
@@ -139,6 +174,19 @@ class Request:
     def _emit(self, tok: Optional[int]) -> None:
         if self.stream_q is not None:
             self.stream_q.put(tok)
+
+
+class _ChunkState:
+    """One long prompt mid-chunked-prefill."""
+
+    __slots__ = ("request", "pages", "table", "true_len", "next_chunk")
+
+    def __init__(self, request: Request, pages: List[int], table, true_len: int):
+        self.request = request
+        self.pages = pages
+        self.table = table  # np [pages_per_seq]
+        self.true_len = true_len
+        self.next_chunk = 0
 
 
 class _Slot:
@@ -239,6 +287,14 @@ class InferenceEngine:
         self._prefill_inflight = 0
         self._decode = self._build_decode()
         self._prefill_cache: Dict[int, Any] = {}
+        self._chunk_fn = self._build_chunk_prefill()
+        # long-prompt chunk states, consumed one chunk per step() by the
+        # DECODE thread (chunk programs donate the same page pool the
+        # decode program does — two threads dispatching donated updates
+        # to one buffer would race; serializing on the decode thread is
+        # the TPU-static-shape form of vLLM's mixed prefill/decode sched)
+        self._chunk_queue: "list[_ChunkState]" = []
+        self._chunk_lock = threading.Lock()
 
     # ------------------------------------------------------------- compiled
 
@@ -345,6 +401,107 @@ class InferenceEngine:
 
         return for_span
 
+    def _build_chunk_prefill(self):
+        """Jit a C-token prefill chunk: compute the chunk's qkv, scatter
+        its KV into the sequence's pages, and attend q over the FULL
+        paged prefix (positions masked). One compiled shape serves every
+        chunk (partial tails pad to C). The attention is the XLA gather
+        path — correctness first; the Pallas chunk kernel can swap in
+        under the same signature."""
+        cfg, ecfg = self.cfg, self.ecfg
+        ps = ecfg.page_size
+        pps = ecfg.pages_per_seq
+        hd = cfg.hdim
+
+        def chunk_step(params, k_pages, v_pages, tokens, start, page_table,
+                       last_idx):
+            """tokens [C]; start/last_idx scalars; page_table [pps].
+            Returns (logits_at_last_idx, k_pages, v_pages)."""
+            dtype = jnp.dtype(cfg.dtype)
+            C = tokens.shape[0]
+            H, KVH = cfg.n_heads, cfg.kv_heads
+            groups = H // KVH
+            total = pps * ps
+            x = _embed_lookup(params["embed"], tokens[None, :], dtype,
+                              mesh=self.mesh)  # [1,C,D]
+            positions = start + jnp.arange(C)
+            if cfg.positional == "learned":
+                x = x + params["pos_emb"][positions][None].astype(dtype)
+                rope_tables = None
+            else:
+                rope_tables = rope_frequencies(
+                    cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
+            page_idx = page_table[positions // ps]  # [C]
+            slot_idx = positions % ps
+            # key j visible to query i iff j <= start + i (prefix + causal
+            # intra-chunk); pad tail positions past true_len write KV into
+            # allocated pages but are never selected by last_idx and are
+            # invisible to later decode (position bound)
+            key_pos = jnp.arange(total)
+            mask = key_pos[None, :] <= positions[:, None]  # [C, total]
+            scale = 1.0 / (hd ** 0.5)
+
+            def body(carry, xs):
+                x = carry
+                lp, kp, vp = xs  # kp/vp [KVH, P, ps, hd]
+                h = _norm(x, lp["ln1"], lp.get("ln1_b"), cfg)
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+                k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+                v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+                if cfg.positional == "rope":
+                    cos, sin = rope_tables
+                    q = apply_rope(q, cos, sin, positions[None])
+                    k = apply_rope(k, cos, sin, positions[None])
+                kp = kp.at[:, page_idx, slot_idx].set(
+                    k[0].transpose(1, 0, 2).astype(kp.dtype))
+                vp = vp.at[:, page_idx, slot_idx].set(
+                    v[0].transpose(1, 0, 2).astype(vp.dtype))
+                # gather THIS sequence's pages (chunk KV now included)
+                keys = kp[:, page_table].reshape(KVH, total, hd)
+                vals = vp[:, page_table].reshape(KVH, total, hd)
+                qh = q[0].reshape(C, KVH, groups, hd)
+                scores = jnp.einsum(
+                    "ckgh,kth->ckgt",
+                    qh.astype(jnp.float32), keys.astype(jnp.float32),
+                ) * scale
+                scores = jnp.where(mask[:, None, None, :], scores,
+                                   jnp.float32(-1e30))
+                p = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum("ckgt,kth->ckgh", p, vals.astype(jnp.float32))
+                o = o.reshape(C, H, hd).astype(dtype)
+                o = jnp.einsum("chk,hkd->cd", o, lp["wo"].astype(dtype))[None]
+                x = x + o
+                h = _norm(x, lp["ln2"], lp.get("ln2_b"), cfg)
+                if cfg.is_moe:
+                    y, _ = _moe_ffn(h, lp, cfg)
+                else:
+                    y = _dense_ffn(h, lp, cfg)
+                return x + y, (kp, vp)
+
+            x, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages)
+            )
+            x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg)
+            head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+            logits = jnp.einsum(
+                "d,dv->v",
+                x[0, last_idx].astype(jnp.float32), head.astype(jnp.float32),
+            )
+            if cfg.logits_softcap:
+                logits = cfg.logits_softcap * jnp.tanh(
+                    logits / cfg.logits_softcap)
+            return logits, new_k, new_v
+
+        cache: Dict[int, Any] = {}
+
+        def for_chunk(C: int):
+            if C not in cache:
+                cache[C] = self._under_mesh(jax.jit(
+                    chunk_step, donate_argnums=(1, 2)))
+            return cache[C]
+
+        return for_chunk
+
     def _under_mesh(self, fn):
         """Trace/execute under THIS engine's mesh context, so in-jit
         sharding constraints resolve against it — never against whatever
@@ -373,10 +530,10 @@ class InferenceEngine:
         """
         import numpy as _np
 
-        K = max(1, self.ecfg.prefill_batch_size)
         bucket_list = list(buckets) if buckets is not None else list(
             self.ecfg.prefill_buckets)
-        sizes = list(batch_sizes) if batch_sizes is not None else sorted({1, K})
+        sizes = (list(batch_sizes) if batch_sizes is not None
+                 else self.ecfg.prefill_tiers())
         for bucket in bucket_list:
             for Bp in sizes:
                 self._prefill_fn(bucket, Bp)(
@@ -399,6 +556,14 @@ class InferenceEngine:
                 jax.random.PRNGKey(0),
             )
             _np.asarray(seq)  # block until compiled + executed
+        if self.ecfg.chunked_prefill:
+            C = self.ecfg.prefill_chunk
+            logits, self.k_pages, self.v_pages = self._chunk_fn(C)(
+                self.params, self.k_pages, self.v_pages,
+                jnp.zeros((C,), jnp.int32), jnp.int32(0),
+                jnp.zeros((pps,), jnp.int32), jnp.int32(C - 1),
+            )
+            _np.asarray(logits)
 
     def _prefill_fn(self, bucket: int, batch: int = 1):
         key = (bucket, batch)
@@ -473,6 +638,9 @@ class InferenceEngine:
         with self._ready_lock:
             if self._ready:
                 return True
+        with self._chunk_lock:
+            if self._chunk_queue:
+                return True
         return any(s.request is not None for s in self.slots)
 
     def _loop(self):
@@ -506,7 +674,10 @@ class InferenceEngine:
             except queue.Empty:
                 continue
             batch = [req]
-            while len(batch) < max(1, self.ecfg.prefill_batch_size):
+            # drain the WHOLE burst (up to the largest compiled tier):
+            # one padded dispatch beats serial rounds for every waiter
+            drain_cap = self.ecfg.prefill_tiers()[-1]
+            while len(batch) < drain_cap:
                 try:
                     batch.append(self.pending.get_nowait())
                 except queue.Empty:
@@ -537,7 +708,8 @@ class InferenceEngine:
             self.pending.put(w)
 
     def _admit_for_prefill(self, req: Request):
-        """-> (pages, T, bucket) or None (deferred to _waiting / errored)."""
+        """-> (pages, T, bucket) or (pages, T, None) for the chunked path,
+        or None (deferred to _waiting / errored)."""
         T = len(req.prompt)
         total = T + req.max_tokens
         n_pages = -(-total // self.ecfg.page_size)
@@ -547,6 +719,10 @@ class InferenceEngine:
                 # no capacity now; revived by _maybe_finish when pages free
                 self._waiting.append(req)
                 return None
+        if self.ecfg.chunked_prefill and T > self.ecfg.prefill_chunk:
+            # long prompt: chunk on the decode thread (KV lands straight
+            # in pages); also serves prompts past the largest bucket
+            return pages, T, None
         bucket = next(
             (b for b in self.ecfg.prefill_buckets if b >= T),
             self.ecfg.prefill_buckets[-1],
@@ -554,7 +730,8 @@ class InferenceEngine:
         if T > bucket:
             self._free_pages_and_revive(pages)
             self._fail_request(
-                req, f"prompt length {T} exceeds largest bucket {bucket}"
+                req, f"prompt length {T} exceeds largest bucket {bucket} "
+                "(enable chunked_prefill to serve longer prompts)"
             )
             return None
         return pages, T, bucket
@@ -574,13 +751,23 @@ class InferenceEngine:
                 continue
             if out is not None:
                 admitted.append((req, *out))
+        chunked = [it for it in admitted if it[3] is None]
+        admitted = [it for it in admitted if it[3] is not None]
+        if chunked:
+            pps = self.ecfg.pages_per_seq
+            with self._chunk_lock:
+                for req, pages, T, _b in chunked:
+                    table = np.zeros((pps,), np.int32)
+                    table[: len(pages)] = pages
+                    self._chunk_queue.append(_ChunkState(req, pages, table, T))
+            self._work.set()  # the decode thread runs the chunks
         by_bucket: Dict[int, List[tuple]] = {}
         for item in admitted:
             by_bucket.setdefault(item[3], []).append(item)
-        K = max(1, self.ecfg.prefill_batch_size)
+        tiers = self.ecfg.prefill_tiers()
         for bucket, group in sorted(by_bucket.items()):
             try:
-                self._prefill_group(bucket, group, K)
+                self._prefill_group(bucket, group, tiers)
             except Exception as e:  # noqa: BLE001 — fail this group only
                 logger.warning("prefill failed for bucket %d", bucket,
                                exc_info=True)
@@ -589,9 +776,16 @@ class InferenceEngine:
                     if not req.done.is_set():
                         self._fail_request(req, f"prefill failed: {e!r}")
 
-    def _prefill_group(self, bucket: int, group: List[tuple], K: int) -> None:
+    def _prefill_group(self, bucket: int, group: List[tuple],
+                       tiers: List[int]) -> None:
         B = len(group)
-        Bpad = 1 if B == 1 else K  # bound compiled shapes to 2 per bucket
+        # smallest compiled tier covering the group; oversize groups split
+        # across dispatches at the largest tier
+        Bpad = next((t for t in tiers if t >= B), tiers[-1])
+        if B > Bpad:
+            self._prefill_group(bucket, group[:Bpad], tiers)
+            self._prefill_group(bucket, group[Bpad:], tiers)
+            return
         padded = np.zeros((Bpad, bucket), np.int32)
         lens = np.ones((Bpad,), np.int32)  # dummy rows: true_len 1
         for i, (req, _pages, T, _b) in enumerate(group):
@@ -637,7 +831,8 @@ class InferenceEngine:
                 if not self._ready or not free_slots:
                     return installed
                 req, pages, cache, T = self._ready.pop(0)
-            self._scatter_prefill(cache, pages, T)
+            if cache is not None:  # chunked prefills wrote pages directly
+                self._scatter_prefill(cache, pages, T)
             slot = free_slots[0]
             slot.request = req
             slot.pages = pages
@@ -649,20 +844,61 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- stepping
 
+    def _advance_chunk(self) -> bool:
+        """Run ONE prefill chunk of the oldest chunked request (decode
+        thread only — chunk programs donate the page pool). The next
+        decode span runs right after, so a long prompt and the running
+        batch interleave at chunk granularity (vLLM chunked prefill)."""
+        with self._chunk_lock:
+            if not self._chunk_queue:
+                return False
+            st = self._chunk_queue[0]
+        C = self.ecfg.prefill_chunk
+        start = st.next_chunk * C
+        toks = st.request.prompt[start:start + C]
+        padded = np.zeros((C,), np.int32)
+        padded[: len(toks)] = toks
+        is_last = start + C >= st.true_len
+        last_idx = (st.true_len - 1 - start) if is_last else C - 1
+        logits, self.k_pages, self.v_pages = self._chunk_fn(C)(
+            self.params, self.k_pages, self.v_pages, jnp.asarray(padded),
+            jnp.int32(start), jnp.asarray(st.table), jnp.int32(last_idx),
+        )
+        st.next_chunk += 1
+        if not is_last:
+            return True
+        with self._chunk_lock:
+            self._chunk_queue.pop(0)
+        req = st.request
+        first = _sample_host(np.asarray(logits), req.temperature)
+        now = time.monotonic()
+        req.first_token_at = now
+        _m_ttft.observe(now - req.submitted_at)
+        _m_tokens.inc()
+        req.output.append(int(first))
+        eos = self.ecfg.eos_token_id
+        if eos is None or int(first) != eos:
+            req._emit(int(first))
+        with self._ready_lock:
+            # cache=None: this prompt's KV is already in its pages
+            self._ready.append((req, st.pages, None, st.true_len))
+        return True
+
     def step(self) -> bool:
-        """One engine iteration: install finished prefills, then a K-step
-        decode span for the whole active batch (K = decode_span, or
-        busy_span under prefill pressure — at most two decode programs
-        ever compile). A slot that finishes
+        """One engine iteration: advance at most one prefill CHUNK, install
+        finished prefills, then a K-step decode span for the whole active
+        batch (K = decode_span, or busy_span under prefill pressure — at
+        most two decode programs ever compile). A slot that finishes
         mid-span keeps decoding to span end; its extra tokens are discarded
         by the host loop, and its extra KV writes are harmless — table
         entries past the allocated pages are 0 (the reserved trash page),
         and page frees happen on the host only after this span's readback,
         so no recycled page can be written. Returns True if work happened."""
+        chunked = self._advance_chunk()
         installed = self._install_ready()
         active = self._active()
         if not active:
-            return installed
+            return installed or chunked
 
         B = self.ecfg.max_batch_size
         pps = self.ecfg.pages_per_seq
@@ -682,7 +918,9 @@ class InferenceEngine:
         # dispatches and arriving requests get their first token (emitted
         # by the prefill program) without waiting out a long span.
         if self.ecfg.adaptive_span and (
-            self._prefill_inflight > 0 or not self.pending.empty()
+            self._prefill_inflight > 0
+            or not self.pending.empty()
+            or self._chunk_queue  # racy read is fine: pressure hint only
         ):
             span = max(1, self.ecfg.busy_span)
         else:

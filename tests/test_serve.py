@@ -291,6 +291,50 @@ class TestEngine:
         assert out["token_ids"] == [int(t) for t in np.asarray(ref)[0]]
         assert out["ttft_s"] >= 0
 
+    def test_chunked_prefill_matches_reference(self):
+        # T=40 > prefill_chunk=16: three decode-thread chunks write KV
+        # straight into pages; greedy output must equal models.generate.
+        # Also proves prompts PAST the largest bucket (32) now serve.
+        engine, params, cfg = self._engine(prefill_chunk=16)
+        prompt = [(i * 7) % 64 + 1 for i in range(40)]
+        out = engine.generate(prompt, max_tokens=8, temperature=0.0)
+        assert out["finish_reason"] == "length"
+        ref = generate(
+            params, cfg, jnp.asarray([prompt], jnp.int32),
+            jax.random.PRNGKey(0), max_new_tokens=8,
+        )
+        assert out["token_ids"] == [int(t) for t in np.asarray(ref)[0]]
+        engine.stop()
+
+    def test_chunked_prefill_interleaves_with_decode(self):
+        import threading as _threading
+
+        # a long prompt chunks while short requests keep decoding; every
+        # output must match the same engine serving them alone
+        engine, params, cfg = self._engine(prefill_chunk=16, decode_span=4)
+        long_prompt = [(i * 5) % 60 + 1 for i in range(40)]
+        shorts = [[1, 2, 3], [9, 8, 7]]
+        results = {}
+
+        def run(name, prompt):
+            results[name] = engine.generate(prompt, max_tokens=10,
+                                            temperature=0.0)
+
+        threads = [_threading.Thread(target=run, args=(f"s{i}", p))
+                   for i, p in enumerate(shorts)]
+        threads.append(_threading.Thread(target=run, args=("long", long_prompt)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        engine.stop()
+        solo, params2, cfg2 = self._engine(prefill_chunk=16)
+        for name, prompt in [("s0", shorts[0]), ("s1", shorts[1]),
+                             ("long", long_prompt)]:
+            ref = solo.generate(prompt, max_tokens=10, temperature=0.0)
+            assert results[name]["token_ids"] == ref["token_ids"], name
+        solo.stop()
+
     def test_continuous_batching_many_requests(self):
         engine, _, _ = self._engine()
         results = {}
