@@ -17,6 +17,7 @@ from .read_api import (  # noqa: F401
     range,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,

@@ -229,10 +229,15 @@ class StreamingExecutor:
     """Executes a LogicalPlan, yielding block ObjectRefs."""
 
     def __init__(self, plan: LogicalPlan, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
-                 max_in_flight_bytes: int = DEFAULT_MAX_IN_FLIGHT_BYTES):
+                 max_in_flight_bytes: int = DEFAULT_MAX_IN_FLIGHT_BYTES,
+                 _protected: Optional[set] = None):
         self.plan = plan
         self.max_in_flight = max_in_flight
         self.max_in_flight_bytes = max_in_flight_bytes
+        # ObjectIDs the PLAN owns (InputData blocks, incl. Union sub-plans):
+        # re-iteration resolves them again, so eager frees (shuffle rounds)
+        # must never touch them. Shared with sub-executors.
+        self._protected: set = set() if _protected is None else _protected
 
     def execute(self) -> Iterator[Any]:
         segments = fuse(self.plan)
@@ -247,13 +252,15 @@ class StreamingExecutor:
                     yield from t
             stream: Iterator[Any] = gen()
         elif isinstance(source, InputData):
+            self._protected.update(r.object_id for r in source.blocks)
             stream = iter(list(source.blocks))
         elif isinstance(source, Union):
             def gen_union():
                 for plan in source.plans:
                     yield from StreamingExecutor(
                         plan, self.max_in_flight,
-                        self.max_in_flight_bytes).execute()
+                        self.max_in_flight_bytes,
+                        _protected=self._protected).execute()
             stream = gen_union()
         else:
             raise TypeError(f"bad source {source}")
@@ -393,21 +400,71 @@ class StreamingExecutor:
     # -- all-to-all barriers -------------------------------------------------
 
     def _shuffle(self, upstream: Iterator[Any], seed: Optional[int]) -> Iterator[Any]:
-        """Two-phase push shuffle: split each block n-ways, re-concat."""
+        """Staged push shuffle with bounded intermediates (reference:
+        `data/_internal/planner/push_based_shuffle.py` map+merge rounds).
+
+        Rounds of W source blocks at a time: each round splits its blocks
+        n-ways, MERGES the pieces into per-partition running partials, and
+        then EXPLICITLY frees the round's sources and pieces (api._free —
+        lineage records would otherwise pin them until the last output is
+        consumed, making peak residency ~everything). Peak is therefore
+        ~1x the dataset (the partials) plus one round's pieces (W * avg
+        block, sized to the stage byte budget). The incremental merge
+        re-copies each partition n/W times — the classic push-shuffle
+        trade of copies for bounded memory."""
         refs = list(upstream)
         n = len(refs)
         rng = random.Random(seed)
         if n <= 1:
             out = refs
         else:
-            split_refs = [
-                _split_block.options(num_returns=n).remote(r, n) for r in refs
-            ]
-            out = []
-            for j in range(n):
-                shard = [split_refs[i][j] for i in range(n)]
-                rng.shuffle(shard)
-                out.append(_concat_blocks.remote(*shard))
+            partials: List[Optional[Any]] = [None] * n
+            window = max(1, min(self.max_in_flight, n))
+            i = 0
+            avg_block: Optional[float] = None
+            while i < n:
+                if avg_block:
+                    # size each round to the stage budget: a round's pieces
+                    # total ~W blocks of source bytes
+                    window = max(1, min(
+                        self.max_in_flight,
+                        int(self.max_in_flight_bytes // max(avg_block, 1.0)),
+                    ))
+                round_refs = refs[i:i + window]
+                # pin sizes BEFORE the sources are freed
+                sizes = [_block_meta.remote(r) for r in round_refs]
+                split_refs = [
+                    _split_block.options(num_returns=n).remote(r, n)
+                    for r in round_refs
+                ]
+                old_partials: List[Any] = []
+                for j in range(n):
+                    pieces = [s[j] for s in split_refs]
+                    rng.shuffle(pieces)
+                    if partials[j] is not None:
+                        old_partials.append(partials[j])
+                        pieces = [partials[j], *pieces]
+                    partials[j] = _concat_blocks.remote(*pieces)
+                # barrier per round: merges must finish before the next
+                # round's pieces land, or rounds pile up unboundedly
+                api.wait([p for p in partials if p is not None],
+                         num_returns=n, timeout=None)
+                metas = api.get(sizes)
+                # consumed for good: splits are done (sources) and merges
+                # are done (pieces, superseded partials) — free now, or
+                # lineage parks them until the final consumer
+                api._free([s[j] for s in split_refs for j in range(n)])
+                api._free(old_partials)
+                # plan-owned blocks (InputData, possibly through a
+                # pass-through stage like Limit) must survive re-iteration;
+                # anything this execution produced is consumed for good
+                api._free([r for r in round_refs
+                           if r.object_id not in self._protected])
+                for k in range(len(round_refs)):
+                    refs[i + k] = None
+                avg_block = sum(m[1] for m in metas) / max(len(metas), 1)
+                i += len(round_refs)
+            out = [p for p in partials if p is not None]
             rng.shuffle(out)
 
         def gen():
@@ -415,6 +472,7 @@ class StreamingExecutor:
             for i, ref in enumerate(out):
                 s = None if seed is None else seed + i
                 yield _run_stage.remote(_permute_rows(s), ref)
+                out[i] = None  # consumed: the driver drops its ref
         return gen()
 
     def _repartition(self, upstream: Iterator[Any], num_blocks: int) -> Iterator[Any]:

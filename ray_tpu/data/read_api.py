@@ -198,6 +198,92 @@ def read_numpy(paths) -> Dataset:
     return _make([make_task(f) for f in files], "read_numpy")
 
 
+_IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images(
+    paths,
+    *,
+    size: Optional[tuple] = None,
+    mode: str = "RGB",
+    include_paths: bool = False,
+    files_per_block: int = 64,
+    parallelism: int = -1,
+) -> Dataset:
+    """Decode image files into numpy blocks (reference:
+    `data/datasource/image_datasource.py :: ImageDatasource` +
+    `read_api.py :: read_images`).
+
+    size: (H, W) resize target. With size set, each block's "image" column
+    is one dense [N, H, W, C] uint8 array — ready for a device batch (the
+    ViT/CLIP ingest shape, BASELINE.md workload #4). Without it, images
+    keep native sizes in an object array.
+    mode: PIL conversion mode ("RGB", "L", ...).
+    files_per_block: decoded images per emitted BLOCK (batch granularity).
+    parallelism: read tasks to split the file list across (cluster-level
+    concurrency; default caps at 16). The two knobs are independent: a
+    task whose shard spans several blocks streams each block out as it
+    decodes, so the first batch reaches the consumer while the rest of
+    the shard is still reading.
+    """
+    import builtins
+
+    files: List[str] = []
+    if isinstance(paths, str):
+        paths = [paths]
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            files.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "*"))
+                if f.lower().endswith(_IMAGE_SUFFIXES)))
+        elif any(c in p for c in "*?["):
+            files.extend(sorted(_glob.glob(p)))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no image files matched {paths}")
+
+    def decode(path: str) -> np.ndarray:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert(mode)
+            if size is not None:
+                im = im.resize((size[1], size[0]))  # PIL takes (W, H)
+            return np.asarray(im)
+
+    def make_task(shard: List[str]):
+        def task():
+            for lo in builtins.range(0, len(shard), files_per_block):
+                chunk = shard[lo:lo + files_per_block]
+                imgs = [decode(f) for f in chunk]
+                if size is not None:
+                    col = np.stack(imgs)  # [N, H, W, C] dense
+                else:
+                    col = np.empty(len(imgs), dtype=object)
+                    for i, im in enumerate(imgs):
+                        col[i] = im
+                block: Dict[str, Any] = {"image": col}
+                if include_paths:
+                    block["path"] = np.asarray(chunk, dtype=object)
+                yield block
+        task.streaming = True
+        return task
+
+    # tasks parallelize across the cluster; blocks stream out of each
+    # task as they decode
+    n = len(files)
+    if parallelism <= 0:
+        parallelism = max(1, min(16, -(-n // files_per_block)))
+    parallelism = min(parallelism, n)
+    cuts = [n * i // parallelism for i in builtins.range(parallelism + 1)]
+    shards = [files[cuts[i]:cuts[i + 1]]
+              for i in builtins.range(parallelism)]
+    return _make([make_task(s) for s in shards if s], "read_images",
+                 num_rows=n)
+
+
 def read_binary_files(paths, *, suffix: str = "") -> Dataset:
     files = _expand_paths(paths, suffix)
 

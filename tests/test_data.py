@@ -394,3 +394,120 @@ class TestBackpressureAndActorPool:
 
         with pytest.raises(ValueError, match="actors"):
             data.range(10).map_batches(C, compute="tasks")
+
+
+class TestReadImages:
+    """read_images datasource (reference: data/datasource/image_datasource.py
+    + read_api.read_images) — BASELINE.md workload #4's ingest shape."""
+
+    @pytest.fixture
+    def image_dir(self, tmp_path):
+        from PIL import Image
+
+        d = tmp_path / "imgs"
+        d.mkdir()
+        rng = np.random.default_rng(0)
+        for i in range(12):
+            arr = rng.integers(0, 255, size=(20 + i, 24 + i, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i:03d}.png")
+        return str(d)
+
+    def test_resized_dense_batches(self, ray_start_regular, image_dir):
+        ds = data.read_images(image_dir, size=(16, 16), files_per_block=4)
+        assert ds.count() == 12
+        batches = list(ds.iter_batches(batch_size=6))
+        assert len(batches) == 2
+        for b in batches:
+            assert b["image"].shape == (6, 16, 16, 3)
+            assert b["image"].dtype == np.uint8
+
+    def test_native_sizes_and_paths(self, ray_start_regular, image_dir):
+        ds = data.read_images(image_dir, include_paths=True,
+                              files_per_block=5)
+        rows = ds.take_all()
+        assert len(rows) == 12
+        shapes = {r["image"].shape for r in rows}
+        assert len(shapes) == 12  # every image kept its native size
+        assert all(r["path"].endswith(".png") for r in rows)
+
+    def test_decode_resize_normalize_pipeline(self, ray_start_regular, image_dir):
+        # the ViT ingest chain: decode -> resize -> normalize -> device batch
+        ds = data.read_images(image_dir, size=(8, 8)).map_batches(
+            lambda b: {"x": b["image"].astype(np.float32) / 255.0})
+        total = 0
+        for b in ds.iter_batches(batch_size=4):
+            assert b["x"].shape == (4, 8, 8, 3)
+            assert float(b["x"].max()) <= 1.0
+            total += len(b["x"])
+        assert total == 12
+
+    def test_grayscale_mode(self, ray_start_regular, image_dir):
+        ds = data.read_images(image_dir, size=(10, 10), mode="L")
+        b = next(iter(ds.iter_batches(batch_size=12)))
+        assert b["image"].shape == (12, 10, 10)
+
+
+class TestBoundedShuffle:
+    """Staged push shuffle (reference:
+    data/_internal/planner/push_based_shuffle.py): intermediates are
+    freed round by round, so peak store residency stays ~1x the dataset
+    plus one byte-budgeted round — not sources+pieces+outputs parked at
+    once (VERDICT r4 weak #3)."""
+
+    def _store_bytes(self, rt):
+        total = 0
+        for agent in rt.agents.values():
+            store = getattr(agent, "store", None)
+            if hasattr(store, "list_objects"):
+                total += sum(n for _oid, n in store.list_objects())
+        return total
+
+    def test_peak_residency_bounded(self, ray_start_regular):
+        from ray_tpu.core import core_worker as _cw
+
+        rt = _cw.get_runtime()
+        n_blocks, rows = 24, 4000
+        row_bytes = 8  # int64 id
+        dataset_bytes = n_blocks * rows * row_bytes
+        budget = 4 * rows * row_bytes  # ~4 blocks per round
+
+        base = self._store_bytes(rt)
+        ds = data.range(n_blocks * rows, parallelism=n_blocks).random_shuffle(
+            seed=3)
+        from ray_tpu.data.executor import StreamingExecutor
+
+        ex = StreamingExecutor(ds._plan, max_in_flight=8,
+                               max_in_flight_bytes=budget)
+        peak = 0
+        seen = 0
+        for ref in ex.execute():
+            block = ray_get(ref, timeout=60)
+            seen += len(block["id"])
+            peak = max(peak, self._store_bytes(rt) - base)
+            del ref, block
+        assert seen == n_blocks * rows
+        # naive barrier parks ~2-3x dataset (sources + n^2 pieces +
+        # outputs); staged rounds must stay well under 2x
+        assert peak < 1.8 * dataset_bytes, (peak, dataset_bytes)
+
+    def test_shuffle_correct_after_staging(self, ray_start_regular):
+        ds = data.range(3000, parallelism=12).random_shuffle(seed=11)
+        ids = [r["id"] for r in ds.take_all()]
+        assert sorted(ids) == list(range(3000))
+        assert ids[:20] != list(range(20))
+
+    def test_intermediates_freed_after_consume(self, ray_start_regular):
+        from ray_tpu.core import core_worker as _cw
+
+        rt = _cw.get_runtime()
+        base = self._store_bytes(rt)
+        ds = data.range(20_000, parallelism=10).random_shuffle(seed=1)
+        rows = ds.take_all()
+        assert len(rows) == 20_000
+        del rows, ds
+        import gc
+
+        gc.collect()
+        # everything the shuffle made is gone once nothing references it
+        leaked = self._store_bytes(rt) - base
+        assert leaked < 200_000, leaked
