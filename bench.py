@@ -289,9 +289,114 @@ def bench_train(model=None, batch=None, seq=None, steps=None, span=None,
             )
 
 
+def bench_moe() -> None:
+    """MoE train gate (BASELINE.md workload #3): tokens/s on moe-1b (8
+    experts top-2) plus expert-dispatch overhead % — the moe step vs a
+    DENSE twin with d_ff = top_k * d_ff (identical active FFN flops and
+    attention), so the delta is routing + gather/scatter cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.comm.mesh import MeshSpec, build_mesh, set_mesh
+    from ray_tpu.models import get_config
+    from ray_tpu.train.lm import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    batch, seq, steps = 2, 1024, 8
+    mesh = build_mesh(MeshSpec.create(dp=-1), devices=jax.devices())
+    set_mesh(mesh)
+
+    def run(cfg) -> float:
+        """-> steady-state seconds per step (fwd+bwd+opt)."""
+        opt = make_optimizer(total_steps=steps + 20, factored=True)
+        state, _ = init_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+        state["params"] = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x,
+            state["params"],
+        )
+        step_fn = jax.jit(make_train_step(cfg, opt), donate_argnums=0)
+        data = synthetic_batch(cfg, batch, seq)
+        with mesh:
+            for _ in range(2):
+                state, metrics = step_fn(state, data)
+            float(metrics["loss"])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step_fn(state, data)
+            float(metrics["loss"])
+            dt = time.perf_counter() - t0
+        del state
+        return dt / steps
+
+    moe_cfg = get_config("moe-1b")
+    t_moe = run(moe_cfg)
+    # dense twin: same attention/backbone, d_ff = selected * d_ff, no router
+    dense_cfg = get_config(
+        "llama-600m",
+        n_layers=moe_cfg.n_layers, d_model=moe_cfg.d_model,
+        n_heads=moe_cfg.n_heads, n_kv_heads=moe_cfg.n_kv_heads,
+        head_dim=moe_cfg.head_dim,
+        d_ff=moe_cfg.num_selected_experts * moe_cfg.d_ff,
+    )
+    t_dense = run(dense_cfg)
+    overhead_pct = 100.0 * max(t_moe - t_dense, 0.0) / t_moe
+    toks_per_sec = batch * seq / t_moe
+    print(
+        f"# moe: model=moe-1b batch={batch} seq={seq} t_moe={t_moe * 1e3:.0f}ms "
+        f"t_dense_twin={t_dense * 1e3:.0f}ms",
+        file=sys.stderr,
+    )
+    _emit("train_tokens_per_sec_moe_1b", toks_per_sec, "tokens/s",
+          "bench_anchor_moe_1b")
+    _emit("moe_dispatch_overhead_pct", overhead_pct, "%",
+          "moe_overhead_anchor", lower_is_better=True)
+
+
+def bench_grpo() -> None:
+    """RLHF gate (BASELINE.md workload #5): GRPO rollout->update pipeline
+    samples/s on the flagship model (group_size completions sampled
+    on-device per iteration, one jitted policy update)."""
+    import jax
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.rl.grpo import GRPO, GRPOConfig
+
+    cfg = get_config("llama-600m")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    gcfg = GRPOConfig(group_size=8, max_new_tokens=16, temperature=1.0,
+                      factored=True)
+
+    def reward(prompt_ids, completion_ids) -> float:
+        # cheap deterministic reward: unique-token ratio (the harness
+        # measures pipeline throughput, not alignment)
+        return len(set(completion_ids)) / max(len(completion_ids), 1)
+
+    algo = GRPO(params, cfg, reward, gcfg)
+    prompt = list(range(1, 33))
+    algo.train_step(prompt)  # compile rollout + logp + update
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = algo.train_step(prompt)
+    dt = time.perf_counter() - t0
+    samples_per_sec = gcfg.group_size * iters / dt
+    print(
+        f"# grpo: model=llama-600m group={gcfg.group_size} "
+        f"new_tokens={gcfg.max_new_tokens} iters={iters} dt={dt:.2f}s "
+        f"reward_mean={out['reward_mean']:.3f}",
+        file=sys.stderr,
+    )
+    _emit("grpo_samples_per_sec", samples_per_sec, "samples/s", "grpo_anchor")
+
+
 def main() -> None:
     suite = os.environ.get(
-        "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data")
+        "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data,moe,grpo")
     wanted = {s.strip() for s in suite.split(",") if s.strip()}
     model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
     # Ordering is deliberate: serve FIRST — its p50-TTFT criterion is
@@ -312,6 +417,12 @@ def main() -> None:
         # (bench_anchor_llama_2b) and must not inherit env overrides.
         bench_train(model="llama-2b", batch=4, seq=2048, steps=8, span=4,
                     factored=True, bf16_params=True)
+    # north-star workloads #3 (MoE) and #5 (RLHF) run LAST: their HBM
+    # churn must not precede the latency-sensitive serve gate
+    if "moe" in wanted:
+        bench_moe()
+    if "grpo" in wanted:
+        bench_grpo()
 
 
 if __name__ == "__main__":

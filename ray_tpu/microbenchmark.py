@@ -41,7 +41,7 @@ def bench_tasks_sync(api, batch: int = 1, min_seconds: float = 2.0) -> float:
     def run():
         if batch == 1:
             for _ in range(50):
-                api.get(nop.remote())
+                api.get(nop.remote(), timeout=60)
             return 50
         api.get([nop.remote() for _ in range(batch)])
         return batch
@@ -138,6 +138,101 @@ def bench_put_get(api, nbytes: int = 1024, min_seconds: float = 2.0) -> float:
     return _timeit(run, min_seconds)
 
 
+def bench_cross_host(api, min_seconds: float = 2.0) -> List[tuple]:
+    """Cross-host dispatch plane (VERDICT r4 weak #8): RemoteNodeAgent
+    submit round-trip rate/latency and transfer-plane pull MB/s against a
+    REAL joined worker OS process. These are the numbers that decide
+    whether 8-host orchestration overhead is noise or bottleneck
+    (reference: `_private/ray_perf.py` multi-node patterns)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    import time as _time
+
+    api.shutdown()  # the dispatch plane needs the RPC-serving head
+    rt = api.init(num_cpus=1, num_tpus=0, system_config={
+        "control_plane_rpc_port": 0, "worker_processes": 0})
+    addr = rt._cp_server.address
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_WORKER_PROCESSES"] = "0"
+    env.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+    # the joiner must import THIS checkout regardless of the caller's cwd
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+    code = textwrap.dedent(f"""
+        import ray_tpu
+        w = ray_tpu.init(address={addr!r}, num_cpus=4, num_tpus=0,
+                         resources={{"xbench": 1.0}})
+        w.wait(timeout=600)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+    deadline = _time.monotonic() + 60
+    joined = False
+    while _time.monotonic() < deadline:
+        if any("xbench" in n.resources_total
+               for n in rt.control_plane.alive_nodes()):
+            joined = True
+            break
+        if proc.poll() is not None:
+            break
+        _time.sleep(0.1)
+    if not joined:
+        proc.kill()
+        raise RuntimeError(
+            "cross-host bench worker never joined "
+            f"(exit={proc.poll()}); cannot measure the dispatch plane")
+
+    @api.remote(num_cpus=0, resources={"xbench": 0.01})
+    def nop():
+        return 0
+
+    @api.remote(num_cpus=0, resources={"xbench": 0.01})
+    def blob(n):
+        return b"x" * n
+
+    try:
+        def sync_run():
+            for _ in range(20):
+                api.get(nop.remote(), timeout=60)
+            return 20
+
+        sync_rate = _timeit(sync_run, min_seconds)
+
+        def batch_run():
+            api.get([nop.remote() for _ in range(64)], timeout=120)
+            return 64
+
+        batch_rate = _timeit(batch_run, min_seconds)
+
+        nbytes = 4 << 20
+        ref = blob.remote(nbytes)
+        api.get(ref, timeout=60)  # produced; every further get is a fresh pull
+
+        def pull_run():
+            for _ in range(4):
+                api.get(ref, timeout=60)
+            return 4
+
+        pulls_per_s = _timeit(pull_run, min_seconds)
+        return [
+            ("xhost_task_roundtrip", sync_rate, "tasks/s"),
+            ("xhost_task_rtt_ms", 1000.0 / max(sync_rate, 1e-9), "ms"),
+            ("xhost_task_batch_64", batch_rate, "tasks/s"),
+            ("xhost_pull_mb_s", pulls_per_s * nbytes / (1 << 20), "MB/s"),
+        ]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def run_all(min_seconds: float = 2.0) -> List[Dict[str, Any]]:
     import ray_tpu as api
 
@@ -153,6 +248,8 @@ def run_all(min_seconds: float = 2.0) -> List[Dict[str, Any]]:
         ("put_get_1kb", bench_put_get(api, 1024, min_seconds=s), "ops/s"),
         ("put_get_1mb", bench_put_get(api, 1 << 20, min_seconds=s), "ops/s"),
     ]
+    # cross-host plane LAST: it recycles the runtime (RPC-serving head)
+    rows.extend(bench_cross_host(api, min_seconds=s))
     out = []
     for name, value, unit in rows:
         rec = {"metric": f"micro_{name}", "value": round(value, 1), "unit": unit}

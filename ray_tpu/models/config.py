@@ -135,6 +135,23 @@ register(ModelConfig(
 ))
 
 register(ModelConfig(
+    name="moe-1b",
+    # Single-chip MoE bench config (BASELINE.md workload #3's measurable
+    # stand-in for mixtral-8x7b): llama-600m's attention backbone, 8
+    # experts top-2 — ~1.3B total params, ~0.45B active per token. With
+    # factored optimizer + bf16 params it fits one 16GB v5e chip, so the
+    # expert-dispatch path (capacity-factor einsums -> all_to_all on ep
+    # meshes) gets a real tokens/s + overhead%% gate.
+    vocab_size=32000,
+    d_model=1536, n_layers=8, n_heads=12, n_kv_heads=4,
+    head_dim=128, d_ff=4096,
+    max_seq_len=4096,
+    num_experts=8, num_selected_experts=2,
+    norm="rmsnorm", activation="swiglu", positional="rope",
+    rope_theta=500000.0,
+))
+
+register(ModelConfig(
     name="llama-2b",
     # ~2B Llama-3 family member: the single-chip scale stepping stone
     # toward llama3-8b (BASELINE.md workload #2). remat (on by default)

@@ -34,6 +34,11 @@ class GRPOConfig:
     kl_coef: float = 0.02
     clip_eps: float = 0.2
     seed: int = 0
+    # adafactor instead of adam: policy + frozen reference + adam moments
+    # is ~4x params of resident f32 — factored second moments are what
+    # fit a 600M+ policy on one 16GB chip (same trap notes as
+    # train.lm.make_optimizer)
+    factored: bool = False
 
 
 class GRPO:
@@ -51,7 +56,13 @@ class GRPO:
         self.cfg = model_cfg
         self.reward_fn = reward_fn
         self.gcfg = config or GRPOConfig()
-        self.optimizer = optax.adam(self.gcfg.lr)
+        if self.gcfg.factored:
+            self.optimizer = optax.adafactor(
+                self.gcfg.lr, weight_decay_rate=None,
+                multiply_by_parameter_scale=False,
+            )
+        else:
+            self.optimizer = optax.adam(self.gcfg.lr)
         self.opt_state = self.optimizer.init(params)
         self.iteration = 0
         self._update = self._build_update()
@@ -87,7 +98,8 @@ class GRPO:
         @jax.jit
         def update(params, opt_state, batch):
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-            updates, opt_state = self.optimizer.update(grads, opt_state)
+            # params threaded through: factored transforms need them
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             aux["loss"] = loss
             return params, opt_state, aux

@@ -12,13 +12,13 @@ from ray_tpu.util import state as state_api
 class TestPatterns:
     def test_all_patterns_report_positive_rates(self, ray_start_regular, capsys):
         rows = mb.run_all(min_seconds=0.2)
-        assert len(rows) == 8
+        assert len(rows) == 12
         for rec in rows:
             assert rec["value"] > 0, rec
             assert rec["metric"].startswith("micro_")
         # one JSON line per pattern on stdout (the CLI contract)
         lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
-        assert len(lines) == 8
+        assert len(lines) == 12
         for ln in lines:
             json.loads(ln)
 
