@@ -1,0 +1,265 @@
+"""Distributed SPSC channels for compiled graphs.
+
+Reference analogue: `python/ray/experimental/channel/` — the compiled
+DAG's transport (`shared_memory_channel.py` intra-node,
+`torch_tensor_nccl_channel.py` cross-node). TPU-native shape: a channel
+is HOMED in its consumer's process as a plain bounded queue (the hot
+read path is a local dequeue, no syscall); remote producers push frames
+over a persistent TCP connection to the owner process's ChannelService.
+Device arrays do NOT ride these channels — compiled-graph values are
+host objects; intra-slice tensors move as jax arrays over ICI inside the
+actors themselves (SURVEY §7.4.5).
+
+Why consumer-homed: the consumer blocks in get() at pipeline cadence —
+that must never pay a round trip. The producer's put() pays the hop, and
+its blocking-put backpressure travels as a delayed RPC reply, so a full
+downstream queue stalls exactly the producer lane that feeds it (the
+reference's bounded-channel semantics).
+
+A `DistChannel` pickles as (owner_addr, chan_id, maxsize) and
+reconstructs anywhere: in the owner process it resolves to the local
+registry queue; elsewhere to a pooled writer connection.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import socketserver
+import threading
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from .logging import get_logger
+from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
+
+logger = get_logger("channels")
+
+KV_CHANNEL_PREFIX = "channel_service/"  # node_id hex -> service address
+
+_PUT_TIMEOUT_S = 300.0
+
+
+class _Registry:
+    """Per-process channel table: chan_id -> bounded queue. Channels
+    materialize lazily on first touch (producer frame or consumer get),
+    so creation order between the two sides never matters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chans: Dict[str, queue.Queue] = {}
+
+    def get_or_create(self, chan_id: str, maxsize: int) -> queue.Queue:
+        with self._lock:
+            q = self._chans.get(chan_id)
+            if q is None:
+                q = self._chans[chan_id] = queue.Queue(maxsize)
+            return q
+
+    def drop(self, chan_id: str) -> None:
+        with self._lock:
+            self._chans.pop(chan_id, None)
+
+
+class _ServiceHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "ChannelService" = self.server  # type: ignore[assignment]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                msg_type, req = recv_msg(sock)
+                if msg_type != MSG_REQUEST:
+                    raise WireError(f"unexpected message type {msg_type}")
+                op = req.get("op")
+                if op == "put":
+                    q = server.registry.get_or_create(
+                        req["chan"], req.get("maxsize", 8))
+                    try:
+                        # blocking put: the delayed ok IS the backpressure
+                        # signal to the remote producer (SPSC edges, so
+                        # this per-connection thread stalls only the lane
+                        # that overfilled its downstream)
+                        q.put(pickle.loads(req["blob"]),
+                              timeout=req.get("timeout", _PUT_TIMEOUT_S))
+                        resp = {"ok": True}
+                    except queue.Full:
+                        resp = {"ok": False, "error": "channel full"}
+                elif op == "ping":
+                    resp = {"ok": True}
+                else:
+                    resp = {"ok": False, "error": f"unknown op {op!r}"}
+                send_msg(sock, MSG_RESPONSE, resp)
+        except (WireError, OSError):
+            pass  # producer disconnected
+
+
+class ChannelService(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, registry: _Registry, host: str = "127.0.0.1"):
+        super().__init__((host, 0), _ServiceHandler)
+        self.registry = registry
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="channel-service"
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self.server_address
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+# --------------------------------------------------------------------------
+# process-global service + writer pool
+# --------------------------------------------------------------------------
+
+_state_lock = threading.Lock()
+_registry = _Registry()
+_service: Optional[ChannelService] = None
+_writers: Dict[Tuple[str, str], "_Writer"] = {}  # (addr, chan_id) -> writer
+
+
+def ensure_service(host: str = "127.0.0.1") -> str:
+    """Start (once) and return this process's channel-service address.
+    Pass the CLUSTER-FACING host (config.node_host) — a loopback bind
+    advertises an address remote producers resolve to themselves."""
+    global _service
+    with _state_lock:
+        if _service is None:
+            _service = ChannelService(_registry, host=host)
+            logger.info("channel service on %s", _service.address)
+        return _service.address
+
+
+def service_address() -> Optional[str]:
+    with _state_lock:
+        return _service.address if _service is not None else None
+
+
+class _Writer:
+    """One persistent producer connection PER CHANNEL: a wedged lane
+    (downstream full, server blocking in put) stalls only its own
+    connection — never another edge's puts to the same host."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)), timeout=10.0)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def put(self, chan_id: str, value: Any, maxsize: int,
+            timeout: float) -> None:
+        blob = _dumps(value)
+        with self._lock:
+            send_msg(self._sock, MSG_REQUEST, {
+                "op": "put", "chan": chan_id, "blob": blob,
+                "maxsize": maxsize, "timeout": timeout,
+            })
+            msg_type, resp = recv_msg(self._sock)
+        if not resp.get("ok"):
+            raise queue.Full(resp.get("error", "remote channel put failed"))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _writer_for(addr: str, chan_id: str, fresh: bool = False) -> _Writer:
+    """Connect OUTSIDE the global lock (a slow/unreachable owner must not
+    freeze unrelated channels); fresh=True evicts a dead cached writer."""
+    key = (addr, chan_id)
+    with _state_lock:
+        w = _writers.get(key)
+        if w is not None and not fresh:
+            return w
+        if w is not None:
+            _writers.pop(key, None)
+    neww = _Writer(addr)
+    with _state_lock:
+        race = _writers.get(key)
+        if race is not None and not fresh:
+            neww.close()
+            return race
+        if w is not None:
+            w.close()
+        _writers[key] = neww
+    return neww
+
+
+def _dumps(obj: Any) -> bytes:
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except Exception:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj, protocol=5)
+
+
+# --------------------------------------------------------------------------
+# the channel handle
+# --------------------------------------------------------------------------
+
+
+class DistChannel:
+    """Bounded SPSC channel homed at `owner_addr`'s process. get() only in
+    the owner process (local dequeue); put() from anywhere."""
+
+    def __init__(self, owner_addr: str, chan_id: Optional[str] = None,
+                 maxsize: int = 8):
+        self.owner_addr = owner_addr
+        self.chan_id = chan_id or uuid.uuid4().hex
+        self.maxsize = maxsize
+
+    def _local(self) -> Optional[queue.Queue]:
+        if service_address() == self.owner_addr:
+            return _registry.get_or_create(self.chan_id, self.maxsize)
+        return None
+
+    def put(self, value: Any, timeout: Optional[float] = None) -> None:
+        t = _PUT_TIMEOUT_S if timeout is None else timeout
+        q = self._local()
+        if q is not None:
+            q.put(value, timeout=t)
+            return
+        try:
+            _writer_for(self.owner_addr, self.chan_id).put(
+                self.chan_id, value, self.maxsize, t)
+        except (WireError, OSError):
+            # cached connection died (owner restarted / transient drop):
+            # one reconnect attempt against a possibly-recovered service
+            _writer_for(self.owner_addr, self.chan_id, fresh=True).put(
+                self.chan_id, value, self.maxsize, t)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        q = self._local()
+        if q is None:
+            raise RuntimeError(
+                "DistChannel.get() outside the owner process (SPSC: the "
+                f"consumer owns {self.chan_id[:8]} at {self.owner_addr})"
+            )
+        return q.get(timeout=timeout)
+
+    def close(self) -> None:
+        """Owner side: drop the registry queue (one-shot result channels
+        call this after their single read, or executions would leak one
+        queue each)."""
+        if service_address() == self.owner_addr:
+            _registry.drop(self.chan_id)
+        with _state_lock:
+            w = _writers.pop((self.owner_addr, self.chan_id), None)
+        if w is not None:
+            w.close()
+
+    def __reduce__(self):
+        return (DistChannel, (self.owner_addr, self.chan_id, self.maxsize))

@@ -610,10 +610,26 @@ class RemoteNodeAgent:
             return False
 
     def submit_direct(self, actor_id: ActorID, fn) -> None:
-        raise WorkerCrashedError(
-            "compiled-graph direct submit does not cross hosts; place DAG "
-            "actors on the driver's node"
-        )
+        self.submit_direct_blob(actor_id, _dumps(fn))
+
+    def submit_direct_blob(self, actor_id: ActorID, fn_blob: bytes) -> None:
+        """Compiled-graph mailbox enqueue on a remote actor: the closure
+        ships as one frame (its channels pickle as DistChannel handles,
+        core/channels.py; CompiledDAG serializes each remote closure ONCE
+        at compile) and the worker enqueues it INLINE on its dispatch
+        loop — one connection, serial handling, so mailbox order equals
+        execute() order. Fire-and-forget: an actor dying in the window
+        between execute()'s liveness pre-check and the remote enqueue is
+        logged here and surfaces as the ref's timeout — the documented
+        stranded-envelope semantics — where the local path would raise
+        synchronously. A dead CONNECTION still raises here like the
+        local path's dead-actor check."""
+        def on_done(result: TaskResult) -> None:
+            if not result.ok:
+                logger.warning("remote submit_direct failed: %r", result.error)
+
+        self._send("submit_direct", done=on_done,
+                   actor_id_hex=actor_id.hex(), fn_blob=fn_blob)
 
     def kill_running_tasks(self) -> None:
         try:
@@ -887,6 +903,12 @@ class _WorkerDispatchHandler(socketserver.BaseRequestHandler):
                 kwargs={"stream": stream_cb}, daemon=True,
                 name=f"dispatch-{spec.task_id.hex()[:8]}",
             ).start()
+        elif method == "submit_direct":
+            # INLINE, never a thread: serial handling on this connection
+            # is what makes remote mailbox order match execute() order
+            fn = pickle.loads(req["fn_blob"])
+            agent.submit_direct(ActorID.from_hex(req["actor_id_hex"]), fn)
+            reply({"id": req_id, "ok": True})
         elif method == "kill_actor":
             ok = agent.kill_actor(ActorID.from_hex(req["actor_id_hex"]),
                                   cause=req.get("cause", "killed"))
@@ -995,6 +1017,12 @@ class WorkerRuntime:
             NODE_SERVICE_PREFIX + self.node_id.hex(), self.dispatch_server.address)
         self.control_plane.kv_put(
             KV_PREFIX + self.node_id.hex(), self.transfer_server.address)
+        # compiled-graph channels homed here (consumer-side queues) are
+        # reachable through this process's channel service
+        from .channels import KV_CHANNEL_PREFIX, ensure_service
+
+        self.control_plane.kv_put(
+            KV_CHANNEL_PREFIX + self.node_id.hex(), ensure_service(node_host))
         self.control_plane.register_node(self.info)
         self._api_client = None
         self._api_client_lock = threading.Lock()

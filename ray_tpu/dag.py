@@ -103,7 +103,7 @@ class MethodNode(DAGNode):
 class DAGRef:
     """Handle to one execution's output."""
 
-    def __init__(self, channel: Channel):
+    def __init__(self, channel: "Channel"):
         self._channel = channel
 
     def get(self, timeout: Optional[float] = 60.0) -> Any:
@@ -111,6 +111,12 @@ class DAGRef:
             out = self._channel.get(timeout=timeout)
         except queue.Empty:
             raise TimeoutError("compiled DAG execution timed out") from None
+        finally:
+            # result channels are one-shot: distributed ones materialize a
+            # registry queue in the driver that must not outlive the read
+            close = getattr(self._channel, "close", None)
+            if close is not None:
+                close()
         if isinstance(out, _Err):
             raise out.exc
         return out
@@ -140,24 +146,6 @@ class CompiledDAG:
         if not self._nodes:
             raise ValueError("compiled DAG needs at least one bound method")
         self._output_node = output_node
-        # one channel per (producer-or-input -> consumer-arg) edge
-        self._input_edges: List[Channel] = []       # InputNode fan-out
-        self._in_channels: Dict[int, List[Tuple[int, Channel]]] = {
-            id(n): [] for n in self._nodes
-        }  # node -> [(arg_index, channel)]
-        self._out_channels: Dict[int, List[Channel]] = {
-            id(n): [] for n in self._nodes
-        }
-        for node in self._nodes:
-            for i, a in enumerate(node.args):
-                if isinstance(a, InputNode):
-                    ch = Channel(max_inflight)
-                    self._input_edges.append(ch)
-                    self._in_channels[id(node)].append((i, ch))
-                elif isinstance(a, MethodNode):
-                    ch = Channel(max_inflight)
-                    self._out_channels[id(a)].append(ch)
-                    self._in_channels[id(node)].append((i, ch))
         self._is_output = {id(n): n is output_node for n in self._nodes}
         # resolve each node's agent once (the "compile": no per-call lookup);
         # actor creation is async, so wait for placement first
@@ -166,6 +154,7 @@ class CompiledDAG:
         from .core.control_plane import ActorState
 
         self._agents = {}
+        node_ids = {}
         for node in self._nodes:
             deadline = _time.monotonic() + 30.0
             while True:
@@ -185,9 +174,85 @@ class CompiledDAG:
                     )
                 _time.sleep(0.005)
             self._agents[id(node)] = self._rt.agents[info.node_id]
+            node_ids[id(node)] = info.node_id
+        # channel plane: all-local graphs use plain queues (today's zero-dep
+        # hot path); any REMOTE node upgrades every edge to DistChannels
+        # homed in each CONSUMER's process, with values riding persistent
+        # TCP (core/channels.py; reference: experimental/channel's
+        # cross-node transport under compiled DAGs)
+        self._any_remote = any(
+            getattr(a, "is_remote", False) for a in self._agents.values()
+        )
+        make_edge = self._edge_factory(node_ids, max_inflight)
+        self._result_maxsize = 1
+        # one channel per (producer-or-input -> consumer-arg) edge
+        self._input_edges: List[Any] = []       # InputNode fan-out
+        self._in_channels: Dict[int, List[Tuple[int, Any]]] = {
+            id(n): [] for n in self._nodes
+        }  # node -> [(arg_index, channel)]
+        self._out_channels: Dict[int, List[Any]] = {
+            id(n): [] for n in self._nodes
+        }
+        for node in self._nodes:
+            for i, a in enumerate(node.args):
+                if isinstance(a, InputNode):
+                    ch = make_edge(node)
+                    self._input_edges.append(ch)
+                    self._in_channels[id(node)].append((i, ch))
+                elif isinstance(a, MethodNode):
+                    ch = make_edge(node)
+                    self._out_channels[id(a)].append(ch)
+                    self._in_channels[id(node)].append((i, ch))
         # bind-once: closures are execution-independent (per-execution state
-        # travels in the envelopes), so build them at compile time
+        # travels in the envelopes), so build them at compile time — and for
+        # REMOTE nodes, serialize them once here too: per-execute cloudpickle
+        # would dominate the per-hop latency this path exists to remove
         self._closures = [self._make_closure(n) for n in self._nodes]
+        self._closure_blobs = {}
+        if self._any_remote:
+            from .core.cross_host import _dumps
+
+            for node, closure in zip(self._nodes, self._closures):
+                if getattr(self._agents[id(node)], "is_remote", False):
+                    self._closure_blobs[id(node)] = _dumps(closure)
+
+    def _edge_factory(self, node_ids, max_inflight: int):
+        """-> make_edge(consumer_node) building the right channel kind."""
+        if not self._any_remote:
+            return lambda node: Channel(max_inflight)
+        from .core.channels import (
+            KV_CHANNEL_PREFIX,
+            DistChannel,
+            ensure_service,
+        )
+        from .core.config import config
+
+        # cluster-facing bind: remote stages resolve this address FROM
+        # THEIR host — loopback would point at themselves
+        driver_addr = ensure_service(config.node_host)
+        self._driver_channel_addr = driver_addr
+        owner_cache: Dict[Any, str] = {}
+
+        def owner_addr_for(node) -> str:
+            agent = self._agents[id(node)]
+            if not getattr(agent, "is_remote", False):
+                return driver_addr  # local (virtual) nodes share this process
+            nid = node_ids[id(node)]
+            addr = owner_cache.get(nid)
+            if addr is None:
+                raw = self._rt.control_plane.kv_get(
+                    KV_CHANNEL_PREFIX + nid.hex())
+                if not raw:
+                    raise RuntimeError(
+                        f"no channel service advertised for node "
+                        f"{nid.hex()[:8]}; joined host too old?"
+                    )
+                addr = raw.decode() if isinstance(raw, bytes) else raw
+                owner_cache[nid] = addr
+            return addr
+
+        return lambda node: DistChannel(
+            owner_addr_for(node), maxsize=max_inflight)
 
     def _make_closure(self, node: MethodNode):
         in_chs = self._in_channels[id(node)]
@@ -237,7 +302,12 @@ class CompiledDAG:
                 raise RuntimeError(
                     f"compiled DAG actor for {node.method} is dead; rebuild"
                 )
-        result_ch = Channel(1)
+        if self._any_remote:
+            from .core.channels import DistChannel
+
+            result_ch = DistChannel(self._driver_channel_addr, maxsize=1)
+        else:
+            result_ch = Channel(1)
         env = _Envelope(result_ch, args[0] if args else None)
         for ch in self._input_edges:
             try:
@@ -247,7 +317,12 @@ class CompiledDAG:
                     "compiled DAG backpressure: downstream stalled"
                 ) from None
         for node, closure in zip(self._nodes, self._closures):
-            self._agents[id(node)].submit_direct(node.handle._actor_id, closure)
+            agent = self._agents[id(node)]
+            blob = self._closure_blobs.get(id(node))
+            if blob is not None:
+                agent.submit_direct_blob(node.handle._actor_id, blob)
+            else:
+                agent.submit_direct(node.handle._actor_id, closure)
         return DAGRef(result_ch)
 
 
