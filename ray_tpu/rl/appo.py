@@ -1,0 +1,168 @@
+"""APPO: asynchronous PPO (reference: `rllib/algorithms/appo/` — the
+reference's flagship-throughput policy-gradient algorithm).
+
+Architecture = IMPALA's decoupled actor/learner (behavior weights lag the
+learner; V-trace corrects the off-policyness) with PPO's clipped
+surrogate objective on the V-trace advantages instead of the plain
+importance-weighted PG loss. The asynchrony that gives APPO its
+throughput: ``train()`` SUBMITS the next round of sampling before
+learning on the previous round's rollouts, so env stepping on the runner
+actors overlaps the learner's jitted update on the device — a two-stage
+pipeline over the task plane rather than the reference's dedicated
+aggregation workers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.logging import get_logger
+from .env_runner import EnvRunnerGroup, fold_truncation_bootstrap
+from .impala import vtrace_targets
+from .module import init_mlp_module, mlp_forward, mlp_forward_np
+
+logger = get_logger("rl.appo")
+
+
+@dataclasses.dataclass
+class APPOConfig:
+    env_fn: Callable[[], Any] = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 1  # >1: vectorized stepping per runner
+    rollout_steps_per_runner: int = 256
+    broadcast_interval: int = 1  # APPO syncs eagerly; V-trace absorbs lag
+    lr: float = 5e-4
+    gamma: float = 0.99
+    rho_bar: float = 1.0
+    c_bar: float = 1.0
+    clip_eps: float = 0.2  # the PPO surrogate clip (the APPO delta)
+    num_passes: int = 2  # >1 is safe under the clip (unlike plain IMPALA)
+    entropy_coef: float = 0.01
+    baseline_coef: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+
+class APPO:
+    def __init__(self, config: APPOConfig):
+        assert config.env_fn is not None, "APPOConfig.env_fn required"
+        self.config = config
+        env = config.env_fn()
+        self.params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), env.observation_size,
+            env.num_actions, config.hidden,
+        )
+        self.behavior_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.runners = EnvRunnerGroup(
+            config.env_fn, mlp_forward_np, config.num_env_runners,
+            config.seed, num_envs_per_runner=config.num_envs_per_runner,
+        )
+        self._update = self._build_update()
+        self._inflight: Optional[List[Any]] = None  # pipelined sample refs
+        self.iteration = 0
+        self._recent_returns: List[float] = []
+
+    def _build_update(self):
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            logits, values = mlp_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            vs, pg_adv = vtrace_targets(
+                batch["behavior_logp"], jax.lax.stop_gradient(target_logp),
+                batch["rewards"], jax.lax.stop_gradient(values),
+                batch["bootstrap_value"], batch["dones"],
+                cfg.gamma, cfg.rho_bar, cfg.c_bar,
+            )
+            adv = jax.lax.stop_gradient(pg_adv)
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            # PPO clipped surrogate on the V-trace advantages (the APPO
+            # objective; reference appo_learner's surrogate on vtrace adv)
+            ratio = jnp.exp(target_logp - batch["behavior_logp"])
+            unclipped = ratio * adv
+            clipped = jnp.clip(
+                ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv
+            pg_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            baseline_loss = 0.5 * jnp.mean(
+                (values - jax.lax.stop_gradient(vs)) ** 2
+            )
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.baseline_coef * baseline_loss
+                     - cfg.entropy_coef * entropy)
+            return total, {"pg_loss": pg_loss, "baseline_loss": baseline_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration of the two-stage pipeline: submit sampling for
+        round N+1, learn on round N's rollouts while the runners step."""
+        cfg = self.config
+        if self.iteration % cfg.broadcast_interval == 0:
+            self.behavior_params = self.params
+        next_refs = self.runners.sample_async(
+            cfg.rollout_steps_per_runner, self.behavior_params
+        )
+        if self._inflight is None:
+            # first call: nothing to learn on yet — collect round 0 and
+            # submit round 1 so the pipeline is primed
+            self._inflight = next_refs
+            next_refs = self.runners.sample_async(
+                cfg.rollout_steps_per_runner, self.behavior_params
+            )
+        rollouts = self.runners.collect(self._inflight, self.behavior_params)
+        self._inflight = next_refs
+        if not rollouts:
+            raise RuntimeError("all env runners failed")
+        metrics: Dict[str, Any] = {}
+        ep_returns: List[float] = []
+        timesteps = 0
+        for ro in rollouts:
+            timesteps += len(ro["obs"])
+            ep_returns.extend(ro["episode_returns"].tolist())
+            rew = fold_truncation_bootstrap(ro, cfg.gamma)
+            batch = {
+                "obs": jnp.asarray(ro["obs"]),
+                "actions": jnp.asarray(ro["actions"]),
+                "rewards": jnp.asarray(rew),
+                "dones": jnp.asarray(ro["dones"]),
+                "behavior_logp": jnp.asarray(ro["logp"]),
+                "bootstrap_value": jnp.asarray(ro["bootstrap_value"]),
+            }
+            for _ in range(max(1, cfg.num_passes)):
+                self.params, self.opt_state, metrics = self._update(
+                    self.params, self.opt_state, batch
+                )
+        self.iteration += 1
+        self._recent_returns.extend(ep_returns)
+        self._recent_returns = self._recent_returns[-100:]
+        out = {k: float(v) for k, v in metrics.items()}
+        out.update({
+            "training_iteration": self.iteration,
+            "episodes_this_iter": len(ep_returns),
+            "timesteps_this_iter": timesteps,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else 0.0,
+        })
+        return out

@@ -127,20 +127,137 @@ class EnvRunner:
         return True
 
 
+@api.remote
+class VectorEnvRunner:
+    """N env copies stepped in lockstep with ONE batched policy forward
+    per step (reference: `rllib/env/vector_env.py` / gymnasium vector
+    envs inside single_agent_env_runner). The rollout keeps the flat
+    [sum_T] contract every learner already consumes: env segments
+    concatenate, and each env's unfinished tail closes with a TRUNCATION
+    cut carrying V(tail_obs) — fold_truncation_bootstrap then keeps GAE/
+    V-trace unbiased across the segment boundaries with no consumer
+    changes."""
+
+    def __init__(self, env_fn: Callable[[], Any], forward_fn, seed: int = 0,
+                 num_envs: int = 2):
+        self.envs = [env_fn() for _ in range(num_envs)]
+        self.forward = forward_fn
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._obs = np.stack([
+            np.asarray(e.reset(seed=seed + i), np.float32)
+            for i, e in enumerate(self.envs)
+        ])
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._ep_returns: List[float] = []
+
+    def set_weights(self, params) -> bool:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, params)
+        return True
+
+    def sample(
+        self, num_steps: int, epsilon: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
+        assert self.params is not None, "set_weights before sample"
+        N = len(self.envs)
+        cols: Dict[str, list] = {k: [] for k in (
+            "obs", "actions", "rewards", "dones", "terminateds",
+            "truncateds", "truncation_values", "next_obs", "logp", "values")}
+        completed: List[float] = []
+        for _ in range(num_steps):
+            logits, values = self.forward(self.params, self._obs)  # [N,A],[N]
+            logits = np.asarray(logits, np.float64)
+            p = np.exp(logits - logits.max(axis=1, keepdims=True))
+            p /= p.sum(axis=1, keepdims=True)
+            row = {k: [] for k in cols}
+            next_obs = np.empty_like(self._obs)
+            for i, env in enumerate(self.envs):
+                if epsilon is None:
+                    a = int(self.rng.choice(p.shape[1], p=p[i]))
+                elif self.rng.random() < epsilon:
+                    a = int(self.rng.integers(p.shape[1]))
+                else:
+                    a = int(np.argmax(logits[i]))
+                nxt, r, term, trunc, _ = env.step(a)
+                nxt = np.asarray(nxt, np.float32)
+                row["obs"].append(self._obs[i].copy())
+                row["actions"].append(a)
+                row["logp"].append(np.log(p[i, a] + 1e-12))
+                row["values"].append(float(values[i]))
+                row["rewards"].append(r)
+                row["dones"].append(term or trunc)
+                row["terminateds"].append(bool(term))
+                row["truncateds"].append(bool(trunc and not term))
+                row["next_obs"].append(nxt)
+                self._ep_return[i] += r
+                if trunc and not term:
+                    _, v_nxt = self.forward(self.params, nxt[None])
+                    row["truncation_values"].append(float(v_nxt[0]))
+                else:
+                    row["truncation_values"].append(0.0)
+                if term or trunc:
+                    completed.append(float(self._ep_return[i]))
+                    self._ep_return[i] = 0.0
+                    next_obs[i] = np.asarray(env.reset(), np.float32)
+                else:
+                    next_obs[i] = nxt
+            for k in cols:
+                cols[k].append(row[k])
+            self._obs = next_obs
+        # per-env tail values in one batched forward
+        _, tail_v = self.forward(self.params, self._obs)
+        # [T, N] -> per-env segments, tail closed by a truncation cut
+        out: Dict[str, list] = {k: [] for k in cols}
+        arr = {k: np.asarray(v) for k, v in cols.items()}
+        for i in range(N):
+            for k in cols:
+                seg = arr[k][:, i]
+                out[k].append(seg.copy())
+            last = num_steps - 1
+            if not out["dones"][-1][last]:
+                out["dones"][-1][last] = True
+                out["truncateds"][-1][last] = True
+                out["truncation_values"][-1][last] = float(tail_v[i])
+        self._ep_returns.extend(completed)
+        flat = {k: np.concatenate(v) for k, v in out.items()}
+        flat["obs"] = flat["obs"].astype(np.float32)
+        flat["actions"] = flat["actions"].astype(np.int32)
+        flat["rewards"] = flat["rewards"].astype(np.float32)
+        flat["logp"] = flat["logp"].astype(np.float32)
+        flat["values"] = flat["values"].astype(np.float32)
+        flat["truncation_values"] = flat["truncation_values"].astype(np.float32)
+        flat["next_obs"] = flat["next_obs"].astype(np.float32)
+        # every segment ends in a cut, so the tail bootstrap is already
+        # folded through truncation_values
+        flat["bootstrap_value"] = 0.0
+        flat["episode_returns"] = np.asarray(completed, np.float32)
+        return flat
+
+    def ping(self) -> bool:
+        return True
+
+
 class EnvRunnerGroup:
-    def __init__(self, env_fn, forward_fn, num_runners: int = 2, seed: int = 0):
+    def __init__(self, env_fn, forward_fn, num_runners: int = 2, seed: int = 0,
+                 num_envs_per_runner: int = 1):
         self.env_fn = env_fn
         self.forward_fn = forward_fn
         self.num_runners = num_runners
         self.seed = seed
-        self.runners = [
-            EnvRunner.remote(env_fn, forward_fn, seed + i) for i in range(num_runners)
-        ]
+        self.num_envs_per_runner = max(1, num_envs_per_runner)
+        self.runners = [self._make(seed + i) for i in range(num_runners)]
+
+    def _make(self, seed: int):
+        if self.num_envs_per_runner > 1:
+            return VectorEnvRunner.remote(
+                self.env_fn, self.forward_fn, seed,
+                self.num_envs_per_runner)
+        return EnvRunner.remote(self.env_fn, self.forward_fn, seed)
 
     def _restart(self, i: int, params=None) -> None:
-        self.runners[i] = EnvRunner.remote(
-            self.env_fn, self.forward_fn, self.seed + i + 1000
-        )
+        self.runners[i] = self._make(self.seed + i + 1000)
         if params is not None:
             api.get(self.runners[i].set_weights.remote(params))
 
@@ -153,12 +270,18 @@ class EnvRunnerGroup:
                 logger.warning("env runner %d dead on sync (%s); restarting", i, e)
                 self._restart(i, params)
 
-    def sample(
-        self, steps_per_runner: int, params=None, epsilon: Optional[float] = None
-    ) -> List[Dict[str, np.ndarray]]:
+    def sample_async(
+        self, steps_per_runner: int, params=None,
+        epsilon: Optional[float] = None,
+    ) -> List[Any]:
+        """Submit sampling on every runner; returns refs (APPO's pipeline
+        overlap: the learner updates while these run)."""
         if params is not None:
             self.sync_weights(params)
-        refs = [r.sample.remote(steps_per_runner, epsilon) for r in self.runners]
+        return [r.sample.remote(steps_per_runner, epsilon)
+                for r in self.runners]
+
+    def collect(self, refs: List[Any], params=None) -> List[Dict[str, np.ndarray]]:
         out: List[Dict[str, np.ndarray]] = []
         for i, ref in enumerate(refs):
             try:
@@ -167,3 +290,9 @@ class EnvRunnerGroup:
                 logger.warning("env runner %d failed (%s); restarting", i, e)
                 self._restart(i, params)
         return out
+
+    def sample(
+        self, steps_per_runner: int, params=None, epsilon: Optional[float] = None
+    ) -> List[Dict[str, np.ndarray]]:
+        return self.collect(
+            self.sample_async(steps_per_runner, params, epsilon), params)
