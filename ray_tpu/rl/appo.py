@@ -126,12 +126,23 @@ class APPO:
         )
         if self._inflight is None:
             # first call: nothing to learn on yet — collect round 0 and
-            # submit round 1 so the pipeline is primed
+            # submit round 1 so the pipeline is primed (params=None: the
+            # weights were just synced; re-pushing would block behind
+            # round 0's whole rollout for nothing)
             self._inflight = next_refs
+            next_refs = self.runners.sample_async(
+                cfg.rollout_steps_per_runner, None
+            )
+        gen = self.runners.generation
+        rollouts = self.runners.collect(self._inflight, self.behavior_params)
+        if self.runners.generation != gen:
+            # a runner was replaced mid-collect: next_refs submitted before
+            # the restart point at the dead actor — resubmit the round, or
+            # the NEXT collect fails again and replaces the healthy
+            # replacement (orphaning its in-flight sample)
             next_refs = self.runners.sample_async(
                 cfg.rollout_steps_per_runner, self.behavior_params
             )
-        rollouts = self.runners.collect(self._inflight, self.behavior_params)
         self._inflight = next_refs
         if not rollouts:
             raise RuntimeError("all env runners failed")

@@ -107,7 +107,7 @@ class EnvRunner:
                 self._obs = nxt
         # bootstrap value for the (possibly unfinished) tail
         _, tail_v = self.forward(self.params, self._obs[None])
-        self._ep_returns.extend(completed)
+        self._ep_returns = (self._ep_returns + completed)[-100:]
         return {
             "obs": np.asarray(obs_l, np.float32),
             "actions": np.asarray(act_l, np.int32),
@@ -220,7 +220,7 @@ class VectorEnvRunner:
                 out["dones"][-1][last] = True
                 out["truncateds"][-1][last] = True
                 out["truncation_values"][-1][last] = float(tail_v[i])
-        self._ep_returns.extend(completed)
+        self._ep_returns = (self._ep_returns + completed)[-100:]
         flat = {k: np.concatenate(v) for k, v in out.items()}
         flat["obs"] = flat["obs"].astype(np.float32)
         flat["actions"] = flat["actions"].astype(np.int32)
@@ -247,6 +247,10 @@ class EnvRunnerGroup:
         self.num_runners = num_runners
         self.seed = seed
         self.num_envs_per_runner = max(1, num_envs_per_runner)
+        # monotonic, bumped on every restart: pipelined consumers (APPO)
+        # use it to detect that refs they submitted before a restart now
+        # point at a dead actor and must be resubmitted
+        self.generation = 0
         self.runners = [self._make(seed + i) for i in range(num_runners)]
 
     def _make(self, seed: int):
@@ -257,15 +261,21 @@ class EnvRunnerGroup:
         return EnvRunner.remote(self.env_fn, self.forward_fn, seed)
 
     def _restart(self, i: int, params=None) -> None:
+        self.generation += 1
         self.runners[i] = self._make(self.seed + i + 1000)
         if params is not None:
             api.get(self.runners[i].set_weights.remote(params))
 
     def sync_weights(self, params) -> None:
-        """Push weights; dead runners are restarted, not fatal."""
+        """Push weights; dead runners are restarted, not fatal. The
+        timeout matches collect()'s: in the pipelined (APPO) flow a
+        set_weights queues BEHIND an in-flight rollout on the actor's
+        serial mailbox — a shorter budget here would misread every
+        healthy-but-sampling runner as dead and restart the whole
+        group each iteration."""
         for i, r in enumerate(self.runners):
             try:
-                api.get(r.set_weights.remote(params), timeout=60.0)
+                api.get(r.set_weights.remote(params), timeout=300.0)
             except (api.RayTaskError, api.RayActorError, api.GetTimeoutError) as e:
                 logger.warning("env runner %d dead on sync (%s); restarting", i, e)
                 self._restart(i, params)

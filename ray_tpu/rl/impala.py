@@ -30,6 +30,7 @@ logger = get_logger("rl.impala")
 class IMPALAConfig:
     env_fn: Callable[[], Any] = None
     num_env_runners: int = 2
+    num_envs_per_runner: int = 1  # >1: vectorized stepping per runner
     rollout_steps_per_runner: int = 256
     broadcast_interval: int = 2  # iterations between behavior-weight syncs
     lr: float = 5e-4
@@ -85,7 +86,8 @@ class IMPALA:
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
         self.runners = EnvRunnerGroup(
-            config.env_fn, mlp_forward_np, config.num_env_runners, config.seed
+            config.env_fn, mlp_forward_np, config.num_env_runners,
+            config.seed, num_envs_per_runner=config.num_envs_per_runner,
         )
         self._update = self._build_update()
         self.iteration = 0

@@ -536,7 +536,7 @@ class TestVectorEnvRunner:
         assert tail_cut
         assert ro["bootstrap_value"] == 0.0
 
-    def test_impala_with_vectorized_runners_learns(self):
+    def test_appo_with_vectorized_runners_learns(self):
         from ray_tpu.rl import APPO, APPOConfig
 
         cfg = APPOConfig(env_fn=CartPole, num_env_runners=2,
@@ -550,3 +550,16 @@ class TestVectorEnvRunner:
         assert out["timesteps_this_iter"] == 384
         assert out["episode_return_mean"] > first["episode_return_mean"], (
             first["episode_return_mean"], out["episode_return_mean"])
+
+    def test_impala_with_vectorized_runners(self):
+        from ray_tpu.rl import IMPALA, IMPALAConfig
+
+        cfg = IMPALAConfig(env_fn=CartPole, num_env_runners=2,
+                           num_envs_per_runner=2,
+                           rollout_steps_per_runner=64, seed=0)
+        algo = IMPALA(cfg)
+        out = None
+        for _ in range(3):
+            out = algo.train()
+        assert out["timesteps_this_iter"] == 256
+        assert np.isfinite(out["loss"])
