@@ -289,6 +289,61 @@ def bench_train(model=None, batch=None, seq=None, steps=None, span=None,
             )
 
 
+def bench_images() -> None:
+    """Image-ingest gate (BASELINE.md workload #4, the ViT/CLIP shape):
+    decode -> resize -> normalize -> batched device-ready arrays through
+    the streaming executor, against a simulated accelerator step. Emits
+    images/s and the stall %% of the step loop."""
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    from ray_tpu import data as rd
+
+    # step_s models a ViT-L-scale train step (bs64 ~ 50-100ms on v5e,
+    # padded for this box's single host core doing ALL the decoding —
+    # real TPU hosts decode on many cores): the gate is "does the
+    # pipeline keep that cadence fed", images/s is raw decode throughput
+    n_images, batch_size, step_s = 2048, 64, 0.25
+    img_dir = tempfile.mkdtemp(prefix="bench_imgs_")
+    rng = np.random.default_rng(0)
+    # realistic-ish JPEG decode work: 256x256 RGB photos
+    for i in range(n_images):
+        arr = rng.integers(0, 255, size=(256, 256, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(os.path.join(img_dir, f"im_{i:05d}.jpg"),
+                                  quality=85)
+
+    ds = rd.read_images(img_dir, size=(224, 224), files_per_block=64,
+                        parallelism=8).map_batches(
+        lambda b: {"x": b["image"].astype(np.float32) / 255.0})
+    it = ds.iter_batches(batch_size=batch_size)
+    next(it)  # prime (startup, not steady state)
+    wait, images, t_loop = 0.0, batch_size, time.perf_counter()
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        wait += time.perf_counter() - t0
+        images += len(batch["x"])
+        time.sleep(step_s)
+    total = time.perf_counter() - t_loop
+    stall_pct = 100.0 * wait / total if total > 0 else 0.0
+    import shutil as _shutil
+
+    import ray_tpu
+
+    ray_tpu.shutdown()  # free pool workers for later benches
+    _shutil.rmtree(img_dir, ignore_errors=True)
+    print(f"# images: n={n_images} 256px->224px total={total:.2f}s "
+          f"wait={wait:.3f}s", file=sys.stderr)
+    _emit("data_images_per_sec", images / total, "images/s", "images_anchor")
+    _emit("data_image_stall_pct", stall_pct, "%", "images_stall_anchor",
+          lower_is_better=True)
+
+
 def bench_moe() -> None:
     """MoE train gate (BASELINE.md workload #3): tokens/s on moe-1b (8
     experts top-2) plus expert-dispatch overhead % — the moe step vs a
@@ -396,7 +451,7 @@ def bench_grpo() -> None:
 
 def main() -> None:
     suite = os.environ.get(
-        "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data,moe,grpo")
+        "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data,images,moe,grpo")
     wanted = {s.strip() for s in suite.split(",") if s.strip()}
     model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
     # Ordering is deliberate: serve FIRST — its p50-TTFT criterion is
@@ -408,6 +463,8 @@ def main() -> None:
         bench_serve(model)
     if "data" in wanted:
         bench_data()
+    if "images" in wanted:
+        bench_images()
     if "train" in wanted:
         bench_train()
     if "train2b" in wanted:
