@@ -319,6 +319,8 @@ class RemoteFunction:
         task_id = TaskID.of()
         streaming = self._options.num_returns == "streaming"
         n = 0 if streaming else max(1, self._options.num_returns)
+        from .util import tracing
+
         spec = TaskSpec(
             task_id=task_id,
             job_id=rt.job_id,
@@ -329,6 +331,7 @@ class RemoteFunction:
             options=self._options,
             return_ids=[ObjectID.for_task_return(task_id, i) for i in range(n)],
             dependencies=_cw._collect_deps(args, kwargs),
+            trace_ctx=tracing.current_context(),
         )
         if streaming:
             # generator task: refs stream back while it runs

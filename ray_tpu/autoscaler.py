@@ -203,6 +203,102 @@ class SubprocessNodeProvider(NodeProvider):
         return dict(self._types)
 
 
+class TPUVMNodeProvider(NodeProvider):
+    """Provisions TPU-VM slices through the GCP TPU API (reference:
+    `autoscaler/_private/gcp/node_provider.py` + its TPU-pod support;
+    v2 instance-manager shape per SURVEY §7.5).
+
+    The cloud boundary is an injectable `api_client` with the gcloud
+    surface this provider drives:
+
+        create_tpu_vm(name, accelerator_type, zone, startup_script) -> op
+        delete_tpu_vm(name, zone) -> op
+        list_tpu_vms(zone) -> [{"name", "state", "accelerator_type"}]
+
+    A real deployment passes a thin wrapper over
+    `google.cloud.tpu_v2.TpuClient` (or `gcloud compute tpus tpu-vm`);
+    tests pass a mock that records the calls — and can "boot" the VM by
+    executing the startup script locally, which is exactly what a fresh
+    TPU-VM does: `ray-tpu start --address <head>` joins the cross-host
+    plane and the rest of the autoscaler loop is provider-agnostic.
+
+    NodeType.topology (e.g. "2x2x4") selects the accelerator_type; one
+    create call provisions the whole slice (the TPU API's granularity is
+    the slice, matching slice-is-the-failure-domain, SURVEY §7.1.3)."""
+
+    STATE_PENDING = ("CREATING", "STARTING", "PROVISIONING")
+    STATE_READY = ("READY", "ACTIVE")
+
+    def __init__(self, head_address: str, api_client, zone: str,
+                 name_prefix: str = "ray-tpu"):
+        self.head_address = head_address
+        self.api = api_client
+        self.zone = zone
+        self.name_prefix = name_prefix
+        self._types: Dict[str, str] = {}  # vm name -> node_type.name
+        self._counter = 0
+
+    # -- the exact strings a real TPU-VM boots with -------------------------
+    def _accelerator_type(self, node_type: NodeType) -> str:
+        if node_type.topology:
+            chips = 1
+            for d in node_type.topology.split("x"):
+                chips *= int(d)
+            gen = node_type.resources.get("tpu_generation", "v5p")
+            gen = gen if isinstance(gen, str) else "v5p"
+            return f"{gen}-{chips}"
+        return f"v5litepod-{int(node_type.resources.get('TPU', 1))}"
+
+    def _startup_script(self, node_type: NodeType, vm_name: str) -> str:
+        extra = {k: v for k, v in node_type.resources.items()
+                 if k not in ("CPU", "TPU", "tpu_generation")}
+        return (
+            "#!/bin/bash\n"
+            "# every host of the slice joins the head's cross-host plane\n"
+            f"ray-tpu start --address {self.head_address} "
+            f"--num-cpus {node_type.resources.get('CPU', 1)} "
+            f"--resources '{extra!r}' "
+            f"--labels provider_node_id={vm_name}\n"
+        )
+
+    # -- NodeProvider surface ----------------------------------------------
+    def create_nodes(self, node_type: NodeType, count: int) -> List[str]:
+        out = []
+        for _ in range(count):
+            self._counter += 1
+            name = f"{self.name_prefix}-{node_type.name}-{self._counter}"
+            self.api.create_tpu_vm(
+                name=name,
+                accelerator_type=self._accelerator_type(node_type),
+                zone=self.zone,
+                startup_script=self._startup_script(node_type, name),
+            )
+            self._types[name] = node_type.name
+            out.append(name)
+            logger.info("requested TPU-VM %s (%s) in %s", name,
+                        self._accelerator_type(node_type), self.zone)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        self._types.pop(node_id, None)
+        self.api.delete_tpu_vm(name=node_id, zone=self.zone)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        live = {}
+        for vm in self.api.list_tpu_vms(zone=self.zone):
+            state = str(vm.get("state", "")).upper()
+            if state in self.STATE_PENDING or state in self.STATE_READY:
+                name = vm["name"]
+                if name in self._types:
+                    live[name] = self._types[name]
+        # forget VMs the cloud no longer reports (preempted/deleted out
+        # of band) so the scaler re-launches the capacity
+        for name in list(self._types):
+            if name not in live:
+                self._types.pop(name, None)
+        return live
+
+
 class Autoscaler:
     """Reconciles pending resource demand against provisioned capacity.
 

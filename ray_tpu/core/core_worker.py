@@ -463,8 +463,13 @@ class Runtime:
         return info
 
     def submit_actor_task(
-        self, actor_id: ActorID, method_name: str, args, kwargs, options: TaskOptions
+        self, actor_id: ActorID, method_name: str, args, kwargs, options: TaskOptions,
+        trace_ctx: Optional[Dict[str, str]] = None,
     ) -> List[ObjectRef]:
+        if trace_ctx is None:
+            from ..util import tracing
+
+            trace_ctx = tracing.current_context()
         task_id = TaskID.of(actor_id)
         spec = TaskSpec(
             task_id=task_id,
@@ -481,6 +486,7 @@ class Runtime:
             actor_id=actor_id,
             method_name=method_name,
             dependencies=_collect_deps(args, kwargs),
+            trace_ctx=trace_ctx,
         )
         refs = [ObjectRef(oid, self) for oid in spec.return_ids]
         with self._lock:

@@ -174,11 +174,13 @@ class HeadService:
     def proxy_submit_actor_task(
         self, actor_id_hex: str, method_name: str,
         payload_blob: bytes, opts_blob: bytes, client_id: str = "",
+        trace_ctx=None,
     ) -> List[str]:
         args, kwargs = pickle.loads(payload_blob)
         options = pickle.loads(opts_blob)
         return self._pin(self._runtime.submit_actor_task(
-            ActorID.from_hex(actor_id_hex), method_name, args, kwargs, options),
+            ActorID.from_hex(actor_id_hex), method_name, args, kwargs,
+            options, trace_ctx=trace_ctx),
             client_id)
 
     def proxy_kill_actor(self, actor_id_hex: str, no_restart: bool) -> bool:

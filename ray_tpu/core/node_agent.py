@@ -462,7 +462,25 @@ class NodeAgent:
             func = getattr(instance, spec.method_name)
         else:
             func = spec.func
-        out = self._invoke(spec, func, args, kwargs)
+        ctx = getattr(spec, "trace_ctx", None)
+        if ctx:
+            # distributed tracing (util/tracing; reference:
+            # tracing_helper's execute-side span): the execute span
+            # parents under the submitter's span, and while it is
+            # current, tasks THIS task submits chain into the same trace
+            from ..util import tracing
+
+            with tracing.start_span(
+                f"execute:{spec.name}",
+                {"task_id": spec.task_id.hex()[:16],
+                 "node": self.node_id.hex()[:8],
+                 "kind": spec.kind.value,
+                 "attempt": spec.attempt},
+                context=ctx,
+            ):
+                out = self._invoke(spec, func, args, kwargs)
+        else:
+            out = self._invoke(spec, func, args, kwargs)
         if kill_event.is_set():
             raise WorkerCrashedError("worker killed during execution")
         return self._shape_returns(spec, out)

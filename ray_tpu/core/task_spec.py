@@ -140,6 +140,10 @@ class TaskSpec:
     # True when a placement-group bundle already holds the resources: the
     # node agent must not double-acquire from the node ledger.
     skip_node_resources: bool = False
+    # Distributed-tracing context (util/tracing): stamped at submission
+    # when the submitting thread has an active span; the executing node
+    # parents its execute-span under it. None = tracing inactive.
+    trace_ctx: Optional[Dict[str, str]] = None
 
     @property
     def name(self) -> str:

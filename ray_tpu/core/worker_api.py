@@ -222,13 +222,17 @@ class WorkerAPIClient:
         return _ActorInfoShim(ActorID.from_hex(actor_hex), name, class_name)
 
     def submit_actor_task(
-        self, actor_id: ActorID, method_name: str, args, kwargs, options
+        self, actor_id: ActorID, method_name: str, args, kwargs, options,
+        trace_ctx=None,
     ) -> List[Any]:
+        from ..util import tracing
         from .cross_host import _dumps
 
+        if trace_ctx is None:
+            trace_ctx = tracing.current_context()
         return self._make_refs(self._cp.proxy_submit_actor_task(
             actor_id.hex(), method_name, _dumps((args, kwargs)),
-            _dumps(options), self.client_id))
+            _dumps(options), self.client_id, trace_ctx))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._cp.proxy_kill_actor(actor_id.hex(), no_restart)

@@ -1,0 +1,144 @@
+"""Distributed tracing: span propagation through task submit/execute.
+
+Reference analogue: `python/ray/util/tracing/tracing_helper.py` — the
+reference wraps task submission and worker execution in OpenTelemetry
+spans so one request's causality chain is visible across processes. Same
+shape here without the OTel dependency (zero-egress image): W3C-style
+ids, a thread-local current span, automatic context injection at
+`.remote()` and extraction around user-function execution
+(`node_agent._invoke`), spans buffered per process and exportable as
+chrome-trace events alongside the timeline (`util/timeline.py`), so one
+`ray-tpu timeline` capture shows both profiling spans AND request
+causality.
+
+Usage:
+
+    from ray_tpu.util import tracing
+
+    with tracing.start_span("handle_request", {"route": "/chat"}):
+        ref = my_task.remote(x)       # ctx injected automatically
+        ray_tpu.get(ref)
+    spans = tracing.get_spans()       # incl. the task's execute span
+                                      # (same trace_id, parented here)
+
+Propagation is on only while a span is active — zero overhead otherwise
+(the spec field stays None)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_local = threading.local()
+_lock = threading.Lock()
+_spans: List[Dict[str, Any]] = []
+_MAX_SPANS = 10_000
+
+
+def _now_us() -> float:
+    return time.time() * 1e6
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "attrs",
+                 "start_us", "end_us")
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.trace_id = trace_id or uuid.uuid4().hex
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.start_us = _now_us()
+        self.end_us: Optional[float] = None
+
+    def context(self) -> Dict[str, str]:
+        """The wire form (W3C traceparent shape, dict-framed)."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def finish(self) -> None:
+        self.end_us = _now_us()
+        rec = {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "attrs": self.attrs, "start_us": self.start_us,
+            "end_us": self.end_us, "pid": os.getpid(),
+        }
+        with _lock:
+            _spans.append(rec)
+            if len(_spans) > _MAX_SPANS:
+                del _spans[: len(_spans) - _MAX_SPANS]
+
+
+def current_span() -> Optional[Span]:
+    return getattr(_local, "span", None)
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """ctx dict to stamp into an outgoing TaskSpec (None when tracing is
+    inactive on this thread — the common, zero-overhead case)."""
+    span = current_span()
+    return span.context() if span is not None else None
+
+
+@contextmanager
+def start_span(name: str, attrs: Optional[Dict[str, Any]] = None,
+               context: Optional[Dict[str, str]] = None):
+    """Open a span. `context` parents it under a REMOTE span (extracted
+    from an incoming TaskSpec); otherwise it nests under this thread's
+    current span (or starts a fresh trace)."""
+    parent = current_span()
+    if context is not None:
+        span = Span(name, trace_id=context["trace_id"],
+                    parent_id=context["span_id"], attrs=attrs)
+    elif parent is not None:
+        span = Span(name, trace_id=parent.trace_id,
+                    parent_id=parent.span_id, attrs=attrs)
+    else:
+        span = Span(name, attrs=attrs)
+    prev = parent
+    _local.span = span
+    try:
+        yield span
+    finally:
+        span.finish()
+        _local.span = prev
+
+
+def get_spans(trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    with _lock:
+        out = list(_spans)
+    if trace_id is not None:
+        out = [s for s in out if s["trace_id"] == trace_id]
+    return out
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def export_to_timeline() -> int:
+    """Mirror buffered spans into the chrome-trace timeline (pid lane
+    'trace', tid = trace id prefix) so `ray-tpu timeline` renders request
+    causality next to task/profiling spans."""
+    from . import timeline
+
+    n = 0
+    for s in get_spans():
+        timeline.record(
+            s["name"], "X", cat="trace", ts_us=s["start_us"],
+            dur_us=(s["end_us"] or s["start_us"]) - s["start_us"],
+            pid="trace", tid=s["trace_id"][:8],
+            args={"span": s["span_id"], "parent": s["parent_id"],
+                  **{k: v for k, v in s["attrs"].items()
+                     if isinstance(v, (int, float, str))}},
+        )
+        n += 1
+    return n

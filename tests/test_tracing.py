@@ -1,0 +1,102 @@
+"""Distributed tracing (§5 aux; reference:
+`python/ray/util/tracing/tracing_helper.py`): span context injected at
+.remote() and extracted around user-function execution, so one trace id
+covers the whole causality chain — driver span -> task execute -> nested
+task execute — across the task plane."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def rt():
+    # worker_processes=0: tasks execute on threads in THIS process, so
+    # the per-process span buffer sees the whole chain (pool workers
+    # record their execute spans in their own processes)
+    r = ray_tpu.init(num_cpus=4, num_tpus=0,
+                     system_config={"worker_processes": 0})
+    tracing.clear()
+    yield r
+    tracing.clear()
+    ray_tpu.shutdown()
+
+
+class TestTracing:
+    def test_local_span_nesting(self, rt):
+        with tracing.start_span("outer", {"k": 1}) as outer:
+            with tracing.start_span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        spans = tracing.get_spans(outer.trace_id)
+        assert {s["name"] for s in spans} == {"outer", "inner"}
+        assert all(s["end_us"] is not None for s in spans)
+
+    def test_task_execution_joins_the_trace(self, rt):
+        @ray_tpu.remote
+        def work(x):
+            return x + 1
+
+        with tracing.start_span("request") as root:
+            assert ray_tpu.get(work.remote(1), timeout=30) == 2
+        spans = tracing.get_spans(root.trace_id)
+        execs = [s for s in spans if s["name"].startswith("execute:")]
+        assert len(execs) == 1
+        assert execs[0]["parent_id"] == root.span_id
+        assert execs[0]["attrs"]["kind"] == "normal"
+
+    def test_nested_submission_chains(self, rt):
+        @ray_tpu.remote
+        def child():
+            return "leaf"
+
+        @ray_tpu.remote
+        def parent():
+            # submitted while the parent's execute span is current
+            return ray_tpu.get(child.remote(), timeout=30)
+
+        with tracing.start_span("root") as root:
+            assert ray_tpu.get(parent.remote(), timeout=30) == "leaf"
+        spans = tracing.get_spans(root.trace_id)
+        p = next(s for s in spans if s["name"].endswith(".parent"))
+        c = next(s for s in spans if s["name"].endswith(".child"))
+        assert p["parent_id"] == root.span_id
+        assert c["parent_id"] == p["span_id"]  # three-deep causality chain
+
+    def test_actor_calls_join_the_trace(self, rt):
+        @ray_tpu.remote(in_process=True)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        ray_tpu.get(c.bump.remote(), timeout=30)  # untraced warm call
+        with tracing.start_span("actor-req") as root:
+            assert ray_tpu.get(c.bump.remote(), timeout=30) == 2
+        spans = tracing.get_spans(root.trace_id)
+        execs = [s for s in spans if s["name"] == "execute:Counter.bump"]
+        assert len(execs) == 1
+        assert execs[0]["parent_id"] == root.span_id
+
+    def test_untraced_submission_has_no_ctx(self, rt):
+        @ray_tpu.remote
+        def plain():
+            return 1
+
+        before = len(tracing.get_spans())
+        assert ray_tpu.get(plain.remote(), timeout=30) == 1
+        assert len(tracing.get_spans()) == before  # zero-overhead path
+
+    def test_export_to_timeline(self, rt):
+        @ray_tpu.remote
+        def t():
+            return 0
+
+        with tracing.start_span("exported"):
+            ray_tpu.get(t.remote(), timeout=30)
+        assert tracing.export_to_timeline() >= 2
