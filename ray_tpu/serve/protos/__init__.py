@@ -1,0 +1,9 @@
+"""Generated protobuf messages for the typed serve gRPC ingress.
+
+serve_pb2.py is generated from serve.proto by `protoc --python_out=.` and
+committed (the image has protoc but not grpcio-tools; service method
+strings are addressed manually via grpc's generic handler/channel API,
+which needs only these message classes on both sides).
+"""
+
+from .serve_pb2 import ServeChunk, ServeReply, ServeRequest  # noqa: F401
