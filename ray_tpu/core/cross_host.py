@@ -183,6 +183,41 @@ class HeadService:
             options, trace_ctx=trace_ctx),
             client_id)
 
+    PROXY_STREAM_CHANNEL = "proxy_stream"
+
+    def proxy_submit_streaming(self, spec_blob: bytes, client_id: str = "") -> str:
+        """Streaming submission from a worker-side client: the head runs
+        the generator task and FORWARDS each item ref over the pubsub
+        plane (`proxy_stream` events carry (stream_id, index, oid_hex));
+        a terminal event carries done/error. Items pin like any other
+        proxy-owned refs."""
+        import uuid as _uuid
+
+        from .core_worker import ObjectRef
+
+        spec = pickle.loads(spec_blob)
+        gen = self._runtime.submit_streaming_task(spec)
+        stream_id = _uuid.uuid4().hex
+        pubsub = self._runtime.control_plane.pubsub
+
+        def pump() -> None:
+            i = 0
+            try:
+                for ref in gen:
+                    self._pin([ref], client_id)
+                    pubsub.publish(self.PROXY_STREAM_CHANNEL,
+                                   (stream_id, i, ref.object_id.hex(), None))
+                    i += 1
+                pubsub.publish(self.PROXY_STREAM_CHANNEL,
+                               (stream_id, -1, None, None))  # done
+            except BaseException as e:  # noqa: BLE001 — forwarded to client
+                pubsub.publish(self.PROXY_STREAM_CHANNEL,
+                               (stream_id, -1, None, _dump_exc(e)))
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"proxy-stream-{stream_id[:8]}").start()
+        return stream_id
+
     def proxy_kill_actor(self, actor_id_hex: str, no_restart: bool) -> bool:
         self._runtime.kill_actor(ActorID.from_hex(actor_id_hex),
                                  no_restart=no_restart)

@@ -42,7 +42,7 @@ _ALLOWED_METHODS: Set[str] = {
     "proxy_job_id", "proxy_submit_task", "proxy_create_actor",
     "proxy_submit_actor_task", "proxy_kill_actor", "proxy_ref_state",
     "proxy_put", "proxy_pin", "proxy_free", "proxy_get_value",
-    "proxy_keepalive",
+    "proxy_keepalive", "proxy_submit_streaming",
 }
 
 

@@ -126,6 +126,10 @@ class WorkerAPIClient:
         self._client_task_id = TaskID.of()
         self._put_index = 0
         self._put_lock = threading.Lock()
+        self._stream_lock = threading.Lock()
+        self._stream_subscribed = False
+        self._streams: Dict[str, "queue.Queue"] = {}
+        self._stream_backlog: Dict[str, list] = {}
         self.is_shutdown = False
         try:
             self.job_id = self._cp.proxy_job_id()
@@ -208,10 +212,38 @@ class WorkerAPIClient:
             _dumps(spec), self.client_id))
 
     def submit_streaming_task(self, spec):
-        raise RuntimeError(
-            "num_returns='streaming' is not supported from worker-host "
-            "processes yet; run streaming producers from the head driver"
-        )
+        """Streaming over the back-channel: the head runs the generator
+        task and forwards item refs as `proxy_stream` pubsub events; this
+        side yields ObjectRefs as the events land (same consume-while-
+        producing contract as the head's ObjectRefGenerator)."""
+        from .cross_host import _dumps
+
+        self._package_renv(spec)
+        with self._stream_lock:
+            if not self._stream_subscribed:
+                self._cp.subscribe("proxy_stream", self._on_stream_event)
+                self._stream_subscribed = True
+        stream_id = self._cp.proxy_submit_streaming(
+            _dumps(spec), self.client_id)
+        q: "queue.Queue" = queue.Queue()
+        with self._stream_lock:
+            self._streams[stream_id] = q
+            # events that raced ahead of the registration replay in order
+            for ev in self._stream_backlog.pop(stream_id, []):
+                q.put(ev)
+        return _ProxyRefStream(self, stream_id, q)
+
+    def _on_stream_event(self, event) -> None:
+        stream_id, index, oid_hex, err_blob = event
+        with self._stream_lock:
+            q = self._streams.get(stream_id)
+            if q is None:
+                # subscribe() races proxy_submit_streaming's reply: buffer
+                # until the stream registers (bounded: streams register
+                # within one RPC round trip)
+                self._stream_backlog.setdefault(stream_id, []).append(event)
+                return
+        q.put(event)
 
     def create_actor(self, cls, args, kwargs, options) -> _ActorInfoShim:
         from .cross_host import _dumps
@@ -392,6 +424,44 @@ class WorkerAPIClient:
         self.reference_counter.gc_enabled = False
         self._free_q.put(None)
         self._cp.close()
+
+
+class _ProxyRefStream:
+    """Client-side ObjectRefGenerator duck: yields ObjectRefs as the
+    head's proxy_stream events arrive; raises the producer's error after
+    the yielded prefix (same contract as core_worker.ObjectRefGenerator)."""
+
+    def __init__(self, client: WorkerAPIClient, stream_id: str, q):
+        self._client = client
+        self._id = stream_id
+        self._q = q
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .core_worker import ObjectRef
+        from .ids import ObjectID
+
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        _sid, index, oid_hex, err_blob = self._q.get()
+        if index < 0:  # terminal event
+            self._done = True
+            with self._client._stream_lock:
+                self._client._streams.pop(self._id, None)
+            if err_blob is not None:
+                self._error = _load_error(err_blob)
+                raise self._error
+            raise StopIteration
+        return ObjectRef(ObjectID.from_hex(oid_hex), self._client)
+
+    def completed(self) -> bool:
+        return self._done
 
 
 def _load_error(blob: Optional[bytes]) -> BaseException:

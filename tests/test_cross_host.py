@@ -364,6 +364,53 @@ class TestCrossHostStreaming:
         assert rest == [1, 2]
 
 
+class TestBackChannelStreaming:
+    def test_streaming_submission_from_joined_host(self, head_with_worker):
+        """num_returns='streaming' through the worker API back-channel:
+        the head runs the generator and forwards item refs as pubsub
+        events; the joined-host consumer iterates while it produces."""
+        rt, proc = head_with_worker
+
+        @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1})
+        def driver_side():
+            import ray_tpu as r
+
+            @r.remote(num_cpus=0.1, num_returns="streaming")
+            def produce():
+                for i in range(4):
+                    yield {"i": i}
+
+            return [r.get(ref, timeout=30)["i"] for ref in produce.remote()]
+
+        assert ray_tpu.get(driver_side.remote(), timeout=120) == [0, 1, 2, 3]
+
+    def test_streaming_error_propagates_through_back_channel(
+            self, head_with_worker):
+        rt, proc = head_with_worker
+
+        @ray_tpu.remote(num_cpus=0, resources={"magic": 0.1})
+        def driver_side():
+            import ray_tpu as r
+
+            @r.remote(num_cpus=0.1, num_returns="streaming", max_retries=0)
+            def flaky():
+                yield 1
+                raise ValueError("stream broke")
+
+            gen = flaky.remote()
+            first = r.get(next(gen), timeout=30)
+            try:
+                for _ in gen:
+                    pass
+                return (first, "no-error")
+            except Exception as e:
+                return (first, type(e).__name__)
+
+        first, err = ray_tpu.get(driver_side.remote(), timeout=120)
+        assert first == 1
+        assert err in ("RayTaskError", "ValueError"), err
+
+
 class TestCrossHostRuntimeEnv:
     def test_working_dir_ships_to_joined_host(self, tmp_path):
         """VERDICT r3 #6 done-criterion: a task runs on the 'remote'
