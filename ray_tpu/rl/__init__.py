@@ -20,3 +20,15 @@ from .offline import BC, BCConfig, load_offline_dataset, rollouts_to_dataset, sa
 from .ppo import PPO, PPOConfig, compute_gae  # noqa: F401
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer, SumTree  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
+from .connectors import (  # noqa: F401
+    ClipObs,
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    LambdaConnector,
+    MaskLogits,
+    NormalizeObs,
+    ScaleObs,
+    build_pipeline,
+)

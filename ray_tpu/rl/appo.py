@@ -47,6 +47,13 @@ class APPOConfig:
     baseline_coef: float = 0.5
     hidden: tuple = (64, 64)
     seed: int = 0
+    # connector pipelines (reference: rllib/connectors):
+    # env_to_module transforms observations on the runner,
+    # module_to_env transforms logits before action selection,
+    # learner transforms whole rollouts before the jitted update
+    env_to_module_connectors: tuple = ()
+    module_to_env_connectors: tuple = ()
+    learner_connectors: tuple = ()
 
 
 class APPO:
@@ -64,7 +71,12 @@ class APPO:
         self.runners = EnvRunnerGroup(
             config.env_fn, mlp_forward_np, config.num_env_runners,
             config.seed, num_envs_per_runner=config.num_envs_per_runner,
+            connectors=config.env_to_module_connectors,
+            action_connectors=config.module_to_env_connectors,
         )
+        from .connectors import build_pipeline
+
+        self._learner_conn = build_pipeline(config.learner_connectors)
         self._update = self._build_update()
         self._inflight: Optional[List[Any]] = None  # pipelined sample refs
         self.iteration = 0
@@ -150,6 +162,8 @@ class APPO:
         ep_returns: List[float] = []
         timesteps = 0
         for ro in rollouts:
+            if self._learner_conn is not None:
+                ro = self._learner_conn(ro)
             timesteps += len(ro["obs"])
             ep_returns.extend(ro["episode_returns"].tolist())
             rew = fold_truncation_bootstrap(ro, cfg.gamma)

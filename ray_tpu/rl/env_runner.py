@@ -33,7 +33,10 @@ def fold_truncation_bootstrap(ro: Dict[str, np.ndarray], gamma: float) -> np.nda
 
 @api.remote
 class EnvRunner:
-    def __init__(self, env_fn: Callable[[], Any], forward_fn, seed: int = 0):
+    def __init__(self, env_fn: Callable[[], Any], forward_fn, seed: int = 0,
+                 connectors=None, action_connectors=None):
+        from .connectors import build_pipeline
+
         self.env = env_fn()
         # Rollout actors are host-resident: forward_fn must be a HOST
         # function (numpy in/out, e.g. module.mlp_forward_np). Per-step
@@ -43,9 +46,24 @@ class EnvRunner:
         self.forward = forward_fn
         self.params = None
         self.rng = np.random.default_rng(seed)
+        # env-to-module / module-to-env connector pipelines (reference:
+        # rllib/connectors): each actor unpickles its OWN copy, so
+        # stateful connectors (NormalizeObs) track per-runner streams
+        self._c_obs = build_pipeline(connectors)
+        self._c_act = build_pipeline(action_connectors)
         self._obs = self.env.reset(seed=seed)
+        # transform-once cache: every raw observation passes the pipeline
+        # exactly ONCE (stateful connectors must not double-count stats,
+        # and next_obs[t] must equal obs[t+1] feature-for-feature)
+        self._obs_t = self._transform_obs(self._obs)
         self._ep_return = 0.0
         self._ep_returns: List[float] = []
+
+    def _transform_obs(self, raw, batched: bool = False) -> np.ndarray:
+        if self._c_obs is None:
+            return np.asarray(raw, np.float32)
+        return np.asarray(
+            self._c_obs(raw, {"batched": batched}), np.float32)
 
     def set_weights(self, params) -> bool:
         import jax
@@ -65,22 +83,33 @@ class EnvRunner:
         term_l, trunc_l, tv_l = [], [], []
         completed = []
         for _ in range(num_steps):
-            logits, value = self.forward(self.params, self._obs[None])
+            # the cached TRANSFORMED obs is what the module sees — and
+            # what the rollout stores, so the learner consumes the same
+            # features (next_obs[t] is literally obs[t+1]'s array)
+            obs_t = self._obs_t
+            logits, value = self.forward(self.params, obs_t[None])
             logits = np.asarray(logits[0], np.float64)
+            if self._c_act is not None:
+                logits = np.asarray(
+                    self._c_act(logits, {"obs": self._obs}), np.float64)
             p = np.exp(logits - logits.max())
             p /= p.sum()
             if epsilon is None:
                 a = int(self.rng.choice(len(p), p=p))
             elif self.rng.random() < epsilon:
-                a = int(self.rng.integers(len(p)))
+                # uniform over VALID actions only: a logits mask zeroes
+                # p, and epsilon exploration must respect it
+                valid = np.flatnonzero(p > 0)
+                a = int(self.rng.choice(valid))
             else:
                 a = int(np.argmax(logits))
-            obs_l.append(self._obs)
+            obs_l.append(obs_t)
             act_l.append(a)
             logp_l.append(np.log(p[a] + 1e-12))
             val_l.append(float(value[0]))
             nxt, r, term, trunc, _ = self.env.step(a)
-            next_l.append(np.asarray(nxt, np.float32))
+            nxt_t = self._transform_obs(nxt)
+            next_l.append(nxt_t)
             self._ep_return += r
             rew_l.append(r)
             done_l.append(term or trunc)
@@ -93,9 +122,7 @@ class EnvRunner:
             # truncated steps so on-policy learners can fold
             # gamma*V(next_obs) back into the reward at the cut.
             if trunc and not term:
-                _, v_nxt = self.forward(
-                    self.params, np.asarray(nxt, np.float32)[None]
-                )
+                _, v_nxt = self.forward(self.params, nxt_t[None])
                 tv_l.append(float(v_nxt[0]))
             else:
                 tv_l.append(0.0)
@@ -103,10 +130,13 @@ class EnvRunner:
                 completed.append(self._ep_return)
                 self._ep_return = 0.0
                 self._obs = self.env.reset()
+                self._obs_t = self._transform_obs(self._obs)
             else:
                 self._obs = nxt
-        # bootstrap value for the (possibly unfinished) tail
-        _, tail_v = self.forward(self.params, self._obs[None])
+                self._obs_t = nxt_t
+        # bootstrap value for the (possibly unfinished) tail — from the
+        # cache, not a fresh transform
+        _, tail_v = self.forward(self.params, self._obs_t[None])
         self._ep_returns = (self._ep_returns + completed)[-100:]
         return {
             "obs": np.asarray(obs_l, np.float32),
@@ -139,17 +169,34 @@ class VectorEnvRunner:
     changes."""
 
     def __init__(self, env_fn: Callable[[], Any], forward_fn, seed: int = 0,
-                 num_envs: int = 2):
+                 num_envs: int = 2, connectors=None, action_connectors=None):
+        from .connectors import build_pipeline
+
         self.envs = [env_fn() for _ in range(num_envs)]
         self.forward = forward_fn
         self.params = None
         self.rng = np.random.default_rng(seed)
+        self._c_obs = build_pipeline(connectors)
+        self._c_act = build_pipeline(action_connectors)
         self._obs = np.stack([
             np.asarray(e.reset(seed=seed + i), np.float32)
             for i, e in enumerate(self.envs)
         ])
+        # transform-once cache (see EnvRunner): one pipeline pass per raw
+        # observation, rows reused as the next step's module input
+        self._obs_t = self._transform_rows(self._obs)
         self._ep_return = np.zeros(num_envs, np.float64)
         self._ep_returns: List[float] = []
+
+    def _transform_row(self, raw) -> np.ndarray:
+        if self._c_obs is None:
+            return np.asarray(raw, np.float32)
+        return np.asarray(self._c_obs(raw), np.float32)
+
+    def _transform_rows(self, raw) -> np.ndarray:
+        if self._c_obs is None:
+            return np.asarray(raw, np.float32)
+        return np.stack([self._transform_row(r) for r in raw])
 
     def set_weights(self, params) -> bool:
         import jax
@@ -167,22 +214,33 @@ class VectorEnvRunner:
             "truncateds", "truncation_values", "next_obs", "logp", "values")}
         completed: List[float] = []
         for _ in range(num_steps):
-            logits, values = self.forward(self.params, self._obs)  # [N,A],[N]
+            obs_t = self._obs_t
+            logits, values = self.forward(self.params, obs_t)  # [N,A],[N]
             logits = np.asarray(logits, np.float64)
+            if self._c_act is not None:
+                logits = np.stack([
+                    np.asarray(self._c_act(logits[i], {"obs": self._obs[i]}),
+                               np.float64)
+                    for i in range(N)
+                ])
             p = np.exp(logits - logits.max(axis=1, keepdims=True))
             p /= p.sum(axis=1, keepdims=True)
             row = {k: [] for k in cols}
             next_obs = np.empty_like(self._obs)
+            next_obs_t = np.empty_like(self._obs_t)
             for i, env in enumerate(self.envs):
                 if epsilon is None:
                     a = int(self.rng.choice(p.shape[1], p=p[i]))
                 elif self.rng.random() < epsilon:
-                    a = int(self.rng.integers(p.shape[1]))
+                    # uniform over VALID actions (respect logits masks)
+                    valid = np.flatnonzero(p[i] > 0)
+                    a = int(self.rng.choice(valid))
                 else:
                     a = int(np.argmax(logits[i]))
                 nxt, r, term, trunc, _ = env.step(a)
                 nxt = np.asarray(nxt, np.float32)
-                row["obs"].append(self._obs[i].copy())
+                nxt_t = self._transform_row(nxt)
+                row["obs"].append(obs_t[i].copy())
                 row["actions"].append(a)
                 row["logp"].append(np.log(p[i, a] + 1e-12))
                 row["values"].append(float(values[i]))
@@ -190,10 +248,10 @@ class VectorEnvRunner:
                 row["dones"].append(term or trunc)
                 row["terminateds"].append(bool(term))
                 row["truncateds"].append(bool(trunc and not term))
-                row["next_obs"].append(nxt)
+                row["next_obs"].append(nxt_t)
                 self._ep_return[i] += r
                 if trunc and not term:
-                    _, v_nxt = self.forward(self.params, nxt[None])
+                    _, v_nxt = self.forward(self.params, nxt_t[None])
                     row["truncation_values"].append(float(v_nxt[0]))
                 else:
                     row["truncation_values"].append(0.0)
@@ -201,13 +259,16 @@ class VectorEnvRunner:
                     completed.append(float(self._ep_return[i]))
                     self._ep_return[i] = 0.0
                     next_obs[i] = np.asarray(env.reset(), np.float32)
+                    next_obs_t[i] = self._transform_row(next_obs[i])
                 else:
                     next_obs[i] = nxt
+                    next_obs_t[i] = nxt_t
             for k in cols:
                 cols[k].append(row[k])
             self._obs = next_obs
-        # per-env tail values in one batched forward
-        _, tail_v = self.forward(self.params, self._obs)
+            self._obs_t = next_obs_t
+        # per-env tail values in one batched forward — from the cache
+        _, tail_v = self.forward(self.params, self._obs_t)
         # [T, N] -> per-env segments, tail closed by a truncation cut
         out: Dict[str, list] = {k: [] for k in cols}
         arr = {k: np.asarray(v) for k, v in cols.items()}
@@ -241,11 +302,14 @@ class VectorEnvRunner:
 
 class EnvRunnerGroup:
     def __init__(self, env_fn, forward_fn, num_runners: int = 2, seed: int = 0,
-                 num_envs_per_runner: int = 1):
+                 num_envs_per_runner: int = 1, connectors=None,
+                 action_connectors=None):
         self.env_fn = env_fn
         self.forward_fn = forward_fn
         self.num_runners = num_runners
         self.seed = seed
+        self.connectors = list(connectors or [])
+        self.action_connectors = list(action_connectors or [])
         self.num_envs_per_runner = max(1, num_envs_per_runner)
         # monotonic, bumped on every restart: pipelined consumers (APPO)
         # use it to detect that refs they submitted before a restart now
@@ -257,8 +321,11 @@ class EnvRunnerGroup:
         if self.num_envs_per_runner > 1:
             return VectorEnvRunner.remote(
                 self.env_fn, self.forward_fn, seed,
-                self.num_envs_per_runner)
-        return EnvRunner.remote(self.env_fn, self.forward_fn, seed)
+                self.num_envs_per_runner, connectors=self.connectors,
+                action_connectors=self.action_connectors)
+        return EnvRunner.remote(self.env_fn, self.forward_fn, seed,
+                                connectors=self.connectors,
+                                action_connectors=self.action_connectors)
 
     def _restart(self, i: int, params=None) -> None:
         self.generation += 1

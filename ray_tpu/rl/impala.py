@@ -42,6 +42,13 @@ class IMPALAConfig:
     baseline_coef: float = 0.5
     hidden: tuple = (64, 64)
     seed: int = 0
+    # connector pipelines (reference: rllib/connectors):
+    # env_to_module transforms observations on the runner,
+    # module_to_env transforms logits before action selection,
+    # learner transforms whole rollouts before the jitted update
+    env_to_module_connectors: tuple = ()
+    module_to_env_connectors: tuple = ()
+    learner_connectors: tuple = ()
 
 
 def vtrace_targets(behavior_logp, target_logp, rewards, values,
@@ -88,7 +95,12 @@ class IMPALA:
         self.runners = EnvRunnerGroup(
             config.env_fn, mlp_forward_np, config.num_env_runners,
             config.seed, num_envs_per_runner=config.num_envs_per_runner,
+            connectors=config.env_to_module_connectors,
+            action_connectors=config.module_to_env_connectors,
         )
+        from .connectors import build_pipeline
+
+        self._learner_conn = build_pipeline(config.learner_connectors)
         self._update = self._build_update()
         self.iteration = 0
         self._recent_returns: List[float] = []
@@ -149,6 +161,8 @@ class IMPALA:
         ep_returns: List[float] = []
         timesteps = 0
         batches = []  # host->device once, reused across passes
+        if self._learner_conn is not None:
+            rollouts = [self._learner_conn(ro) for ro in rollouts]
         for ro in rollouts:
             timesteps += len(ro["obs"])
             ep_returns.extend(ro["episode_returns"].tolist())

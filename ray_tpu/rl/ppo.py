@@ -39,6 +39,13 @@ class PPOConfig:
     minibatch_size: int = 256
     hidden: tuple = (64, 64)
     seed: int = 0
+    # connector pipelines (reference: rllib/connectors):
+    # env_to_module transforms observations on the runner,
+    # module_to_env transforms logits before action selection,
+    # learner transforms whole rollouts before the jitted update
+    env_to_module_connectors: tuple = ()
+    module_to_env_connectors: tuple = ()
+    learner_connectors: tuple = ()
 
 
 def compute_gae(rewards, values, dones, bootstrap_value, gamma, lam):
@@ -69,8 +76,13 @@ class PPO:
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
         self.runners = EnvRunnerGroup(
-            config.env_fn, mlp_forward_np, config.num_env_runners, config.seed
+            config.env_fn, mlp_forward_np, config.num_env_runners, config.seed,
+            connectors=config.env_to_module_connectors,
+            action_connectors=config.module_to_env_connectors,
         )
+        from .connectors import build_pipeline
+
+        self._learner_conn = build_pipeline(config.learner_connectors)
         self._update = self._build_update()
         self.iteration = 0
         self._recent_returns: List[float] = []
@@ -116,6 +128,8 @@ class PPO:
             raise RuntimeError("all env runners failed")
         obs, acts, logp, advs, rets = [], [], [], [], []
         ep_returns: List[float] = []
+        if self._learner_conn is not None:
+            rollouts = [self._learner_conn(ro) for ro in rollouts]
         for ro in rollouts:
             adv, ret = compute_gae(
                 fold_truncation_bootstrap(ro, cfg.gamma),

@@ -563,3 +563,69 @@ class TestVectorEnvRunner:
             out = algo.train()
         assert out["timesteps_this_iter"] == 256
         assert np.isfinite(out["loss"])
+
+
+class TestConnectors:
+    """Connector pipelines (reference: rllib/connectors): env-to-module,
+    module-to-env, and learner transform chains with surgery ergonomics."""
+
+    def test_pipeline_surgery(self):
+        from ray_tpu.rl import (
+            ClipObs,
+            ConnectorPipeline,
+            LambdaConnector,
+            ScaleObs,
+        )
+
+        pipe = ConnectorPipeline([ScaleObs(scale=2.0), ClipObs(-1, 1)])
+        out = pipe(np.asarray([0.4, 3.0], np.float32))
+        assert np.allclose(out, [0.8, 1.0])
+        pipe.insert_after("ScaleObs", LambdaConnector(lambda x: x + 1, "plus"))
+        assert [c.name for c in pipe.connectors] == [
+            "ScaleObs", "plus", "ClipObs"]
+        pipe.remove("plus")
+        assert len(pipe) == 2
+
+    def test_env_to_module_connector_shapes_training(self):
+        from ray_tpu.rl import PPO, PPOConfig, ScaleObs
+
+        cfg = PPOConfig(env_fn=CartPole, num_env_runners=1,
+                        rollout_steps_per_runner=64, num_epochs=1,
+                        minibatch_size=32, seed=0,
+                        env_to_module_connectors=(ScaleObs(scale=0.5),))
+        algo = PPO(cfg)
+        out = algo.train()
+        assert np.isfinite(out["loss"])
+        # the stored rollout obs ARE the transformed features: sample one
+        # rollout directly and check the scale took effect
+        ro = algo.runners.sample(16, algo.params)[0]
+        assert np.abs(ro["obs"]).max() <= 0.5 * 5.0  # cartpole obs < 5
+
+    def test_learner_connector_clips_rewards(self):
+        from ray_tpu.rl import APPO, APPOConfig, ClipReward
+
+        cfg = APPOConfig(env_fn=CartPole, num_env_runners=1,
+                         rollout_steps_per_runner=48, num_passes=1, seed=0,
+                         learner_connectors=(ClipReward(-0.5, 0.5),))
+        algo = APPO(cfg)
+        out = algo.train()
+        assert np.isfinite(out["loss"])
+
+    def test_normalize_obs_runs_stateful(self):
+        from ray_tpu.rl import NormalizeObs
+
+        norm = NormalizeObs()
+        xs = [np.asarray([float(i), -float(i)], np.float32) for i in range(32)]
+        outs = [norm(x) for x in xs]
+        assert norm.count == 32
+        assert np.abs(outs[-1]).max() <= 10.0
+
+    def test_mask_logits_blocks_invalid_actions(self):
+        from ray_tpu.rl import MaskLogits
+
+        mask = MaskLogits(lambda obs: np.asarray([True, obs[0] > 0]))
+        logits = np.asarray([0.1, 5.0], np.float32)
+        out = mask(logits, {"obs": np.asarray([-1.0])})
+        assert out[1] < -1e20 and out[0] == np.float32(0.1)
+        out2 = mask(logits, {"obs": np.asarray([1.0])})
+        assert np.allclose(out2, logits)
