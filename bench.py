@@ -461,6 +461,12 @@ def main() -> None:
     # tolerates residue far better (1.5% -> ~2-6% worst case).
     if "serve" in wanted:
         bench_serve(model)
+    if "grpo" in wanted:
+        # rollout generate pays per-TOKEN dispatches — as latency-bound
+        # as serve TTFT, and equally poisoned by the HBM churn the train/
+        # moe suites leave behind (measured 10x: 15 -> 1.4 samples/s when
+        # run last). Latency-sensitive gates run before throughput gates.
+        bench_grpo()
     if "data" in wanted:
         bench_data()
     if "images" in wanted:
@@ -474,12 +480,10 @@ def main() -> None:
         # (bench_anchor_llama_2b) and must not inherit env overrides.
         bench_train(model="llama-2b", batch=4, seq=2048, steps=8, span=4,
                     factored=True, bf16_params=True)
-    # north-star workloads #3 (MoE) and #5 (RLHF) run LAST: their HBM
-    # churn must not precede the latency-sensitive serve gate
+    # MoE runs LAST: its HBM churn must not precede the latency-
+    # sensitive serve/grpo gates
     if "moe" in wanted:
         bench_moe()
-    if "grpo" in wanted:
-        bench_grpo()
 
 
 if __name__ == "__main__":
