@@ -1,0 +1,118 @@
+"""Tree-of-tasks across hosts: the worker-side API back-channel.
+
+The reference's bread-and-butter pattern — tasks spawn tasks, replicas
+call handles, trials place trainers — requires every worker to reach the
+ownership tables. Here ownership stays at the HEAD (single controller,
+the TPU-pod shape) and worker-side code gets a transparent client
+(`core/worker_api.py`): the SAME `ray_tpu.put/get/remote/wait/actor`
+calls work inside tasks on joined hosts, inside pool-worker subprocesses,
+and inside dedicated actor processes.
+
+    python examples/nested_tasks.py
+
+Demonstrates, across one head + 2 joined worker runtimes:
+  1. a task on a joined host fanning out grandchild tasks the HEAD
+     schedules cluster-wide (tree of tasks),
+  2. a named actor created by the driver being called from a task on
+     another host (the serve model-composition shape),
+  3. a streaming producer consumed from a joined host
+     (num_returns='streaming' over the back-channel).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import ray_tpu  # noqa: E402
+
+
+def main() -> int:
+    rt = ray_tpu.init(
+        num_cpus=2, num_tpus=0,
+        system_config={"control_plane_rpc_port": 0},
+    )
+    addr = rt._cp_server.address
+    procs = []
+    for i in range(2):
+        code = textwrap.dedent(f"""
+            import ray_tpu
+            w = ray_tpu.init(address={addr!r}, num_cpus=4, num_tpus=0,
+                             resources={{"pool": 2.0}})
+            w.wait(timeout=600)
+        """)
+        procs.append(subprocess.Popen([sys.executable, "-c", code],
+                                      env=dict(os.environ)))
+    while sum(n.resources_total.get("pool", 0)
+              for n in rt.control_plane.alive_nodes()) < 4:
+        time.sleep(0.2)
+    print(f"cluster up: {len(rt.control_plane.alive_nodes())} runtimes")
+
+    # 1. tree of tasks: parent runs on a joined host, its children fan
+    #    out wherever the HEAD's scheduler finds capacity
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5})
+    def parent(n):
+        import ray_tpu as r
+
+        @r.remote(num_cpus=0, resources={"pool": 0.25})
+        def child(i):
+            return (i, os.getpid())
+
+        results = r.get([child.remote(i) for i in range(n)], timeout=60)
+        return {"parent_pid": os.getpid(), "children": results}
+
+    out = ray_tpu.get(parent.remote(6), timeout=120)
+    child_pids = {pid for _, pid in out["children"]}
+    print(f"tree-of-tasks: parent pid {out['parent_pid']} fanned 6 children "
+          f"across {len(child_pids)} process(es)")
+
+    # 2. cross-host handle call on a named actor
+    @ray_tpu.remote(num_cpus=0.1, in_process=True, name="ledger")
+    class Ledger:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, k):
+            self.total += k
+            return self.total
+
+    ledger = Ledger.remote()
+    ray_tpu.get(ledger.add.remote(1), timeout=30)
+
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5})
+    def worker_updates():
+        import ray_tpu as r
+
+        h = r.get_actor("ledger")
+        return r.get(h.add.remote(10), timeout=30)
+
+    print("named-actor call from a joined host ->",
+          ray_tpu.get(worker_updates.remote(), timeout=60))
+
+    # 3. streaming through the back-channel
+    @ray_tpu.remote(num_cpus=0, resources={"pool": 0.5})
+    def stream_consumer():
+        import ray_tpu as r
+
+        @r.remote(num_cpus=0.1, num_returns="streaming")
+        def ticks():
+            for i in range(4):
+                yield {"tick": i}
+
+        return [r.get(ref, timeout=30)["tick"] for ref in ticks.remote()]
+
+    print("streamed through the back-channel ->",
+          ray_tpu.get(stream_consumer.remote(), timeout=120))
+
+    ray_tpu.shutdown()
+    for p in procs:
+        p.terminate()
+    print("NESTED-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
