@@ -29,3 +29,4 @@ from .openai_api import (  # noqa: F401
     OpenAIServer,
     build_openai_app,
 )
+from .proxy_actor import ProxyActor, start_proxy  # noqa: F401

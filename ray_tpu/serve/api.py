@@ -47,11 +47,23 @@ def run(
     handle = DeploymentHandle(dep.name, controller)
     route = (route_prefix or name or dep.name).strip("/")
     with _state_lock:
+        prev = _apps.get(name)
         _apps[name] = (dep.name, route)
         if _proxy is None:
             _proxy = HTTPProxy(port=http_port)
             _proxy.start()
+        if prev is not None and prev[1] != route:
+            # re-deploy under a NEW route: retire the old one everywhere,
+            # or per-host proxies serve a stale path forever
+            _proxy.remove_route(prev[1])
         _proxy.add_route(route, handle)
+    if prev is not None and prev[1] != route:
+        core_api.get(controller.delete_route.remote(prev[1], prev[0]))
+    # controller table updated AFTER local state: a failure above leaves
+    # no orphaned cluster-wide route that delete() could never clean
+    # (dual store: _apps/head proxy here, controller table for per-host
+    # proxies — the invariant is controller routes ⊆ _apps routes)
+    core_api.get(controller.set_route.remote(route, dep.name))
     logger.info("app %r -> deployment %r at /%s (port %d)",
                 name, dep.name, route, _proxy.port)
     if blocking:  # pragma: no cover
@@ -128,6 +140,8 @@ def delete(name: str = "default") -> None:
             _proxy.remove_route(route)
     if dep_name is not None:
         controller = core_api.get_actor(CONTROLLER_NAME)
+        # ownership-checked: another app may have re-claimed this route
+        core_api.get(controller.delete_route.remote(route, dep_name))
         core_api.get(controller.delete_deployment.remote(dep_name))
 
 

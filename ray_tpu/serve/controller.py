@@ -56,6 +56,7 @@ class _DeploymentState:
 class ServeController:
     def __init__(self, reconcile_period_s: float = 0.25):
         self._deployments: Dict[str, _DeploymentState] = {}
+        self._routes: Dict[str, str] = {}  # route -> deployment name
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._period = reconcile_period_s
@@ -96,6 +97,28 @@ class ServeController:
             if state is None:
                 return [], -1
             return list(state.replicas), state.membership
+
+    # ---- route table (consumed by per-host proxies) -----------------------
+    def set_route(self, route: str, deployment_name: str) -> bool:
+        with self._lock:
+            self._routes[route] = deployment_name
+        return True
+
+    def delete_route(self, route: str, deployment_name: str = "") -> bool:
+        """Remove a route — only if it still points at deployment_name
+        (empty = unconditional): app B re-claiming app A's route must not
+        be torn down when A is later deleted."""
+        with self._lock:
+            if deployment_name and self._routes.get(route) != deployment_name:
+                return False
+            return self._routes.pop(route, None) is not None
+
+    def get_routes(self) -> Dict[str, str]:
+        """route -> deployment name; per-host proxies poll this so apps
+        deployed after a proxy started still get routed (reference:
+        proxies watch the controller's LongPoll config updates)."""
+        with self._lock:
+            return dict(self._routes)
 
     def status(self) -> Dict[str, Any]:
         with self._lock:

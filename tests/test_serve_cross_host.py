@@ -129,6 +129,56 @@ class TestServeCrossHost:
             recovered += 1
         assert recovered >= 8, "traffic never recovered after host death"
 
+    def test_per_host_proxy_on_joined_runtime(self, serve_cluster):
+        """Per-host ingress (reference: one ProxyActor per node): a proxy
+        placed on a joined runtime serves HTTP THERE, picks up apps
+        deployed both before and AFTER it started (route-table poll),
+        and routes through back-channel handles."""
+        import json
+        import urllib.request
+
+        rt, procs = serve_cluster
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1,
+                          ray_actor_options={"num_cpus": 0.1})
+        class Before:
+            def __call__(self, x):
+                return {"app": "before", "x": x}
+
+        serve.run(Before.bind(), name="before")
+        proxy, port = serve.start_proxy(
+            actor_options={"resources": {"replica_pool": 0.2}},
+            host="127.0.0.1",
+        )
+
+        def post(route, payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/{route}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+        assert post("before", 1)["result"] == {"app": "before", "x": 1}
+
+        @serve.deployment(num_replicas=1,
+                          ray_actor_options={"num_cpus": 0.1})
+        class After:
+            def __call__(self, x):
+                return {"app": "after", "x": x}
+
+        serve.run(After.bind(), name="after")
+        deadline = time.monotonic() + 30
+        out = None
+        while time.monotonic() < deadline:
+            try:
+                out = post("after", 2)["result"]
+                break
+            except Exception:
+                time.sleep(0.3)  # proxy's route poll hasn't ticked yet
+        assert out == {"app": "after", "x": 2}
+        ray_tpu.get(proxy.stop.remote(), timeout=30)
+
     def test_replica_handle_composition_across_hosts(self, serve_cluster):
         """Model composition: a replica on a joined host resolves ANOTHER
         deployment's handle and calls through it (the pattern the r4
