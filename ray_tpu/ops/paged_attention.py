@@ -47,25 +47,21 @@ def _paged_reference(q, k_pages, v_pages, page_table, lengths, scale):
     return o.reshape(B, H, D).astype(q.dtype)
 
 
-def _paged_kernel(
-    # scalar prefetch
-    pt_ref, len_ref,
-    # inputs
-    q_ref, k_hbm, v_hbm,
-    # outputs
-    o_ref,
-    # scratch
-    k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
-    *, page_size, pages_per_seq, scale,
+def _flash_page_loop(
+    q2d, n_pages, page_id_fn, mask_fn, c,
+    k_hbm, v_hbm, k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+    *, page_size, scale,
 ):
-    b = pl.program_id(0)
-    c = pl.program_id(1)
-    g, D = q_ref.shape[2], q_ref.shape[3]
-    length = len_ref[b]
-    n_pages = jax.lax.div(length + page_size - 1, page_size)
+    """The shared double-buffered page-DMA flash loop: stream this kv
+    head's pages HBM->VMEM two-deep while the MXU runs the online-softmax
+    update for q2d [rows, D]. Kernels differ only in how a loop index
+    maps to a page id (page_id_fn) and in the validity mask
+    (mask_fn(i) -> [rows, page_size] bool); everything else — slot
+    rotation, the exp-underflow guard, the l==0 epilogue division — is
+    one implementation serving both decode and chunk prefill."""
 
     def page_dma(slot, i):
-        page = pt_ref[b * pages_per_seq + i]
+        page = page_id_fn(i)
         kcp = pltpu.make_async_copy(k_hbm.at[c, page], k_buf.at[slot], sem_ref.at[slot, 0])
         vcp = pltpu.make_async_copy(v_hbm.at[c, page], v_buf.at[slot], sem_ref.at[slot, 1])
         return kcp, vcp
@@ -94,13 +90,12 @@ def _paged_kernel(
             kw.wait()
             vw.wait()
 
-            q = q_ref[0, 0].astype(jnp.float32)  # [g, D]
             k = k_buf[slot].astype(jnp.float32)  # [ps, D]
             s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            ) * scale  # [g, ps]
-            pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (g, page_size), 1)
-            s = jnp.where(pos < length, s, _NEG_INF)
+                q2d, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [rows, ps]
+            s = jnp.where(mask_fn(i), s, _NEG_INF)
 
             m_prev, l_prev = m_ref[...], l_ref[...]
             m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -122,7 +117,38 @@ def _paged_kernel(
 
     l = l_ref[...][:, :1]
     l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+    return (acc_ref[...] / l)
+
+
+def _paged_kernel(
+    # scalar prefetch
+    pt_ref, len_ref,
+    # inputs
+    q_ref, k_hbm, v_hbm,
+    # outputs
+    o_ref,
+    # scratch
+    k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+    *, page_size, pages_per_seq, scale,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    g = q_ref.shape[2]
+    length = len_ref[b]
+    n_pages = jax.lax.div(length + page_size - 1, page_size)
+
+    def mask(i):
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (g, page_size), 1)
+        return pos < length
+
+    out = _flash_page_loop(
+        q_ref[0, 0].astype(jnp.float32), n_pages,
+        lambda i: pt_ref[b * pages_per_seq + i], mask, c,
+        k_hbm, v_hbm, k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+        page_size=page_size, scale=scale,
+    )
+    o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def _paged_pallas(q, k_pages, v_pages, page_table, lengths, scale):
@@ -162,6 +188,156 @@ def _paged_pallas(q, k_pages, v_pages, page_table, lengths, scale):
         interpret=interpret_mode(),
     )(page_table.reshape(-1), lengths, q4, k_pages, v_pages)
     return out.reshape(B, H, D)
+
+
+def _chunk_reference(q, k_pages, v_pages, page_table, start, total, scale):
+    """Gather-based fallback for ONE sequence's prefill chunk.
+    q [C,H,D] -> o [C,H,D]; key j visible to query row c iff
+    j <= start + c and j < total."""
+    C, H, D = q.shape
+    KVH, _, page_size, _ = k_pages.shape
+    g = H // KVH
+    pages_per_seq = page_table.shape[0]
+    ctx = pages_per_seq * page_size
+    kg = k_pages[:, page_table].reshape(KVH, ctx, D)
+    vg = v_pages[:, page_table].reshape(KVH, ctx, D)
+    qf = q.reshape(C, KVH, g, D).astype(jnp.float32)
+    s = jnp.einsum("ckgd,ktd->ckgt", qf, kg.astype(jnp.float32)) * scale
+    keypos = jnp.arange(ctx)
+    qpos = start + jnp.arange(C)
+    mask = (keypos[None, :] <= qpos[:, None]) & (keypos[None, :] < total)
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask[:, None, None, :], p, 0.0)
+    o = jnp.einsum("ckgt,ktd->ckgd", p, vg.astype(jnp.float32))
+    return o.reshape(C, H, D).astype(q.dtype)
+
+
+def _chunk_kernel(
+    # scalar prefetch
+    pt_ref, meta_ref,
+    # inputs
+    q_ref, k_hbm, v_hbm,
+    # outputs
+    o_ref,
+    # scratch
+    k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+    *, page_size, scale, rows, group,
+):
+    """One kv head's chunk attention: q block [rows=C*g, D] vs the
+    sequence's paged prefix (chunk KV already written into pages by the
+    caller). The shared _flash_page_loop with a per-ROW causal bound
+    instead of the decode kernel's one scalar length."""
+    c = pl.program_id(0)
+    start = meta_ref[0]
+    total = meta_ref[1]
+    n_pages = jax.lax.div(total + page_size - 1, page_size)
+
+    def mask(i):
+        keypos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 0) // group
+        return (keypos <= qpos) & (keypos < total)
+
+    out = _flash_page_loop(
+        q_ref[0].astype(jnp.float32), n_pages,
+        lambda i: pt_ref[i], mask, c,
+        k_hbm, v_hbm, k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+        page_size=page_size, scale=scale,
+    )
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _chunk_pallas(q, k_pages, v_pages, page_table, meta, scale):
+    C, H, D = q.shape
+    KVH, _, page_size, _ = k_pages.shape
+    g = H // KVH
+    rows = C * g
+    # [C,H,D] -> [KVH, C*g, D]: each kv head's q rows contiguous
+    qr = q.reshape(C, KVH, g, D).transpose(1, 0, 2, 3).reshape(KVH, rows, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(KVH,),
+        in_specs=[
+            pl.BlockSpec((1, rows, D), lambda c, *_: (c, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, rows, D), lambda c, *_: (c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, D), k_pages.dtype),
+            pltpu.VMEM((2, page_size, D), v_pages.dtype),
+            pltpu.VMEM((rows, D), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel, page_size=page_size, scale=scale,
+            rows=rows, group=g,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((KVH, rows, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret_mode(),
+    )(page_table, meta, qr, k_pages, v_pages)
+    # [KVH, C*g, D] -> [C, H, D]
+    return out.reshape(KVH, C, g, D).transpose(1, 0, 2, 3).reshape(C, H, D)
+
+
+def paged_attention_chunk(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    start,
+    total,
+    scale: float | None = None,
+    force_xla: bool = False,
+) -> jax.Array:
+    """Chunked-prefill attention for ONE sequence over its paged KV.
+
+    The serving engine writes a prompt chunk's KV into the sequence's
+    pages, then calls this with the chunk's queries: key position j is
+    visible to query row c iff ``j <= start + c`` (prefix + causal
+    intra-chunk) and ``j < total``. Reads only ceil(total/page_size)
+    pages — the XLA gather fallback touches the whole table, which is
+    the difference at long context.
+
+    Args:
+      q: [C, H, D] — the chunk's queries (rope applied).
+      k_pages/v_pages: [KVH, num_pages, page_size, D] (chunk KV written).
+      page_table: [pages_per_seq] int32 page ids for this sequence.
+      start: scalar int — the chunk's first token position.
+      total: scalar int — visibility cap (usually start + C).
+    Returns [C, H, D].
+    """
+    C, H, D = q.shape
+    KVH = k_pages.shape[0]
+    if scale is None:
+        scale = D**-0.5
+    kernel_ok = use_pallas() and D % _LANES == 0 and H % KVH == 0
+    if force_xla or not kernel_ok:
+        return _chunk_reference(q, k_pages, v_pages, page_table,
+                                start, total, scale)
+
+    def run_pallas(q, kp, vp, pt, meta):
+        return _chunk_pallas(q, kp, vp, pt, meta, scale)
+
+    meta = jnp.stack([jnp.asarray(start, jnp.int32),
+                      jnp.asarray(total, jnp.int32)])
+    return platform_dispatch(
+        run_pallas,
+        lambda q, kp, vp, pt, _m: _chunk_reference(
+            q, kp, vp, pt, start, total, scale),
+        q, k_pages, v_pages, page_table, meta,
+    )
 
 
 def paged_attention_decode(

@@ -50,7 +50,12 @@ from ..models.transformer import (
     _norm,
     prefill,
 )
-from ..ops import apply_rope, paged_attention_decode, rope_frequencies
+from ..ops import (
+    apply_rope,
+    paged_attention_chunk,
+    paged_attention_decode,
+    rope_frequencies,
+)
 
 logger = get_logger("serve.engine")
 
@@ -403,15 +408,18 @@ class InferenceEngine:
 
     def _build_chunk_prefill(self):
         """Jit a C-token prefill chunk: compute the chunk's qkv, scatter
-        its KV into the sequence's pages, and attend q over the FULL
-        paged prefix (positions masked). One compiled shape serves every
-        chunk (partial tails pad to C). The attention is the XLA gather
-        path — correctness first; the Pallas chunk kernel can swap in
-        under the same signature."""
+        its KV into the sequence's pages, and attend q over the paged
+        prefix (per-row causal bound). Attention runs the Pallas chunk
+        kernel (ops.paged_attention_chunk: double-buffered page DMAs,
+        reads only the valid prefix pages) where shapes allow; the XLA
+        gather fallback — which touches the whole table — covers CPU
+        tests, odd head dims, and TP meshes (GSPMD partitions the
+        fallback's einsums; a bare pallas_call it cannot)."""
         cfg, ecfg = self.cfg, self.ecfg
         ps = ecfg.page_size
         pps = ecfg.pages_per_seq
         hd = cfg.hdim
+        tp_force_xla = self._tp > 1
 
         def chunk_step(params, k_pages, v_pages, tokens, start, page_table,
                        last_idx):
@@ -419,9 +427,6 @@ class InferenceEngine:
             Returns (logits_at_last_idx, k_pages, v_pages)."""
             dtype = jnp.dtype(cfg.dtype)
             C = tokens.shape[0]
-            H, KVH = cfg.n_heads, cfg.kv_heads
-            groups = H // KVH
-            total = pps * ps
             x = _embed_lookup(params["embed"], tokens[None, :], dtype,
                               mesh=self.mesh)  # [1,C,D]
             positions = start + jnp.arange(C)
@@ -433,13 +438,6 @@ class InferenceEngine:
                     cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
             page_idx = page_table[positions // ps]  # [C]
             slot_idx = positions % ps
-            # key j visible to query i iff j <= start + i (prefix + causal
-            # intra-chunk); pad tail positions past true_len write KV into
-            # allocated pages but are never selected by last_idx and are
-            # invisible to later decode (position bound)
-            key_pos = jnp.arange(total)
-            mask = key_pos[None, :] <= positions[:, None]  # [C, total]
-            scale = 1.0 / (hd ** 0.5)
 
             def body(carry, xs):
                 x = carry
@@ -456,19 +454,14 @@ class InferenceEngine:
                     k[0].transpose(1, 0, 2).astype(kp.dtype))
                 vp = vp.at[:, page_idx, slot_idx].set(
                     v[0].transpose(1, 0, 2).astype(vp.dtype))
-                # gather THIS sequence's pages (chunk KV now included)
-                keys = kp[:, page_table].reshape(KVH, total, hd)
-                vals = vp[:, page_table].reshape(KVH, total, hd)
-                qh = q[0].reshape(C, KVH, groups, hd)
-                scores = jnp.einsum(
-                    "ckgh,kth->ckgt",
-                    qh.astype(jnp.float32), keys.astype(jnp.float32),
-                ) * scale
-                scores = jnp.where(mask[:, None, None, :], scores,
-                                   jnp.float32(-1e30))
-                p = jax.nn.softmax(scores, axis=-1)
-                o = jnp.einsum("ckgt,kth->ckgh", p, vals.astype(jnp.float32))
-                o = o.reshape(C, H, hd).astype(dtype)
+                # key j visible to query row c iff j <= start + c (prefix
+                # + causal intra-chunk); pad rows past true_len write KV
+                # but are never selected by last_idx and are invisible to
+                # later decode (position bound)
+                o = paged_attention_chunk(
+                    q[0], kp, vp, page_table, start, start + C,
+                    force_xla=tp_force_xla,
+                ).astype(dtype)
                 o = jnp.einsum("chk,hkd->cd", o, lp["wo"].astype(dtype))[None]
                 x = x + o
                 h = _norm(x, lp["ln2"], lp.get("ln2_b"), cfg)

@@ -255,3 +255,50 @@ class TestPagedAttentionTP:
             lambda *a: paged_attention_decode(*a, mesh=mesh)
         )(qs, kps, vps, ts, ls)
         np.testing.assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
+
+
+class TestPagedAttentionChunk:
+    """Chunked-prefill attention kernel (ops.paged_attention_chunk): a
+    C-token query block over ONE sequence's paged KV with the per-row
+    causal bound (key j visible to row c iff j <= start+c and j < total).
+    Pallas branch runs in interpret mode on CPU via kernel_mode."""
+
+    def _setup(self, C=32, H=6, KVH=2, D=128, page_size=16, pages_per_seq=8):
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = _rand(ks[0], (C, H, D))
+        kp = _rand(ks[1], (KVH, pages_per_seq + 4, page_size, D))
+        vp = _rand(ks[2], (KVH, pages_per_seq + 4, page_size, D))
+        pt = (1 + jnp.arange(pages_per_seq, dtype=jnp.int32))
+        return q, kp, vp, pt
+
+    @pytest.mark.parametrize("start,extra", [(0, 0), (37, 0), (0, -19)])
+    def test_matches_reference(self, kernel_mode, start, extra):
+        from ray_tpu.ops.paged_attention import (
+            _chunk_reference,
+            paged_attention_chunk,
+        )
+
+        q, kp, vp, pt = self._setup()
+        C = q.shape[0]
+        total = start + C + extra  # extra<0: visibility cap mid-chunk
+        out = paged_attention_chunk(q, kp, vp, pt, start, total)
+        ref = _chunk_reference(q, kp, vp, pt, start, total, q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_matches_causal_flash_at_start_zero(self, kernel_mode):
+        # start=0, total=C: the chunk IS the whole sequence — must equal
+        # plain causal attention over the same contiguous KV
+        from ray_tpu.ops.paged_attention import paged_attention_chunk
+
+        C, H, KVH, D, ps = 32, 4, 4, 128, 16
+        q, kp, vp, pt = self._setup(C, H, KVH, D, ps, pages_per_seq=2)
+        out = paged_attention_chunk(q, kp, vp, pt, 0, C)
+        kg = kp[:, pt].reshape(KVH, 2 * ps, D)[:, :C]
+        vg = vp[:, pt].reshape(KVH, 2 * ps, D)[:, :C]
+        o_ref = mha_reference(
+            q[None],  # [1, C, H, D]
+            jnp.swapaxes(kg, 0, 1)[None],
+            jnp.swapaxes(vg, 0, 1)[None],
+            causal=True,
+        )
+        np.testing.assert_allclose(out, o_ref[0], atol=2e-3, rtol=2e-3)
