@@ -22,12 +22,14 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_cross_host.py tests/test_fault_tolerance.py \
 	tests/test_sched.py tests/test_dag.py tests/test_collectives.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
-	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py
+	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py \
+	tests/test_tracing.py
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
-	tests/test_dashboard.py tests/test_integrations.py \
-	tests/test_platform.py tests/test_microbenchmark.py
+	tests/test_serve_cross_host.py tests/test_dashboard.py \
+	tests/test_integrations.py tests/test_platform.py \
+	tests/test_microbenchmark.py
 
 MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \

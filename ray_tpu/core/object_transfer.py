@@ -17,10 +17,14 @@ runtimes.
 
 from __future__ import annotations
 
+import hashlib
+import itertools
+import os
 import pickle
 import socket
 import socketserver
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from .ids import ObjectID
@@ -34,6 +38,29 @@ DEFAULT_CHUNK_BYTES = 1 << 20  # ~1MB, the reference's chunk size
 
 KV_PREFIX = "object_transfer/"  # control-plane KV key prefix for addresses
 
+# Native fast path (_shm/transfer.cc): the holder stages the serialized
+# blob in a shm arena once, a C++ thread streams it zero-copy, and the
+# puller lands it straight in its own arena — Python never allocates or
+# copies on the data path. Sized by this env knob; objects larger than
+# the staging arena ride the chunked Python path below.
+STAGING_BYTES = int(os.environ.get("RAY_TPU_TRANSFER_STAGING_BYTES",
+                                   str(256 << 20)))
+
+
+_staging_seq = itertools.count()  # unique arena names (id() can be reused)
+
+
+def _staging_name(tag: str) -> str:
+    return f"/rtpu_{tag}_{os.getpid()}_{next(_staging_seq)}"
+
+
+def _stage_id(oid: bytes, raw: bool) -> bytes:
+    """Staging-arena id for (object, raw-flag): sha1 maps the 28-byte
+    ObjectID onto the store's 20-byte ids, deterministically on both ends
+    of the pull. raw=True serves the SEALED payload — a different blob
+    for the same object — so it hashes to a distinct staging id."""
+    return hashlib.sha1(oid + (b"r" if raw else b"")).digest()
+
 _pulled_chunks = Counter(
     "object_transfer_chunks_pulled", "Chunks pulled from remote runtimes."
 )
@@ -44,6 +71,23 @@ _pulled_bytes = Counter(
 
 class ObjectPullError(RuntimeError):
     pass
+
+
+_NATIVE_MISS = object()  # sentinel: native path unavailable, use chunks
+
+
+def _make_client_native():
+    from .shm_store import NativeTransferClient, ShmObjectStore
+
+    staging = ShmObjectStore(
+        _staging_name("xc"), capacity=STAGING_BYTES, max_objects=1024,
+    )
+    try:
+        native = NativeTransferClient()
+    except Exception:
+        staging.close()
+        raise
+    return staging, native, lambda n: n.close()
 
 
 def _serialize_for_wire(value: Any) -> bytes:
@@ -83,6 +127,11 @@ class _TransferHandler(socketserver.BaseRequestHandler):
             oid_hex, *rest = req["args"]
             blob = server._blob_for(oid_hex, raw=bool(rest and rest[0]))
             return {"id": req["id"], "ok": True, "value": len(blob)}
+        if method == "stage":
+            oid_hex, raw = req["args"]
+            size, native_port = server._stage(oid_hex, bool(raw))
+            return {"id": req["id"], "ok": True,
+                    "value": {"size": size, "native_port": native_port}}
         if method == "chunk":
             oid_hex, offset, length, *rest = req["args"]
             blob = server._blob_for(oid_hex, raw=bool(rest and rest[0]))
@@ -94,6 +143,87 @@ class _TransferHandler(socketserver.BaseRequestHandler):
             return {"id": req["id"], "ok": True,
                     "value": bool(server._store.contains(oid))}
         raise WireError(f"unknown method {method!r}")
+
+
+class _NativePlane:
+    """Owns one side's native-path pair (staging arena + C++ endpoint)
+    with the init/commit/teardown choreography the server and client
+    share. `make()` runs on a background thread (a cold environment may
+    have to COMPILE the shm library — no request or pull ever waits on
+    that); `acquire()/release()` hold a use count so `teardown()` never
+    munmaps the arena under an in-flight, GIL-released native call."""
+
+    def __init__(self, name: str, make):
+        self._name = name
+        self._make = make  # () -> (staging, native, stop_native)
+        self.staging = None
+        self.native = None
+        self._stop_native = None
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._started = False
+        self._users = 0
+
+    def start_async(self) -> None:
+        with self._lock:
+            if self._started or self._closed:
+                return
+            self._started = True
+        threading.Thread(target=self._init, daemon=True,
+                         name=self._name).start()
+
+    def _init(self) -> None:
+        try:
+            staging, native, stop_native = self._make()
+        except Exception:  # noqa: BLE001 — the chunked path remains
+            logger.warning("%s unavailable", self._name, exc_info=True)
+            return
+        with self._lock:
+            if not self._closed:
+                self.staging = staging
+                self.native = native
+                self._stop_native = stop_native
+                return
+        stop_native(native)  # teardown() won the race
+        staging.close()
+
+    def acquire(self):
+        """-> (native, staging) with a use hold, or (None, None). A
+        non-None acquire MUST be paired with release()."""
+        with self._lock:
+            if self._closed or self.native is None:
+                return None, None
+            self._users += 1
+            return self.native, self.staging
+
+    def release(self) -> None:
+        with self._lock:
+            self._users -= 1
+            if self._users == 0:
+                self._cond.notify_all()
+
+    def teardown(self, wait_s: float = 5.0) -> None:
+        with self._lock:
+            self._closed = True
+            native, staging = self.native, self.staging
+            stop_native = self._stop_native
+            self.native = self.staging = self._stop_native = None
+            deadline = time.monotonic() + wait_s
+            while self._users > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    # leaking an arena beats munmapping it under a live
+                    # native call (use-after-unmap in the C recv/send)
+                    logger.warning("%s busy at teardown; leaking arena",
+                                   self._name)
+                    native = staging = None
+                    break
+                self._cond.wait(left)
+        if native is not None:
+            stop_native(native)
+        if staging is not None:
+            staging.close()
 
 
 class ObjectTransferServer(socketserver.ThreadingTCPServer):
@@ -111,11 +241,63 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         self._store = store
         self._blob_cache: Dict[Tuple[str, bool], bytes] = {}
         self._cache_lock = threading.Lock()
+        self._plane = _NativePlane("native-transfer-server",
+                                   self._make_native)
+        self._plane.start_async()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True, name="object-transfer"
         )
         self._thread.start()
         logger.info("object transfer plane on %s:%d", *self.server_address)
+
+    def _make_native(self):
+        from .shm_store import NativeTransferServer, ShmObjectStore
+
+        staging = ShmObjectStore(
+            _staging_name("xs"), capacity=STAGING_BYTES, max_objects=1024,
+        )
+        try:
+            native = NativeTransferServer(staging,
+                                          host=self.server_address[0])
+        except Exception:
+            staging.close()
+            raise
+        logger.info("native transfer plane on port %d", native.port)
+        return staging, native, lambda n: n.stop()
+
+    def _stage(self, oid_hex: str, raw: bool) -> Tuple[int, Optional[int]]:
+        """Ensure the blob for (oid, raw) sits in the staging arena; ->
+        (size, native_port). native_port None = use the chunked path."""
+        try:
+            sid = _stage_id(ObjectID.from_hex(oid_hex).binary(), raw)
+        except (ValueError, TypeError):
+            sid = None  # non-ObjectID key: chunked path only
+        native, staging = self._plane.acquire() if sid is not None \
+            else (None, None)
+        if native is None:
+            return len(self._blob_for(oid_hex, raw=raw)), None
+        try:
+            view = staging.get_view(sid)
+            if view is not None:  # already staged: size from the arena,
+                try:              # no re-pickle of the value
+                    return len(view), native.port
+                finally:
+                    staging.release(sid)
+            blob = self._blob_for(oid_hex, raw=raw)
+            if len(blob) > (STAGING_BYTES * 3) // 4:
+                return len(blob), None
+            try:
+                staging.put(sid, blob)
+            except Exception:  # noqa: BLE001 — races/arena pressure
+                if not staging.contains(sid):
+                    return len(blob), None  # cannot stage: chunked fallback
+            # the arena copy now serves all pulls; dropping the byte-cache
+            # entry halves holder-side residency for large objects
+            with self._cache_lock:
+                self._blob_cache.pop((oid_hex, raw), None)
+            return len(blob), native.port
+        finally:
+            self._plane.release()
 
     @property
     def address(self) -> str:
@@ -146,6 +328,7 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
     def stop(self) -> None:
         self.shutdown()
         self.server_close()
+        self._plane.teardown()
 
 
 class ObjectTransferClient:
@@ -158,6 +341,8 @@ class ObjectTransferClient:
         self._locks: Dict[str, threading.Lock] = {}
         self._global_lock = threading.Lock()
         self._next_id = 0
+        self._plane = _NativePlane("native-transfer-client",
+                                   _make_client_native)
 
     def _conn(self, address: str) -> Tuple[socket.socket, threading.Lock]:
         with self._global_lock:
@@ -204,11 +389,25 @@ class ObjectTransferClient:
         """Pull one object from the holder at `address`; returns the value
         (raw=True: the sealed payload, store.get_raw parity).
 
-        Chunks sequentially over one connection: the transfer is bandwidth
-        -bound, not latency-bound, at ~1MB chunks (matching the reference's
+        Fast path: one "stage" round trip on the control connection, then
+        the C++ plane streams the blob arena-to-arena (_shm/transfer.cc)
+        and the value unpickles from a zero-copy view. Fallback: ~1MB
+        chunks over the control connection (matching the reference's
         ObjectBufferPool sizing)."""
         oid_hex = object_id.hex() if hasattr(object_id, "hex") else str(object_id)
-        total = self._call(address, "meta", oid_hex, raw)
+        try:
+            staged = self._call(address, "stage", oid_hex, raw)
+            total, native_port = staged["size"], staged["native_port"]
+        except ObjectPullError as e:
+            if "unknown method" not in str(e):
+                raise
+            # holder predates the staged protocol: chunked path via "meta"
+            total, native_port = self._call(address, "meta", oid_hex, raw), None
+        if native_port is not None:
+            value = self._pull_native(address, native_port, oid_hex, raw,
+                                      total)
+            if value is not _NATIVE_MISS:
+                return value
         parts = []
         offset = 0
         while offset < total:
@@ -224,6 +423,53 @@ class ObjectTransferClient:
             _pulled_bytes.inc(len(chunk))
         return pickle.loads(b"".join(parts))
 
+    def _pull_native(self, address: str, native_port: int, oid_hex: str,
+                     raw: bool, total: int) -> Any:
+        """One native arena-to-arena pull; returns _NATIVE_MISS to send the
+        caller down the chunked path (never raises for availability-class
+        failures — the chunked path is the answer to all of them)."""
+        from .shm_store import PullRejected, ShmStoreError
+
+        self._plane.start_async()  # idempotent; first pull rides chunks
+        native, staging = self._plane.acquire()
+        if native is None:
+            return _NATIVE_MISS
+        host = address.rpartition(":")[0]
+        try:
+            sid = _stage_id(ObjectID.from_hex(oid_hex).binary(), raw)
+        except (ValueError, TypeError):
+            self._plane.release()
+            return _NATIVE_MISS
+        try:
+            if not staging.contains(sid):
+                n = native.pull_into(host, native_port, sid, staging)
+                if n is None:
+                    # staged blob evicted between stage and pull: restage
+                    # once (the holder re-pins it), then give up to chunks
+                    self._call(address, "stage", oid_hex, raw)
+                    n = native.pull_into(host, native_port, sid, staging)
+                    if n is None:
+                        return _NATIVE_MISS
+            view = staging.get_view(sid)
+            if view is None:
+                return _NATIVE_MISS  # evicted locally before the read
+            try:
+                value = pickle.loads(view)
+            finally:
+                staging.release(sid)
+                staging.delete(sid)
+            _pulled_chunks.inc()
+            _pulled_bytes.inc(total)
+            return value
+        except PullRejected:
+            return _NATIVE_MISS  # does not fit the local arena
+        except ShmStoreError as e:
+            logger.warning("native pull from %s:%d failed (%s); "
+                           "falling back to chunks", host, native_port, e)
+            return _NATIVE_MISS
+        finally:
+            self._plane.release()
+
     def close(self) -> None:
         with self._global_lock:
             conns = list(self._conns.values())
@@ -234,6 +480,7 @@ class ObjectTransferClient:
                 sock.close()
             except OSError:
                 pass
+        self._plane.teardown()
 
 
 def serve_object_transfer(runtime, host: str = "127.0.0.1",
@@ -252,25 +499,35 @@ def serve_object_transfer(runtime, host: str = "127.0.0.1",
     return server
 
 
+_default_client: Optional[ObjectTransferClient] = None
+_default_client_lock = threading.Lock()
+
+
+def _shared_client() -> ObjectTransferClient:
+    """Process-wide default puller. Long-lived so the native path's
+    connections and staging arena amortize across calls — a per-call
+    client would pay arena setup/teardown per object."""
+    global _default_client
+    with _default_client_lock:
+        if _default_client is None:
+            _default_client = ObjectTransferClient()
+        return _default_client
+
+
 def pull_from_any(control_plane, object_id,
                   client: Optional[ObjectTransferClient] = None) -> Any:
     """Resolve `object_transfer/*` advertisements from the control plane
     and try each holder until one serves the object."""
-    own = client is None
-    client = client or ObjectTransferClient()
-    try:
-        errors = []
-        for key in control_plane.kv_keys(KV_PREFIX):
-            address = control_plane.kv_get(key)
-            if not address:
-                continue
-            try:
-                return client.pull(address, object_id)
-            except ObjectPullError as e:
-                errors.append((address, str(e)))
-        raise ObjectPullError(
-            f"no advertised holder served {object_id}: {errors}"
-        )
-    finally:
-        if own:
-            client.close()
+    client = client or _shared_client()
+    errors = []
+    for key in control_plane.kv_keys(KV_PREFIX):
+        address = control_plane.kv_get(key)
+        if not address:
+            continue
+        try:
+            return client.pull(address, object_id)
+        except ObjectPullError as e:
+            errors.append((address, str(e)))
+    raise ObjectPullError(
+        f"no advertised holder served {object_id}: {errors}"
+    )

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import socket
 import subprocess
 import threading
 import weakref
@@ -35,6 +36,11 @@ class ShmStoreError(RuntimeError):
     pass
 
 
+class PullRejected(ShmStoreError):
+    """Native pull could not land in the destination store (too large for
+    the arena); the caller should fall back to the buffered path."""
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     with _lock:
@@ -53,10 +59,32 @@ def _load() -> ctypes.CDLL:
             build()
         try:
             lib = ctypes.CDLL(_SO)
+            _bind(lib)
         except OSError:
             # Stale binary for another arch/libc: rebuild from source.
             build()
             lib = ctypes.CDLL(_SO)
+            _bind(lib)
+        except AttributeError:
+            # Binary predates a symbol this binding needs (e.g. a .so built
+            # before the transfer plane existed): rebuild. dlopen caches by
+            # path, so if the fresh build STILL lacks the symbol in this
+            # process, fail with a clear error instead of an AttributeError
+            # that would brick every store construction.
+            build()
+            lib = ctypes.CDLL(_SO)
+            try:
+                _bind(lib)
+            except AttributeError as e:
+                raise ShmStoreError(
+                    f"libshm_store.so rebuilt but still missing {e}; "
+                    "restart the process to drop the stale dlopen mapping"
+                ) from e
+        _lib = lib
+        return lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
         lib.shm_store_create.restype = ctypes.c_void_p
         lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
         lib.shm_store_open.restype = ctypes.c_void_p
@@ -78,8 +106,30 @@ def _load() -> ctypes.CDLL:
         lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
         lib.shm_store_close.restype = None
         lib.shm_store_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return lib
+        # native transfer plane (_shm/transfer.cc)
+        lib.shm_transfer_server_start.restype = ctypes.c_void_p
+        lib.shm_transfer_server_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)
+        ]
+        lib.shm_transfer_server_port.restype = ctypes.c_int
+        lib.shm_transfer_server_port.argtypes = [ctypes.c_void_p]
+        lib.shm_transfer_server_stop.restype = None
+        lib.shm_transfer_server_stop.argtypes = [ctypes.c_void_p]
+        lib.shm_transfer_connect.restype = ctypes.c_int
+        lib.shm_transfer_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int
+        ]
+        lib.shm_transfer_pull_buf.restype = ctypes.c_int64
+        lib.shm_transfer_pull_buf.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64
+        ]
+        lib.shm_transfer_pull_store.restype = ctypes.c_int64
+        lib.shm_transfer_pull_store.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p
+        ]
+        lib.shm_transfer_close_fd.restype = None
+        lib.shm_transfer_close_fd.argtypes = [ctypes.c_int]
 
 
 def _check_id(object_id: bytes) -> bytes:
@@ -235,3 +285,147 @@ class ShmObjectStore:
             self.close()
         except Exception:
             pass
+
+
+class NativeTransferServer:
+    """C++ serving thread streaming sealed objects from `store`'s arena
+    (_shm/transfer.cc). The store must stay open for the server's life —
+    the server holds a raw handle into it."""
+
+    def __init__(self, store: ShmObjectStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._lib = _load()
+        self._store = store  # keep the mapping alive
+        host = socket.gethostbyname(host)  # the C side wants a dotted quad
+        port_out = ctypes.c_int()
+        self._h = self._lib.shm_transfer_server_start(
+            store._handle(), host.encode(), port, ctypes.byref(port_out)
+        )
+        if not self._h:
+            raise ShmStoreError("cannot start native transfer server")
+        self.port = port_out.value
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.shm_transfer_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class NativeTransferClient:
+    """Pulls whole objects over one pooled connection per holder. The recv
+    loop runs in C with the GIL released; -2 (missing) and -3 (exceeds the
+    caller's buffer) are soft failures the caller can fall back from.
+    Connect and every send/recv are bounded by `timeout_s` (enforced in C
+    via SO_RCVTIMEO/SO_SNDTIMEO) so a blackholed holder fails fast instead
+    of wedging the puller."""
+
+    MISSING = -2
+    TOO_LARGE = -3
+
+    def __init__(self, timeout_s: float = 30.0):
+        self._lib = _load()
+        self._timeout_ms = max(1, int(timeout_s * 1000))
+        self._fds: dict = {}
+        self._lock = threading.Lock()
+
+    def _conn(self, host: str, port: int):
+        key = (host, port)
+        with self._lock:
+            conn = self._fds.get(key)
+        if conn is not None:
+            return conn
+        # connect OUTSIDE the registry lock: a slow/unreachable holder
+        # must not block pulls from other holders
+        fd = self._lib.shm_transfer_connect(
+            socket.gethostbyname(host).encode(), port, self._timeout_ms)
+        if fd < 0:
+            raise ShmStoreError(f"cannot connect to {host}:{port}")
+        with self._lock:
+            existing = self._fds.get(key)
+            if existing is not None:  # lost the race: keep the first conn
+                self._lib.shm_transfer_close_fd(fd)
+                return existing
+            conn = (fd, threading.Lock())
+            self._fds[key] = conn
+            return conn
+
+    def pull(self, host: str, port: int, object_id: bytes,
+             size: int) -> Optional[bytearray]:
+        """Pull `object_id` (known `size` from the control path) into a
+        fresh buffer. Returns None when the holder no longer has it."""
+        _check_id(object_id)
+        fd, fd_lock = self._conn(host, port)
+        buf = bytearray(size)
+        c_buf = (ctypes.c_uint8 * size).from_buffer(buf) if size else None
+        with fd_lock:  # request/response pairs must not interleave on one fd
+            rc = self._lib.shm_transfer_pull_buf(fd, object_id, c_buf, size)
+        if rc == self.MISSING:
+            return None
+        if rc == self.TOO_LARGE:
+            # soft failure by contract: the C side drained the payload, so
+            # the pooled connection stays healthy — do NOT drop it
+            raise PullRejected(
+                f"object {object_id.hex()[:8]} is larger than the "
+                f"{size}B buffer the control path promised"
+            )
+        if rc < 0 or rc != size:
+            self._drop(host, port)
+            raise ShmStoreError(
+                f"native pull of {object_id.hex()[:8]} from {host}:{port} "
+                f"failed (rc={rc}, expected {size}B)"
+            )
+        return buf
+
+    def pull_into(self, host: str, port: int, object_id: bytes,
+                  store: ShmObjectStore) -> Optional[int]:
+        """Pull `object_id` straight into `store`'s arena (no Python-side
+        allocation). Returns the size, None when the holder lacks the
+        object, or raises PullRejected when the local create failed and
+        the object is not already present (caller falls back)."""
+        _check_id(object_id)
+        fd, fd_lock = self._conn(host, port)
+        with fd_lock:  # request/response pairs must not interleave on one fd
+            rc = self._lib.shm_transfer_pull_store(fd, object_id,
+                                                   store._handle())
+        if rc == self.MISSING:
+            return None
+        if rc == self.TOO_LARGE:
+            # create failed: either a concurrent pull landed it (reuse) or
+            # it genuinely does not fit this store. get_view (not contains)
+            # pins it — a concurrent delete between the two would otherwise
+            # turn the reuse branch into a crash.
+            view = store.get_view(object_id)
+            if view is not None:
+                try:
+                    return len(view)
+                finally:
+                    store.release(object_id)
+            raise PullRejected(
+                f"object {object_id.hex()[:8]} does not fit store {store.name}"
+            )
+        if rc < 0:
+            self._drop(host, port)
+            raise ShmStoreError(
+                f"native pull of {object_id.hex()[:8]} from {host}:{port} "
+                f"failed (rc={rc})"
+            )
+        return int(rc)
+
+    def _drop(self, host: str, port: int) -> None:
+        with self._lock:
+            conn = self._fds.pop((host, port), None)
+        if conn is not None:
+            self._lib.shm_transfer_close_fd(conn[0])
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._fds.values())
+            self._fds.clear()
+        for fd, _ in conns:
+            self._lib.shm_transfer_close_fd(fd)
