@@ -213,10 +213,14 @@ class _NativePlane:
             while self._users > 0:
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    # leaking an arena beats munmapping it under a live
-                    # native call (use-after-unmap in the C recv/send)
-                    logger.warning("%s busy at teardown; leaking arena",
-                                   self._name)
+                    # leaking the MAPPING beats munmapping it under a live
+                    # native call (use-after-unmap in the C recv/send) —
+                    # but the /dev/shm NAME must still go, or the segment
+                    # outlives the process and fills /dev/shm on restarts
+                    logger.warning("%s busy at teardown; leaking arena "
+                                   "mapping (name unlinked)", self._name)
+                    if staging is not None:
+                        staging.unlink_name()
                     native = staging = None
                     break
                 self._cond.wait(left)
@@ -442,7 +446,23 @@ class ObjectTransferClient:
             return _NATIVE_MISS
         try:
             if not staging.contains(sid):
-                n = native.pull_into(host, native_port, sid, staging)
+                try:
+                    n = native.pull_into(host, native_port, sid, staging)
+                except PullRejected:
+                    # Either the blob truly exceeds the arena, or a
+                    # CONCURRENT pull of the same object holds the id
+                    # unsealed (duplicate create). If it fits, wait
+                    # briefly for the winner to seal instead of paying a
+                    # full chunked re-download of the same bytes.
+                    if total > (STAGING_BYTES * 3) // 4:
+                        return _NATIVE_MISS
+                    deadline = time.monotonic() + 5.0
+                    while (not staging.contains(sid)
+                           and time.monotonic() < deadline):
+                        time.sleep(0.01)
+                    if not staging.contains(sid):
+                        return _NATIVE_MISS
+                    n = total
                 if n is None:
                     # staged blob evicted between stage and pull: restage
                     # once (the holder re-pins it), then give up to chunks
@@ -456,8 +476,10 @@ class ObjectTransferClient:
             try:
                 value = pickle.loads(view)
             finally:
+                # release the pin but keep the sealed blob: concurrent and
+                # repeat pulls of the same (immutable) object hit it here,
+                # and the arena's LRU eviction bounds total residency
                 staging.release(sid)
-                staging.delete(sid)
             _pulled_chunks.inc()
             _pulled_bytes.inc(total)
             return value
@@ -524,10 +546,16 @@ def pull_from_any(control_plane, object_id,
         address = control_plane.kv_get(key)
         if not address:
             continue
-        try:
-            return client.pull(address, object_id)
-        except ObjectPullError as e:
-            errors.append((address, str(e)))
+        # two attempts per holder: the shared client pools connections, so
+        # the first failure after a holder restart (or an idle conn being
+        # dropped) is just the stale socket — the client drops it and the
+        # retry dials fresh
+        for attempt in (0, 1):
+            try:
+                return client.pull(address, object_id)
+            except ObjectPullError as e:
+                if attempt == 1:
+                    errors.append((address, str(e)))
     raise ObjectPullError(
         f"no advertised holder served {object_id}: {errors}"
     )

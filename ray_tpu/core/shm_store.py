@@ -55,31 +55,31 @@ def _load() -> ctypes.CDLL:
             except (subprocess.CalledProcessError, OSError) as e:
                 raise ShmStoreError(f"cannot build libshm_store.so: {e}") from e
 
-        if not os.path.exists(_SO):
-            build()
-        try:
-            lib = ctypes.CDLL(_SO)
-            _bind(lib)
-        except OSError:
-            # Stale binary for another arch/libc: rebuild from source.
-            build()
-            lib = ctypes.CDLL(_SO)
-            _bind(lib)
-        except AttributeError:
-            # Binary predates a symbol this binding needs (e.g. a .so built
-            # before the transfer plane existed): rebuild. dlopen caches by
-            # path, so if the fresh build STILL lacks the symbol in this
-            # process, fail with a clear error instead of an AttributeError
-            # that would brick every store construction.
+        def rebuild_and_bind() -> ctypes.CDLL:
             build()
             lib = ctypes.CDLL(_SO)
             try:
                 _bind(lib)
             except AttributeError as e:
+                # dlopen caches by path: a fresh build that STILL lacks a
+                # symbol in this process must fail with a clear error, not
+                # an AttributeError that bricks every store construction
                 raise ShmStoreError(
                     f"libshm_store.so rebuilt but still missing {e}; "
                     "restart the process to drop the stale dlopen mapping"
                 ) from e
+            return lib
+
+        if not os.path.exists(_SO):
+            build()
+        try:
+            lib = ctypes.CDLL(_SO)
+            _bind(lib)
+        except (OSError, AttributeError):
+            # OSError: binary for another arch/libc. AttributeError: binary
+            # predates a symbol this binding needs (e.g. built before the
+            # transfer plane existed). Either way: rebuild from source.
+            lib = rebuild_and_bind()
         _lib = lib
         return lib
 
@@ -279,6 +279,17 @@ class ShmObjectStore:
         if self._h:
             self._lib.shm_store_close(self._h)
             self._h = None
+
+    def unlink_name(self) -> None:
+        """Remove the /dev/shm name WITHOUT closing the mapping: live
+        pointers stay valid, but no new process can open the store and
+        the segment is reclaimed once the last mapping drops. For
+        teardown paths that must not munmap under in-flight users but
+        also must not leak the name past process exit."""
+        try:
+            os.unlink(os.path.join("/dev/shm", self.name.lstrip("/")))
+        except OSError:
+            pass
 
     def __del__(self):
         try:

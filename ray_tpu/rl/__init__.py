@@ -16,7 +16,17 @@ from .multi_agent import (  # noqa: F401
     MultiAgentPPOConfig,
     MultiCartPole,
 )
-from .offline import BC, BCConfig, load_offline_dataset, rollouts_to_dataset, save_rollouts  # noqa: F401
+from .offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    CQL,
+    CQLConfig,
+    MARWIL,
+    MARWILConfig,
+    load_offline_dataset,
+    rollouts_to_dataset,
+    save_rollouts,
+)
 from .ppo import PPO, PPOConfig, compute_gae  # noqa: F401
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer, SumTree  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401

@@ -20,19 +20,40 @@ from .. import data as rt_data
 from .module import init_mlp_module, mlp_forward
 
 
-def rollouts_to_dataset(rollouts: Iterable[Dict[str, np.ndarray]]):
+def rollouts_to_dataset(rollouts: Iterable[Dict[str, np.ndarray]],
+                        gamma: float = None):
     """Flat rollouts (EnvRunner.sample output) -> row-wise Dataset of
-    {obs, action, reward, done, next_obs} transitions."""
+    {obs, action, reward, done, next_obs} transitions. With `gamma`, each
+    row also carries the Monte-Carlo discounted "return" from its step to
+    the end of its episode (what MARWIL's advantage estimate needs); the
+    trailing PARTIAL episode of each rollout — steps after the last done,
+    cut off by the rollout length, not termination — is dropped in that
+    mode, because its returns would omit all post-truncation reward and
+    systematically bias advantages negative at rollout boundaries."""
     rows: List[Dict[str, Any]] = []
     for ro in rollouts:
-        for t in range(len(ro["obs"])):
-            rows.append({
+        n = len(ro["obs"])
+        returns = np.zeros(n, np.float32)
+        if gamma is not None:
+            done_idx = np.flatnonzero(np.asarray(ro["dones"]))
+            n = int(done_idx[-1]) + 1 if len(done_idx) else 0
+            acc = 0.0
+            for t in reversed(range(n)):
+                if bool(ro["dones"][t]):
+                    acc = 0.0  # episodes are concatenated in one rollout
+                acc = float(ro["rewards"][t]) + gamma * acc
+                returns[t] = acc
+        for t in range(n):
+            row = {
                 "obs": np.asarray(ro["obs"][t], np.float32),
                 "action": int(ro["actions"][t]),
                 "reward": float(ro["rewards"][t]),
                 "done": bool(ro["dones"][t]),
                 "next_obs": np.asarray(ro["next_obs"][t], np.float32),
-            })
+            }
+            if gamma is not None:
+                row["return"] = float(returns[t])
+            rows.append(row)
     return rt_data.from_items(rows)
 
 
@@ -102,3 +123,161 @@ class BC:
             correct += int(jnp.sum(jnp.argmax(logits, -1) == actions))
             total += len(actions)
         return {"loss": float(np.mean(losses)), "accuracy": correct / max(1, total)}
+
+
+@dataclasses.dataclass
+class MARWILConfig:
+    obs_size: int = 4
+    num_actions: int = 2
+    lr: float = 1e-3
+    batch_size: int = 256
+    hidden: tuple = (64, 64)
+    beta: float = 1.0        # 0 = plain BC; >0 weights by exp(beta * adv)
+    vf_coeff: float = 1.0
+    max_weight: float = 20.0  # cap on the exponential advantage weight
+    seed: int = 0
+
+
+class MARWIL:
+    """Monotonic Advantage Re-Weighted Imitation Learning (reference:
+    `rllib/algorithms/marwil/`): behavior cloning where each (obs, action)
+    is weighted by exp(beta * advantage / c), advantage = MC return - V(s),
+    with c^2 a running mean of squared advantages (the reference's moving
+    normalizer) and a jointly-trained value head. Needs the "return"
+    column from `rollouts_to_dataset(..., gamma=...)`."""
+
+    def __init__(self, config: MARWILConfig):
+        self.config = config
+        self.params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), config.obs_size,
+            config.num_actions, config.hidden,
+        )
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.c2 = 1.0  # running E[adv^2] (host scalar, like the reference)
+        cfg = config
+
+        def loss_fn(params, obs, actions, returns, c):
+            logits, value = mlp_forward(params, obs)
+            adv = returns - value
+            weight = jnp.exp(
+                jnp.clip(cfg.beta * jax.lax.stop_gradient(adv) / c,
+                         a_max=jnp.log(cfg.max_weight))
+            )
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+            policy_loss = jnp.mean(weight * nll)
+            vf_loss = jnp.mean(adv ** 2)  # doubles as E[adv^2] for the c^2 ema
+            return policy_loss + cfg.vf_coeff * vf_loss, vf_loss
+
+        @jax.jit
+        def update(params, opt_state, obs, actions, returns, c):
+            (loss, adv_sq), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, obs, actions, returns, c
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, adv_sq
+
+        self._update = update
+
+    def train_epoch(self, dataset) -> Dict[str, float]:
+        losses: List[float] = []
+        for batch in dataset.iter_batches(batch_size=self.config.batch_size):
+            obs = jnp.asarray(np.asarray(batch["obs"], np.float32))
+            actions = jnp.asarray(np.asarray(batch["action"], np.int32))
+            returns = jnp.asarray(np.asarray(batch["return"], np.float32))
+            c = float(np.sqrt(self.c2) + 1e-8)
+            self.params, self.opt_state, loss, adv_sq = self._update(
+                self.params, self.opt_state, obs, actions, returns, c
+            )
+            # moving normalizer: c^2 <- c^2 + 1e-2 (E[adv^2] - c^2)
+            self.c2 += 1e-2 * (float(adv_sq) - self.c2)
+            losses.append(float(loss))
+        return {"loss": float(np.mean(losses)), "c2": self.c2}
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    obs_size: int = 4
+    num_actions: int = 2
+    lr: float = 1e-3
+    batch_size: int = 256
+    hidden: tuple = (64, 64)
+    gamma: float = 0.99
+    alpha: float = 1.0             # conservative penalty coefficient
+    target_update_every: int = 100  # gradient steps between target copies
+    seed: int = 0
+
+
+class CQL:
+    """Conservative Q-Learning, discrete CQL(H) (reference:
+    `rllib/algorithms/cql/`; Kumar et al. 2020): double-DQN TD learning on
+    the offline transitions plus the conservative penalty
+    E[logsumexp_a Q(s,a) - Q(s, a_data)], which pushes Q down on actions
+    the behavior policy never took — the reason plain DQN collapses on
+    offline data and CQL does not. The pi head doubles as the Q head."""
+
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        self.params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), config.obs_size,
+            config.num_actions, config.hidden,
+        )
+        self.target_params = self.params
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.grad_steps = 0
+        cfg = config
+
+        def loss_fn(params, target_params, obs, actions, rewards, dones,
+                    next_obs):
+            q, _ = mlp_forward(params, obs)
+            q_a = jnp.take_along_axis(q, actions[:, None], axis=-1)[:, 0]
+            # double-DQN target: online argmax, target net evaluation
+            next_q_online, _ = mlp_forward(params, next_obs)
+            next_q_target, _ = mlp_forward(target_params, next_obs)
+            best = jnp.argmax(next_q_online, axis=-1)
+            next_v = jnp.take_along_axis(
+                next_q_target, best[:, None], axis=-1)[:, 0]
+            target = rewards + cfg.gamma * (1.0 - dones) * next_v
+            td_loss = jnp.mean(optax.huber_loss(
+                q_a - jax.lax.stop_gradient(target)))
+            cql_penalty = jnp.mean(jax.nn.logsumexp(q, axis=-1) - q_a)
+            return td_loss + cfg.alpha * cql_penalty, (td_loss, cql_penalty)
+
+        @jax.jit
+        def update(params, target_params, opt_state, obs, actions, rewards,
+                   dones, next_obs):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, obs, actions, rewards, dones, next_obs
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = update
+
+    def train_epoch(self, dataset) -> Dict[str, float]:
+        losses, penalties = [], []
+        for batch in dataset.iter_batches(batch_size=self.config.batch_size):
+            obs = jnp.asarray(np.asarray(batch["obs"], np.float32))
+            actions = jnp.asarray(np.asarray(batch["action"], np.int32))
+            rewards = jnp.asarray(np.asarray(batch["reward"], np.float32))
+            dones = jnp.asarray(np.asarray(batch["done"], np.float32))
+            next_obs = jnp.asarray(np.asarray(batch["next_obs"], np.float32))
+            self.params, self.opt_state, loss, aux = self._update(
+                self.params, self.target_params, self.opt_state,
+                obs, actions, rewards, dones, next_obs
+            )
+            self.grad_steps += 1
+            if self.grad_steps % self.config.target_update_every == 0:
+                self.target_params = self.params
+            losses.append(float(loss))
+            penalties.append(float(aux[1]))
+        return {"loss": float(np.mean(losses)),
+                "cql_penalty": float(np.mean(penalties))}
+
+    def act(self, obs: np.ndarray) -> int:
+        q, _ = mlp_forward(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(q[0]))

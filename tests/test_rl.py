@@ -244,6 +244,98 @@ class TestOffline:
             metrics = bc.train_epoch(ds)
         assert metrics["accuracy"] > 0.9, metrics
 
+    def test_mc_returns_drop_truncated_tail(self, ray_start_regular):
+        """With gamma set, the trailing partial episode (steps after the
+        last done) is excluded — its MC returns would omit post-truncation
+        reward and bias MARWIL's advantages at rollout boundaries."""
+        from ray_tpu.rl import rollouts_to_dataset
+
+        n = 10
+        dones = np.zeros(n, np.bool_)
+        dones[5] = True  # episode ends at t=5; t=6..9 are truncated
+        ro = {
+            "obs": np.zeros((n, 4), np.float32),
+            "actions": np.zeros(n, np.int32),
+            "rewards": np.ones(n, np.float32),
+            "dones": dones,
+            "next_obs": np.zeros((n, 4), np.float32),
+        }
+        ds = rollouts_to_dataset([ro], gamma=1.0)
+        rows = list(ds.iter_rows())
+        assert len(rows) == 6  # truncated tail dropped
+        assert rows[0]["return"] == 6.0 and rows[5]["return"] == 1.0
+        # without gamma, all transitions survive (no return column)
+        assert rollouts_to_dataset([ro]).count() == n
+
+    def test_marwil_upweights_high_return_behavior(self, ray_start_regular):
+        """Mixed-quality data: the expert acts by the true score, a noise
+        policy acts uniformly — but expert episodes carry high returns.
+        MARWIL (beta>0) must recover the expert; the advantage weighting
+        is what filters the noise (plain BC on this data caps near the
+        mixture rate)."""
+        from ray_tpu.rl import MARWIL, MARWILConfig, rollouts_to_dataset
+
+        rng = np.random.default_rng(1)
+        w = np.array([1.0, -0.5, 2.0, 0.3], np.float32)
+
+        def episodes(n, expert):
+            obs = rng.normal(size=(n, 4)).astype(np.float32)
+            good = (obs @ w > 0).astype(np.int32)
+            acts = good if expert else rng.integers(0, 2, n).astype(np.int32)
+            rew = np.full(n, 1.0 if expert else 0.0, np.float32)
+            dones = np.zeros(n, np.bool_)
+            dones[np.arange(31, n, 32)] = True  # short episodes
+            return {"obs": obs, "actions": acts, "rewards": rew,
+                    "dones": dones, "next_obs": obs}
+
+        ds = rollouts_to_dataset(
+            [episodes(1024, True), episodes(1024, False)], gamma=0.99)
+        algo = MARWIL(MARWILConfig(obs_size=4, num_actions=2, lr=3e-3,
+                                   beta=2.0, seed=0))
+        for _ in range(10):
+            metrics = algo.train_epoch(ds)
+        assert np.isfinite(metrics["loss"])
+        # imitation quality measured against the EXPERT labels only
+        from ray_tpu.rl.module import mlp_forward
+
+        test_obs = rng.normal(size=(512, 4)).astype(np.float32)
+        logits, _ = mlp_forward(algo.params, test_obs)
+        acc = np.mean(np.argmax(np.asarray(logits), -1) == (test_obs @ w > 0))
+        assert acc > 0.8, acc
+
+    def test_cql_beats_plain_q_on_offline_gap(self, ray_start_regular):
+        """CQL's conservative penalty keeps Q-values for unseen actions
+        from exploding: train on single-action-dominated data and check
+        the penalty shrinks while the loss stays finite, and the learned
+        policy matches the behavior-optimal action."""
+        from ray_tpu.rl import CQL, CQLConfig, rollouts_to_dataset
+
+        rng = np.random.default_rng(2)
+        n = 2048
+        obs = rng.normal(size=(n, 4)).astype(np.float32)
+        w = np.array([1.0, -0.5, 2.0, 0.3], np.float32)
+        good = (obs @ w > 0).astype(np.int32)
+        # behavior data: mostly the good action, rewarded when it matches
+        acts = np.where(rng.random(n) < 0.9, good,
+                        rng.integers(0, 2, n)).astype(np.int32)
+        rew = (acts == good).astype(np.float32)
+        dones = np.ones(n, np.bool_)  # 1-step bandit episodes
+        ds = rollouts_to_dataset([{
+            "obs": obs, "actions": acts, "rewards": rew,
+            "dones": dones, "next_obs": obs,
+        }])
+        algo = CQL(CQLConfig(obs_size=4, num_actions=2, lr=3e-3,
+                             alpha=1.0, seed=0))
+        first = algo.train_epoch(ds)
+        for _ in range(8):
+            metrics = algo.train_epoch(ds)
+        assert np.isfinite(metrics["loss"])
+        assert metrics["cql_penalty"] < first["cql_penalty"]
+        test_obs = rng.normal(size=(256, 4)).astype(np.float32)
+        picked = np.array([algo.act(o) for o in test_obs])
+        acc = np.mean(picked == (test_obs @ w > 0))
+        assert acc > 0.8, acc
+
 
 class TestMultiAgent:
     def test_multicartpole_env_contract(self):
