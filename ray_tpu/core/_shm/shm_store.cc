@@ -246,9 +246,34 @@ void* shm_obj_create(void* handle, const uint8_t* id, uint64_t size) {
   Store* s = (Store*)handle;
   Lock(s);
   Entry* e = FindSlot(s, id, true);
-  if (e == nullptr || (e->used && memcmp(e->id, id, kIdSize) == 0)) {
+  if (e != nullptr && e->used && memcmp(e->id, id, kIdSize) == 0) {
     Unlock(s);
-    return nullptr;  // table full or duplicate
+    return nullptr;  // duplicate
+  }
+  if (e == nullptr) {
+    // Table full: evict the LRU sealed+unpinned entry. ReserveSpace only
+    // evicts under BYTE pressure — many small sealed objects can exhaust
+    // the slot table long before the arena fills, and without this path
+    // the store would refuse all new objects forever.
+    Entry* victim = nullptr;
+    for (uint32_t i = 0; i < s->hdr->max_objects; i++) {
+      Entry* c = &s->entries[i];
+      if (c->used && c->sealed == 1 && c->pins == 0) {
+        if (victim == nullptr || c->lru_tick < victim->lru_tick) victim = c;
+      }
+    }
+    if (victim == nullptr) {
+      Unlock(s);
+      return nullptr;  // everything pinned/unsealed
+    }
+    s->hdr->live_bytes -= victim->size;
+    victim->used = 0;
+    victim->sealed = 2;  // tombstone for probe chains
+    e = FindSlot(s, id, true);
+    if (e == nullptr) {
+      Unlock(s);
+      return nullptr;
+    }
   }
   uint64_t off = ReserveSpace(s, size);
   if (off == UINT64_MAX) {

@@ -347,6 +347,8 @@ class ObjectTransferClient:
         self._next_id = 0
         self._plane = _NativePlane("native-transfer-client",
                                    _make_client_native)
+        self._inflight: set = set()  # sids being pulled by THIS client
+        self._inflight_lock = threading.Lock()
 
     def _conn(self, address: str) -> Tuple[socket.socket, threading.Lock]:
         with self._global_lock:
@@ -445,31 +447,41 @@ class ObjectTransferClient:
             self._plane.release()
             return _NATIVE_MISS
         try:
+            transferred = False
             if not staging.contains(sid):
-                try:
-                    n = native.pull_into(host, native_port, sid, staging)
-                except PullRejected:
-                    # Either the blob truly exceeds the arena, or a
-                    # CONCURRENT pull of the same object holds the id
-                    # unsealed (duplicate create). If it fits, wait
-                    # briefly for the winner to seal instead of paying a
-                    # full chunked re-download of the same bytes.
-                    if total > (STAGING_BYTES * 3) // 4:
-                        return _NATIVE_MISS
-                    deadline = time.monotonic() + 5.0
-                    while (not staging.contains(sid)
-                           and time.monotonic() < deadline):
+                with self._inflight_lock:
+                    winner = sid not in self._inflight
+                    if winner:
+                        self._inflight.add(sid)
+                if not winner:
+                    # another thread of THIS client is pulling the same
+                    # object (clients never share staging arenas, so this
+                    # is the only duplicate source): wait for it to finish
+                    # rather than re-downloading the same bytes
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline:
+                        with self._inflight_lock:
+                            if sid not in self._inflight:
+                                break
                         time.sleep(0.01)
                     if not staging.contains(sid):
-                        return _NATIVE_MISS
-                    n = total
-                if n is None:
-                    # staged blob evicted between stage and pull: restage
-                    # once (the holder re-pins it), then give up to chunks
-                    self._call(address, "stage", oid_hex, raw)
-                    n = native.pull_into(host, native_port, sid, staging)
-                    if n is None:
-                        return _NATIVE_MISS
+                        return _NATIVE_MISS  # winner failed; use chunks
+                else:
+                    try:
+                        n = native.pull_into(host, native_port, sid, staging)
+                        if n is None:
+                            # staged blob evicted between stage and pull:
+                            # restage once (the holder re-pins it), then
+                            # give up to chunks
+                            self._call(address, "stage", oid_hex, raw)
+                            n = native.pull_into(host, native_port, sid,
+                                                 staging)
+                            if n is None:
+                                return _NATIVE_MISS
+                        transferred = True
+                    finally:
+                        with self._inflight_lock:
+                            self._inflight.discard(sid)
             view = staging.get_view(sid)
             if view is None:
                 return _NATIVE_MISS  # evicted locally before the read
@@ -478,10 +490,11 @@ class ObjectTransferClient:
             finally:
                 # release the pin but keep the sealed blob: concurrent and
                 # repeat pulls of the same (immutable) object hit it here,
-                # and the arena's LRU eviction bounds total residency
+                # and the arena's LRU/slot eviction bounds total residency
                 staging.release(sid)
-            _pulled_chunks.inc()
-            _pulled_bytes.inc(total)
+            if transferred:  # count only bytes that crossed the network
+                _pulled_chunks.inc()
+                _pulled_bytes.inc(total)
             return value
         except PullRejected:
             return _NATIVE_MISS  # does not fit the local arena

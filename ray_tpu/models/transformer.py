@@ -421,15 +421,17 @@ def forward_pp(
 
     Reference status per SURVEY §2.4: upstream has no native PP (deferred
     to DeepSpeed); here it is a first-class primitive on the flagship
-    model. MoE aux losses are not threaded through the pipeline yet."""
+    model. MoE composes: each stage runs its layers' experts locally
+    (gather routing — experts replicated per stage rank on dp x pp
+    meshes) and the load-balance aux loss threads through the pipeline
+    (pipeline_apply with_aux), so pp MoE losses match dp MoE losses."""
     from ..parallel.pipeline import pipelined
     from ..parallel.sharding import no_constrain
 
-    assert not cfg.is_moe, "forward_pp does not support MoE yet"
-    for ax in ("fsdp", "sp"):
-        # the shard_map in_specs here are dp/pp only: an fsdp or sp axis
-        # would silently all-gather ZeRO-sharded params into every stage
-        # rank (HBM blowup) and replicate compute — refuse loudly
+    for ax in ("fsdp", "sp", "ep"):
+        # the shard_map in_specs here are dp/pp only: an fsdp/sp/ep axis
+        # would silently all-gather ZeRO- or expert-sharded params into
+        # every stage rank (HBM blowup) and replicate compute — refuse
         assert mesh.shape.get(ax, 1) == 1, (
             f"forward_pp does not compose with the {ax!r} mesh axis yet; "
             "use dp x pp meshes"
@@ -443,12 +445,14 @@ def forward_pp(
         # per-shard body: constrain() must be inert here (manual axes)
         with no_constrain():
             def body(carry, lp):
-                y, _aux = _block(carry, lp, cfg, rope_tables, None)
-                return y, None
+                y, aux = _block(carry, lp, cfg, rope_tables, None)
+                return y, aux
 
             if cfg.remat:
                 body = jax.checkpoint(body)
-            h, _ = jax.lax.scan(body, h, lp_stage)
+            h, aux = jax.lax.scan(body, h, lp_stage)
+            if cfg.is_moe:
+                return h, jnp.sum(aux)  # this stage's layers, this microbatch
             return h
 
     # [L, ...] stacked layers -> [S, L/S, ...]: contiguous blocks per
@@ -460,9 +464,12 @@ def forward_pp(
 
     data_spec = PartitionSpec("dp") if "dp" in mesh.axis_names else PartitionSpec()
     run = pipelined(stage_fn, mesh, num_microbatches, axis_name=axis_name,
-                    data_spec=data_spec)
-    x = run(stage_params, x)
-    return _lm_head(x, params, cfg), jnp.zeros((), jnp.float32)
+                    data_spec=data_spec, with_aux=cfg.is_moe)
+    if cfg.is_moe:
+        x, aux = run(stage_params, x)
+    else:
+        x, aux = run(stage_params, x), jnp.zeros((), jnp.float32)
+    return _lm_head(x, params, cfg), aux
 
 
 def loss_fn(

@@ -25,18 +25,25 @@ from jax.sharding import Mesh, PartitionSpec
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     stage_params: Any,
     x: jax.Array,
     num_microbatches: int,
     axis_name: str = "pp",
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Any:
     """Run x through S pipeline stages (per-rank body — call in shard_map).
 
     stage_fn(stage_params, h [mb, ...]) -> h [mb, ...] applies THIS rank's
     layer block. x [B, ...] (same value on every stage). Output [B, ...]
     replicated across the pp axis.
-    """
+
+    with_aux=True: stage_fn returns (h, aux_scalar) — the per-microbatch
+    auxiliary loss of THIS stage's layers (MoE load-balance). Contributions
+    are masked to the steps where a stage holds a REAL microbatch (during
+    fill/drain it chews zeros), summed over stages via psum, and averaged
+    over microbatches, so the result equals the full-batch aux the unpiped
+    forward computes. Returns (y, aux_total)."""
     S = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B = x.shape[0]
@@ -49,46 +56,72 @@ def pipeline_apply(
     perm_fwd = [(i, i + 1) for i in range(S - 1)]
 
     def step(carry, t):
-        incoming, outputs = carry
+        incoming, outputs, aux_acc = carry
         # stage 0 consumes fresh microbatches while they last
         fresh = xm[jnp.clip(t, 0, M - 1)]
         h = jnp.where(idx == 0, fresh, incoming)
-        out = stage_fn(stage_params, h)
+        if with_aux:
+            out, aux = stage_fn(stage_params, h)
+            # stage `idx` holds microbatch t-idx, real iff 0 <= t-idx < M
+            valid = jnp.logical_and(t >= idx, t < idx + M)
+            aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
+        else:
+            out = stage_fn(stage_params, h)
         nxt = jax.lax.ppermute(out, axis_name, perm_fwd) if S > 1 else out
         # last stage collects finished microbatch t-(S-1)
         out_idx = jnp.clip(t - (S - 1), 0, M - 1)
         collect = jnp.logical_and(idx == S - 1, t >= S - 1)
         updated = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
         outputs = jnp.where(collect, updated, outputs)
-        return (nxt, outputs), None
+        return (nxt, outputs, aux_acc), None
 
-    init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm))
-    (_, outputs), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
+    init = (jnp.zeros_like(xm[0]), jnp.zeros_like(xm),
+            jnp.zeros((), jnp.float32))
+    (_, outputs, aux_acc), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
     # only the last stage holds real outputs; broadcast over the ring
     y = jax.lax.psum(jnp.where(idx == S - 1, outputs, 0.0), axis_name)
-    return y.reshape(B, *x.shape[1:])
+    y = y.reshape(B, *x.shape[1:])
+    if not with_aux:
+        return y
+    # sum stage contributions; mean over microbatches matches the
+    # full-batch mean the unpiped layers compute (equal microbatch sizes)
+    aux_total = jax.lax.psum(aux_acc, axis_name) / M
+    return y, aux_total
 
 
 def pipelined(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "pp",
     data_spec: PartitionSpec = PartitionSpec(),
+    with_aux: bool = False,
 ):
-    """Global-view wrapper: returns fn(stacked_stage_params, x) -> y.
+    """Global-view wrapper: returns fn(stacked_stage_params, x) -> y
+    (or (y, aux) when with_aux — see pipeline_apply).
 
     stacked_stage_params: pytree with a leading STAGE axis of size
     mesh.shape[axis_name] (each leaf [S, ...]); x per data_spec (must not
     shard over axis_name). The stage axis is sharded over "pp"; each rank
     sees its own [1, ...] slice, squeezed before stage_fn.
     """
+    data_axes = [a for axes in data_spec if axes is not None
+                 for a in (axes if isinstance(axes, tuple) else (axes,))]
 
     def body(params_local, x):
         params_one = jax.tree.map(lambda p: p[0], params_local)
-        return pipeline_apply(
-            stage_fn, params_one, x, num_microbatches, axis_name
+        out = pipeline_apply(
+            stage_fn, params_one, x, num_microbatches, axis_name,
+            with_aux=with_aux,
         )
+        if not with_aux:
+            return out
+        y, aux = out
+        # per-data-shard aux means -> global mean (the unpiped forward
+        # computes aux over the FULL batch); replicated for out_specs=()
+        for ax in data_axes:
+            aux = jax.lax.pmean(aux, ax)
+        return y, aux
 
     param_spec = PartitionSpec(axis_name)
 
@@ -97,8 +130,9 @@ def pipelined(
             jax.tree.map(lambda _: param_spec, stacked_params),
             data_spec,
         )
+        out_specs = (data_spec, PartitionSpec()) if with_aux else data_spec
         return jax.shard_map(
-            body, mesh=mesh, in_specs=specs_in, out_specs=data_spec,
+            body, mesh=mesh, in_specs=specs_in, out_specs=out_specs,
             check_vma=False,
         )(stacked_params, x)
 

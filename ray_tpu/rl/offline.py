@@ -54,6 +54,13 @@ def rollouts_to_dataset(rollouts: Iterable[Dict[str, np.ndarray]],
             if gamma is not None:
                 row["return"] = float(returns[t])
             rows.append(row)
+    if gamma is not None and not rows:
+        raise ValueError(
+            "no completed episodes in the rollouts: every transition was "
+            "truncated (no done=True anywhere), so no Monte-Carlo return "
+            "can be computed — collect longer rollouts or episode-aligned "
+            "ones before MARWIL training"
+        )
     return rt_data.from_items(rows)
 
 

@@ -117,6 +117,49 @@ class TestPipelineRealModel:
             losses[name] = float(metrics["loss"])
         assert losses["pp"] == pytest.approx(losses["dp"], abs=2e-3), losses
 
+    def test_pp_moe_loss_matches_dp_only(self, cpu_mesh_devices):
+        """MoE through the pipeline: per-stage experts run locally (gather
+        routing) and the load-balance aux threads through the schedule —
+        pp and dp-only must produce the SAME loss (incl. the aux term;
+        cfg.router_aux_coef couples it into the total)."""
+        import dataclasses
+
+        from ray_tpu.comm.mesh import set_mesh
+        from ray_tpu.models import get_config
+        from ray_tpu.train.lm import (
+            batch_shardings,
+            init_train_state,
+            make_optimizer,
+            make_pp_train_step,
+            make_train_step,
+            synthetic_batch,
+        )
+
+        cfg = dataclasses.replace(get_config("tiny-moe"), n_layers=4)
+        assert cfg.is_moe and cfg.router_aux_coef > 0
+        batch = synthetic_batch(cfg, 8, 32)
+        losses, auxes = {}, {}
+        for name, sizes, maker in (
+            ("dp", {"dp": 8}, lambda m: make_train_step(cfg, opt)),
+            ("pp", {"dp": 2, "pp": 4},
+             lambda m: make_pp_train_step(cfg, opt, m, num_microbatches=2)),
+        ):
+            mesh = build_mesh(MeshSpec.create(**sizes), devices=cpu_mesh_devices)
+            set_mesh(mesh)
+            opt = make_optimizer(total_steps=10)
+            state, shardings = init_train_state(
+                cfg, mesh, jax.random.PRNGKey(0), opt)
+            step = jax.jit(maker(mesh), donate_argnums=0,
+                           in_shardings=(shardings, batch_shardings(mesh)))
+            with mesh:
+                state, metrics = step(state, batch)
+                state, metrics = step(state, batch)  # second step: grads applied
+            losses[name] = float(metrics["loss"])
+            auxes[name] = float(metrics["aux_loss"])
+        assert auxes["pp"] > 0  # the aux actually threads through
+        assert auxes["pp"] == pytest.approx(auxes["dp"], rel=2e-2), auxes
+        assert losses["pp"] == pytest.approx(losses["dp"], abs=2e-3), losses
+
     def test_pp_microbatch_count_is_schedule_only(self, cpu_mesh_devices):
         import dataclasses
 

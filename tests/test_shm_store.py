@@ -259,3 +259,30 @@ class TestNativeTransfer:
         for t in threads:
             t.join(timeout=60)
         assert not errors, errors
+
+
+class TestSlotEviction:
+    """Table-full pressure: shm_obj_create must evict the LRU sealed
+    object when SLOTS run out, not just when bytes do — many small sealed
+    objects exhaust the table long before the arena fills."""
+
+    def test_create_evicts_lru_when_table_full(self):
+        name = f"/rtpu_slots_{uuid.uuid4().hex[:8]}"
+        s = ShmObjectStore(name, capacity=1 << 20, max_objects=8)
+        try:
+            for i in range(8):
+                s.put(_oid(i), bytes([i]) * 64)
+            # table is full; next put evicts the LRU (oid 0)
+            s.put(_oid(100), b"fresh" * 16)
+            assert s.contains(_oid(100))
+            assert not s.contains(_oid(0))
+            assert s.contains(_oid(7))
+            # pinned objects survive slot pressure
+            view = s.get_view(_oid(7))
+            assert view is not None
+            for i in range(200, 206):
+                s.put(_oid(i), b"x" * 32)
+            assert s.contains(_oid(7))
+            s.release(_oid(7))
+        finally:
+            s.close()
