@@ -30,10 +30,12 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -66,6 +68,9 @@ _m_requests = Counter("serve_requests_finished",
 _m_running = Gauge("serve_requests_running",
                    "Requests currently admitted to decode slots.")
 _m_tokens = Counter("serve_tokens_generated", "Tokens emitted by the engine.")
+_m_prefix_hit_tokens = Counter(
+    "serve_prefix_cache_hit_tokens",
+    "Prompt tokens served from the prefix cache instead of prefilled.")
 _m_ttft = Histogram(
     "serve_ttft_seconds", "Time to first token.",
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
@@ -135,6 +140,14 @@ class EngineConfig:
     # dispatch latency when the backlog is long.
     busy_span: int = 4
     adaptive_span: bool = True
+    # Automatic prefix caching (vLLM APC analogue): full prompt pages are
+    # content-addressed by a chained hash of their token prefix and kept
+    # (refcounted) after their request finishes; a new prompt sharing the
+    # prefix reuses those pages and prefills only the tail through the
+    # chunked path. Cached zero-ref pages are reclaimed LRU-first under
+    # allocator pressure, so caching never reduces serveable capacity.
+    # Requires chunked_prefill (hits enter through the chunk scheduler).
+    prefix_caching: bool = True
 
     @property
     def pages_per_seq(self) -> int:
@@ -204,6 +217,103 @@ class _Slot:
         self.generated = 0
 
 
+class PrefixCache:
+    """Content-addressed prompt pages (vLLM automatic-prefix-caching
+    analogue). A full page's KV is a pure function of the token prefix
+    through its last token (causal attention + absolute positions), so
+    page i of a prompt is keyed by the CHAIN hash of pages 0..i. Shared
+    pages are refcounted; zero-ref pages sit in an LRU the allocator can
+    reclaim under pressure. All calls run under the engine's _alloc_lock.
+
+    Safety: only FULL prompt pages are ever registered, and lookups are
+    capped below the last prompt token, so every sequence prefills >= 1
+    token (producing its first-token logits) and decode never writes into
+    a shared page (first write position >= cached_len + 1)."""
+
+    def __init__(self, page_size: int):
+        self.ps = page_size
+        self.by_hash: Dict[bytes, int] = {}
+        self.by_page: Dict[int, bytes] = {}
+        self.refs: Dict[int, int] = {}
+        self.lru: "OrderedDict[int, None]" = OrderedDict()  # zero-ref pages
+
+    def page_hashes(self, prompt, n_pages: int) -> List[bytes]:
+        """Chain hashes for the first n_pages full pages of `prompt`."""
+        out, h = [], b""
+        for i in range(n_pages):
+            chunk = np.asarray(
+                prompt[i * self.ps:(i + 1) * self.ps], np.int32).tobytes()
+            h = hashlib.sha1(h + chunk).digest()
+            out.append(h)
+        return out
+
+    def lookup_acquire(self, prompt, align_tokens: int) -> List[int]:
+        """Longest cached page run for `prompt`, refs bumped. Capped below
+        the last token (>= 1 token must prefill) and aligned down to
+        `align_tokens` (the chunk size the tail prefill resumes at)."""
+        T = len(prompt)
+        max_pages = (T - 1) // self.ps  # never the page holding token T-1
+        align_pages = max(1, align_tokens // self.ps)
+        hashes = self.page_hashes(prompt, max_pages)  # one chain, reused
+        n = 0
+        for h in hashes:
+            if self.by_hash.get(h) is None:
+                break
+            n += 1
+        n = (n // align_pages) * align_pages
+        pages = []
+        for h in hashes[:n]:
+            pid = self.by_hash[h]
+            self.refs[pid] = self.refs.get(pid, 0) + 1
+            self.lru.pop(pid, None)
+            pages.append(pid)
+        return pages
+
+    def register(self, prompt, pages: List[int]) -> None:
+        """Offer a prefilled request's full prompt pages to the cache.
+        First writer wins per hash; pages already cached (the request's
+        own shared prefix) are skipped. Registered pages get one ref on
+        behalf of this request (dropped via release_and_filter)."""
+        n_pages = min(len(prompt) // self.ps, len(pages))
+        for h, pid in zip(self.page_hashes(prompt, n_pages),
+                          pages[:n_pages]):
+            if pid in self.by_page:
+                continue  # already cached (this request's shared prefix)
+            if h in self.by_hash:
+                continue  # another page already serves this prefix
+            self.by_hash[h] = pid
+            self.by_page[pid] = h
+            self.refs[pid] = self.refs.get(pid, 0) + 1
+
+    def release_and_filter(self, pages: List[int]) -> List[int]:
+        """Drop one ref per cached page in `pages`; -> the pages the
+        caller still owns (uncached ones) to return to the allocator."""
+        mine = []
+        for pid in pages:
+            if pid in self.by_page:
+                self.refs[pid] -= 1
+                if self.refs[pid] <= 0:
+                    del self.refs[pid]
+                    self.lru[pid] = None
+                    self.lru.move_to_end(pid)
+            else:
+                mine.append(pid)
+        return mine
+
+    def evict(self, n: int) -> List[int]:
+        """Reclaim up to n zero-ref cached pages, LRU first."""
+        out = []
+        while self.lru and len(out) < n:
+            pid, _ = self.lru.popitem(last=False)
+            del self.by_hash[self.by_page.pop(pid)]
+            out.append(pid)
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        return {"cached_pages": len(self.by_page),
+                "reusable_pages": len(self.lru)}
+
+
 class PageAllocator:
     """Free-list over page ids; page 0 is the reserved trash page that
     inactive decode slots write into."""
@@ -267,6 +377,9 @@ class InferenceEngine:
             self.k_pages = jnp.zeros((L, KVH, P, ps, hd), dtype)
             self.v_pages = jnp.zeros((L, KVH, P, ps, hd), dtype)
         self.allocator = PageAllocator(P)
+        self.prefix = (PrefixCache(ps)
+                       if engine_cfg.prefix_caching
+                       and engine_cfg.chunked_prefill else None)
         self.slots = [_Slot() for _ in range(B)]
         self.pending: "queue.Queue[Request]" = queue.Queue()
         self._step_count = 0
@@ -693,29 +806,57 @@ class InferenceEngine:
     def _free_pages_and_revive(self, pages: List[int]) -> None:
         """Free pages AND re-queue page-starved parked requests: every
         free site must revive _waiting, or a parked request can only be
-        rescued by some unrelated request finishing later."""
+        rescued by some unrelated request finishing later. Cached pages
+        in `pages` only drop a ref (the prefix cache owns them)."""
         with self._alloc_lock:
+            if self.prefix is not None:
+                pages = self.prefix.release_and_filter(pages)
             self.allocator.free(pages)
             waiting, self._waiting = self._waiting, []
         for w in waiting:
             self.pending.put(w)
 
+    def _alloc_with_reclaim(self, n: int) -> Optional[List[int]]:
+        """allocator.alloc, reclaiming zero-ref cached pages on miss —
+        caching must never reduce serveable capacity. Caller holds
+        _alloc_lock."""
+        pages = self.allocator.alloc(n)
+        if pages is None and self.prefix is not None:
+            short = n - self.allocator.num_free
+            reclaimed = self.prefix.evict(short)
+            if reclaimed:
+                self.allocator.free(reclaimed)
+                pages = self.allocator.alloc(n)
+        return pages
+
     def _admit_for_prefill(self, req: Request):
-        """-> (pages, T, bucket) or (pages, T, None) for the chunked path,
-        or None (deferred to _waiting / errored)."""
+        """-> (pages, T, bucket, cached_len); bucket None = chunked path,
+        cached_len = tokens served by the prefix cache (chunk-aligned).
+        Or None (deferred to _waiting / errored)."""
         T = len(req.prompt)
         total = T + req.max_tokens
         n_pages = -(-total // self.ecfg.page_size)
+        C = self.ecfg.prefill_chunk
         with self._alloc_lock:
-            pages = self.allocator.alloc(n_pages)
+            shared: List[int] = []
+            if self.prefix is not None:
+                shared = self.prefix.lookup_acquire(req.prompt, C)
+            pages = self._alloc_with_reclaim(n_pages - len(shared))
             if pages is None:
+                if shared:  # drop the refs we just took
+                    self.prefix.release_and_filter(shared)
                 # no capacity now; revived by _maybe_finish when pages free
                 self._waiting.append(req)
                 return None
-        if self.ecfg.chunked_prefill and T > self.ecfg.prefill_chunk:
-            # long prompt: chunk on the decode thread (KV lands straight
-            # in pages); also serves prompts past the largest bucket
-            return pages, T, None
+            pages = shared + pages
+        cached_len = len(shared) * self.ecfg.page_size
+        if cached_len:
+            _m_prefix_hit_tokens.inc(cached_len)
+        if shared or (self.ecfg.chunked_prefill and T > C):
+            # long prompt (or cached prefix): chunk on the decode thread —
+            # KV lands straight in pages and the chunk scheduler resumes
+            # at the first uncached token
+            return pages, T, None, cached_len
         bucket = next(
             (b for b in self.ecfg.prefill_buckets if b >= T),
             self.ecfg.prefill_buckets[-1],
@@ -727,7 +868,7 @@ class InferenceEngine:
                 "(enable chunked_prefill to serve longer prompts)"
             )
             return None
-        return pages, T, bucket
+        return pages, T, bucket, 0
 
     def _prefill_batch(self, reqs: List[Request]) -> None:
         """Admit + prefill a drained batch. Never raises: each request
@@ -748,11 +889,14 @@ class InferenceEngine:
         admitted = [it for it in admitted if it[3] is not None]
         if chunked:
             pps = self.ecfg.pages_per_seq
+            C = self.ecfg.prefill_chunk
             with self._chunk_lock:
-                for req, pages, T, _b in chunked:
+                for req, pages, T, _b, cached_len in chunked:
                     table = np.zeros((pps,), np.int32)
                     table[: len(pages)] = pages
-                    self._chunk_queue.append(_ChunkState(req, pages, table, T))
+                    st = _ChunkState(req, pages, table, T)
+                    st.next_chunk = cached_len // C  # resume past the hits
+                    self._chunk_queue.append(st)
             self._work.set()  # the decode thread runs the chunks
         by_bucket: Dict[int, List[tuple]] = {}
         for item in admitted:
@@ -764,7 +908,7 @@ class InferenceEngine:
             except Exception as e:  # noqa: BLE001 — fail this group only
                 logger.warning("prefill failed for bucket %d", bucket,
                                exc_info=True)
-                for req, pages, _T, _b in group:
+                for req, pages, _T, _b, _cl in group:
                     self._free_pages_and_revive(pages)
                     if not req.done.is_set():
                         self._fail_request(req, f"prefill failed: {e!r}")
@@ -781,7 +925,7 @@ class InferenceEngine:
             return
         padded = np.zeros((Bpad, bucket), np.int32)
         lens = np.ones((Bpad,), np.int32)  # dummy rows: true_len 1
-        for i, (req, _pages, T, _b) in enumerate(group):
+        for i, (req, _pages, T, _b, _cl) in enumerate(group):
             padded[i, :T] = req.prompt
             lens[i] = T
         logits, cache = self._prefill_fn(bucket, Bpad)(
@@ -794,12 +938,12 @@ class InferenceEngine:
         logits_host = np.asarray(logits)
         firsts = [
             _sample_host(logits_host[i], req.temperature)
-            for i, (req, _p, _T, _b) in enumerate(group)
+            for i, (req, _p, _T, _b, _cl) in enumerate(group)
         ]
         now = time.monotonic()
         eos = self.ecfg.eos_token_id
         with self._ready_lock:
-            for i, (req, pages, T, _b) in enumerate(group):
+            for i, (req, pages, T, _b, _cl) in enumerate(group):
                 first = firsts[i]
                 req.first_token_at = now
                 _m_ttft.observe(now - req.submitted_at)
@@ -826,6 +970,11 @@ class InferenceEngine:
                 req, pages, cache, T = self._ready.pop(0)
             if cache is not None:  # chunked prefills wrote pages directly
                 self._scatter_prefill(cache, pages, T)
+            if self.prefix is not None:
+                # the prompt's full pages are now valid: offer them to the
+                # cache so later prompts sharing the prefix skip prefill
+                with self._alloc_lock:
+                    self.prefix.register(req.prompt, pages)
             slot = free_slots[0]
             slot.request = req
             slot.pages = pages
@@ -954,21 +1103,18 @@ class InferenceEngine:
             if eos is not None and req.output and req.output[-1] == eos:
                 req.output.pop()
             req.finished_at = time.monotonic()
-            req.done.set()
-            req._emit(None)
-            with self._alloc_lock:
-                self.allocator.free(slot.pages)
-                waiting, self._waiting = self._waiting, []
+            # free BEFORE signalling completion: a caller that returns from
+            # generate() and reads stats() must see this request's pages
+            # already released (and _free_pages_and_revive is the one
+            # place that knows the release/free/revive choreography)
+            self._free_pages_and_revive(slot.pages)
             slot.request = None
             slot.pages = []
             slot.position = 0
             slot.generated = 0
             _m_running.set(sum(1 for s in self.slots if s.request is not None))
-            if waiting:
-                # capacity freed: give page-starved requests another pass
-                # (the prefill thread blocks on pending, so the put wakes it)
-                for w in waiting:
-                    self.pending.put(w)
+            req.done.set()
+            req._emit(None)
 
     # ------------------------------------------------------------- blocking
 
@@ -1055,12 +1201,17 @@ class InferenceEngine:
         with self._alloc_lock:
             waiting = len(self._waiting)
             free_pages = self.allocator.num_free
+            prefix = self.prefix.stats() if self.prefix is not None else {}
+        # free_pages counts SERVEABLE capacity: zero-ref cached pages are
+        # reclaimed on demand (_alloc_with_reclaim), so they are free in
+        # every sense that matters to admission
         return {
             "active": len(self._active()),
             "pending": self.pending.qsize(),
             "ready": ready,
             "waiting_for_pages": waiting,
-            "free_pages": free_pages,
+            "free_pages": free_pages + prefix.get("reusable_pages", 0),
+            **prefix,
             "steps": self._step_count,
         }
 

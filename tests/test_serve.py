@@ -701,3 +701,83 @@ class TestMultiplex:
         gate.set()
         assert [r.result(timeout=30) for r in responses] == ["m1"] * 3
         assert loads == ["m1"], loads  # one in-flight load, two waiters
+
+
+class TestPrefixCache:
+    """Automatic prefix caching (vLLM APC analogue): content-addressed
+    full prompt pages reused across requests; zero-ref cached pages are
+    reclaimable capacity, never a leak."""
+
+    def _engine(self, **kw):
+        from ray_tpu.serve import EngineConfig, InferenceEngine
+
+        cfg = get_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(
+            max_batch_size=4, page_size=8, max_pages=64, max_seq_len=64,
+            prefill_buckets=(16, 32), prefill_chunk=16, **kw,
+        )
+        return InferenceEngine(params, cfg, ecfg), params, cfg
+
+    def test_unit_lookup_align_refs_evict(self):
+        from ray_tpu.serve.engine import PrefixCache
+
+        pc = PrefixCache(page_size=4)
+        prompt = list(range(1, 17))  # 16 tokens = 4 full pages
+        pc.register(prompt, [10, 11, 12, 13])
+        # same prefix, longer prompt: full-run hit capped + aligned to 8
+        # tokens (2 pages)
+        got = pc.lookup_acquire(prompt + [99, 98], align_tokens=8)
+        assert got == [10, 11, 12, 13]
+        # diverging second page: only page 0 matches -> aligned DOWN to 0
+        div = prompt[:4] + [77] * 12
+        assert pc.lookup_acquire(div, align_tokens=8) == []
+        # refs pin pages against eviction; release moves them to LRU
+        assert pc.evict(4) == []  # all referenced (register ref + acquire)
+        rest = pc.release_and_filter([10, 11, 12, 13])  # acquire refs
+        assert rest == []
+        rest = pc.release_and_filter([10, 11, 12, 13, 50])  # register refs
+        assert rest == [50]  # 50 was never cached: caller still owns it
+        assert pc.evict(2) == [10, 11]  # LRU order
+        assert pc.lookup_acquire(prompt, align_tokens=4) == []  # chain broken
+
+    def test_repeat_prompt_hits_cache_and_output_identical(self):
+        from ray_tpu.serve.engine import _m_prefix_hit_tokens
+
+        engine, _, _ = self._engine()
+        prompt = [(i * 7) % 60 + 1 for i in range(40)]  # > chunk, 5 pages
+        first = engine.generate(prompt, max_tokens=8, temperature=0.0)
+        before = _m_prefix_hit_tokens.get()
+        second = engine.generate(prompt, max_tokens=8, temperature=0.0)
+        hits = _m_prefix_hit_tokens.get() - before
+        engine.stop()
+        assert second["token_ids"] == first["token_ids"]
+        # 40 tokens: 4 full pages = 32 tokens, chunk-aligned (16) -> 32
+        assert hits == 32, hits
+
+    def test_shared_prefix_outputs_match_uncached_engine(self):
+        sys_prefix = [(i * 3) % 50 + 1 for i in range(24)]
+        tails = [[7, 8, 9, 10], [11, 12], [13] * 9]
+        cached, _, _ = self._engine(prefix_caching=True)
+        plain, _, _ = self._engine(prefix_caching=False)
+        for tail in tails:
+            prompt = sys_prefix + tail
+            a = cached.generate(prompt, max_tokens=6, temperature=0.0)
+            b = plain.generate(prompt, max_tokens=6, temperature=0.0)
+            assert a["token_ids"] == b["token_ids"], tail
+        cached.stop()
+        plain.stop()
+
+    def test_pool_pressure_reclaims_cached_pages(self):
+        # 64-page pool, each request needs ~6 pages; 20 distinct prompts
+        # would strand 20*4 cached pages without reclaim
+        engine, _, _ = self._engine()
+        for i in range(20):
+            prompt = [(i * 13 + j) % 60 + 1 for j in range(40)]
+            out = engine.generate(prompt, max_tokens=4, temperature=0.0)
+            assert len(out["token_ids"]) == 4
+        stats = engine.stats()
+        engine.stop()
+        # every page is either allocator-free or reclaimable cache
+        assert stats["free_pages"] == 64 - 1, stats
+        assert stats["cached_pages"] > 0
