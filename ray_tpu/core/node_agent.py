@@ -563,6 +563,17 @@ class NodeAgent:
                 except Exception as e:  # shm unavailable: stay on threads
                     logger.warning("process pool unavailable (%s); using threads", e)
                     self._pool = False
+                if self._pool:
+                    try:
+                        # host-OOM guard (reference memory_monitor.cc):
+                        # kills the newest pool task under memory pressure;
+                        # it retries via the worker-crash path. The monitor
+                        # is OPTIONAL — its failure must not disable the
+                        # pool (or leak the acquire ref above).
+                        self._pool.ensure_memory_monitor()
+                    except Exception:  # noqa: BLE001
+                        logger.warning("memory monitor unavailable",
+                                       exc_info=True)
             return self._pool or None
 
     def _materialize_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:

@@ -24,8 +24,10 @@ import contextlib
 import os
 import pickle
 import queue
+import signal
 import sys
 import threading
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Tuple
@@ -329,6 +331,9 @@ class ProcessPool:
         self._tasks: "queue.Queue[Optional[Tuple]]" = queue.Queue()
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()
+        self._inflight: dict = {}  # lane index -> (worker pid, start time)
+        self._inflight_lock = threading.Lock()
+        self._mem_monitor = None
         self._threads: List[threading.Thread] = []
         for i in range(self.num_workers):
             t = threading.Thread(
@@ -368,7 +373,40 @@ class ProcessPool:
             return box[1]
         raise box[1]
 
+    def kill_newest_worker(self) -> Optional[int]:
+        """Kill the worker process running the NEWEST in-flight task (the
+        memory monitor's victim policy, matching the reference: newest =
+        least progress lost, and its task retries via the normal
+        worker-crash path). Returns the killed pid, or None when no task
+        is in flight."""
+        with self._inflight_lock:
+            if not self._inflight:
+                return None
+            _lane, (pid, _t0) = max(self._inflight.items(),
+                                    key=lambda kv: kv[1][1])
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            return None
+        return pid
+
+    def ensure_memory_monitor(self) -> None:
+        """Start the node memory monitor once per pool (idempotent); it
+        kills the newest pool task under host memory pressure. Stopped by
+        close()."""
+        with self._submit_lock:
+            if self._mem_monitor is None and not self._closed.is_set():
+                from .memory_monitor import MemoryMonitor
+
+                monitor = MemoryMonitor(self.kill_newest_worker)
+                if monitor.enabled:
+                    monitor.start()
+                    self._mem_monitor = monitor
+
     def close(self) -> None:
+        if self._mem_monitor is not None:
+            self._mem_monitor.stop()
+            self._mem_monitor = None
         with self._submit_lock:
             if self._closed.is_set():
                 return
@@ -449,6 +487,8 @@ class ProcessPool:
                 logger.warning("pool transport failure: %r", e)
                 complete(False, WorkerProcessCrash(f"pool transport failure: {e!r}"))
                 continue
+            with self._inflight_lock:
+                self._inflight[index] = (worker.proc.pid, time.monotonic())
             worker.req_q.put((tag, payload, buffer_ids, inline))
             resp = None
             while resp is None:
@@ -459,6 +499,8 @@ class ProcessPool:
                         break
                     if self._closed.is_set():
                         break
+            with self._inflight_lock:
+                self._inflight.pop(index, None)
             _cleanup_buffers(self.store, buffer_ids)
             if resp is None:
                 code = worker.proc.exitcode

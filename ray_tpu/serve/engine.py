@@ -247,14 +247,19 @@ class PrefixCache:
             out.append(h)
         return out
 
-    def lookup_acquire(self, prompt, align_tokens: int) -> List[int]:
+    def lookup_acquire(self, prompt, align_tokens: int,
+                       hashes: Optional[List[bytes]] = None) -> List[int]:
         """Longest cached page run for `prompt`, refs bumped. Capped below
         the last token (>= 1 token must prefill) and aligned down to
-        `align_tokens` (the chunk size the tail prefill resumes at)."""
+        `align_tokens` (the chunk size the tail prefill resumes at).
+        `hashes`: precomputed page_hashes (callers hash OUTSIDE the
+        engine's _alloc_lock; dict lookups are all that runs inside)."""
         T = len(prompt)
         max_pages = (T - 1) // self.ps  # never the page holding token T-1
         align_pages = max(1, align_tokens // self.ps)
-        hashes = self.page_hashes(prompt, max_pages)  # one chain, reused
+        if hashes is None:
+            hashes = self.page_hashes(prompt, max_pages)
+        hashes = hashes[:max_pages]
         n = 0
         for h in hashes:
             if self.by_hash.get(h) is None:
@@ -269,14 +274,17 @@ class PrefixCache:
             pages.append(pid)
         return pages
 
-    def register(self, prompt, pages: List[int]) -> None:
+    def register(self, prompt, pages: List[int],
+                 hashes: Optional[List[bytes]] = None) -> None:
         """Offer a prefilled request's full prompt pages to the cache.
         First writer wins per hash; pages already cached (the request's
         own shared prefix) are skipped. Registered pages get one ref on
-        behalf of this request (dropped via release_and_filter)."""
+        behalf of this request (dropped via release_and_filter).
+        `hashes`: precomputed page_hashes (hash outside the lock)."""
         n_pages = min(len(prompt) // self.ps, len(pages))
-        for h, pid in zip(self.page_hashes(prompt, n_pages),
-                          pages[:n_pages]):
+        if hashes is None:
+            hashes = self.page_hashes(prompt, n_pages)
+        for h, pid in zip(hashes[:n_pages], pages[:n_pages]):
             if pid in self.by_page:
                 continue  # already cached (this request's shared prefix)
             if h in self.by_hash:
@@ -837,10 +845,18 @@ class InferenceEngine:
         total = T + req.max_tokens
         n_pages = -(-total // self.ecfg.page_size)
         C = self.ecfg.prefill_chunk
+        hashes: List[bytes] = []
+        if self.prefix is not None:
+            # hash OUTSIDE the lock (sha1 over the whole prompt); stashed
+            # on the request so install-time register() reuses the chain
+            hashes = self.prefix.page_hashes(
+                req.prompt, T // self.ecfg.page_size)
+            req._page_hashes = hashes
         with self._alloc_lock:
             shared: List[int] = []
             if self.prefix is not None:
-                shared = self.prefix.lookup_acquire(req.prompt, C)
+                shared = self.prefix.lookup_acquire(req.prompt, C,
+                                                    hashes=hashes)
             pages = self._alloc_with_reclaim(n_pages - len(shared))
             if pages is None:
                 if shared:  # drop the refs we just took
@@ -973,8 +989,10 @@ class InferenceEngine:
             if self.prefix is not None:
                 # the prompt's full pages are now valid: offer them to the
                 # cache so later prompts sharing the prefix skip prefill
+                # (hash chain computed at admission; lock sees dict ops only)
+                hashes = getattr(req, "_page_hashes", None)
                 with self._alloc_lock:
-                    self.prefix.register(req.prompt, pages)
+                    self.prefix.register(req.prompt, pages, hashes=hashes)
             slot = free_slots[0]
             slot.request = req
             slot.pages = pages

@@ -281,3 +281,106 @@ class TestSerializationBoundary:
 
         assert ray_tpu.get(mutate.remote(ref)) == 4
         assert ray_tpu.get(ref) == {"xs": [1, 2, 3]}
+
+
+def _sleep_for(s):
+    time.sleep(s)
+    return "done"
+
+
+class TestMemoryMonitor:
+    """Host-OOM guard (reference memory_monitor.cc / worker_killing_policy):
+    under pressure the NEWEST in-flight pool task's worker is killed and
+    the task fails as a worker crash (the retriable path)."""
+
+    def test_kill_newest_worker_targets_latest_task(self, pool):
+        from ray_tpu.core.process_pool import WorkerProcessCrash
+
+        results = {}
+
+        def run(name, dur):
+            try:
+                results[name] = pool.run(_sleep_for, (dur,), {})
+            except WorkerProcessCrash as e:
+                results[name] = e
+
+        t_old = threading.Thread(target=run, args=("old", 3.0))
+        t_old.start()
+        time.sleep(0.5)  # ensure "old" starts first
+        t_new = threading.Thread(target=run, args=("new", 3.0))
+        t_new.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with pool._inflight_lock:
+                if len(pool._inflight) == 2:
+                    break
+            time.sleep(0.05)
+        pid = pool.kill_newest_worker()
+        assert pid is not None
+        t_old.join(timeout=30)
+        t_new.join(timeout=30)
+        assert results["old"] == "done"  # oldest survives
+        from ray_tpu.core.process_pool import WorkerProcessCrash as WPC
+
+        assert isinstance(results["new"], WPC)
+        # the lane respawns: the pool still serves
+        assert pool.run(_getpid, (), {}) > 0
+
+    def test_monitor_kills_under_pressure_and_stops_when_relieved(self, pool):
+        from ray_tpu.core.memory_monitor import MemoryMonitor, _m_killed
+        from ray_tpu.core.process_pool import WorkerProcessCrash
+
+        pressure = {"on": True}
+
+        def probe():
+            return 0.99 if pressure["on"] else 0.1
+
+        def kill_and_relieve():
+            pid = pool.kill_newest_worker()
+            if pid is not None:
+                pressure["on"] = False  # the kill "reclaimed" memory
+            return pid
+
+        monitor = MemoryMonitor(kill_and_relieve, threshold=0.95,
+                                interval_s=0.05, probe=probe)
+        before = _m_killed.get()
+        monitor.start()
+        try:
+            with pytest.raises(WorkerProcessCrash):
+                pool.run(_sleep_for, (5.0,), {})
+        finally:
+            monitor.stop()
+        assert _m_killed.get() - before == 1
+        assert pool.run(_sleep_for, (0.01,), {}) == "done"  # pressure off
+
+    def test_retriable_task_survives_oom_kill(self):
+        """End to end through the runtime: the killed task resubmits under
+        max_retries and completes once pressure clears."""
+        rt = ray_tpu.init(num_cpus=2, num_tpus=0,
+                          system_config={"worker_processes": 1})
+        try:
+            pool = rt.driver_agent._ensure_pool()
+            assert pool is not None
+
+            @ray_tpu.remote(max_retries=2)
+            def slowish():
+                time.sleep(1.0)
+                return os.getpid()
+
+            ref = slowish.remote()
+            deadline = time.monotonic() + 10
+            killed = None
+            while time.monotonic() < deadline and killed is None:
+                killed = pool.kill_newest_worker()
+                time.sleep(0.05)
+            assert killed is not None
+            out = ray_tpu.get(ref, timeout=60)  # retry ran to completion
+            assert isinstance(out, int) and out != killed
+        finally:
+            ray_tpu.shutdown()
+
+    def test_system_probe_returns_sane_fraction(self):
+        from ray_tpu.core.memory_monitor import system_memory_fraction
+
+        frac = system_memory_fraction()
+        assert 0.0 <= frac <= 1.5  # cgroup current can briefly exceed max
