@@ -27,5 +27,11 @@ from .config import (  # noqa: F401
 )
 from .integrations import MLflowLoggerCallback, WandbLoggerCallback  # noqa: F401
 from .result import Result  # noqa: F401
-from .session import TrainContext, get_checkpoint, get_context, report  # noqa: F401
+from .session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from .trainer import JaxTrainer, TrainingFailedError  # noqa: F401

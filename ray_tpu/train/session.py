@@ -57,9 +57,11 @@ class _TrainSession:
         self,
         context: TrainContext,
         resume_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.context = context
         self.resume_checkpoint = resume_checkpoint
+        self.datasets = datasets or {}
         self._reports: "queue.Queue[_Report]" = queue.Queue()
         self.finished = False
 
@@ -113,3 +115,16 @@ def get_checkpoint() -> Optional[Checkpoint]:
     """The checkpoint to resume from (set after a gang restart)."""
     s = _get_session()
     return s.resume_checkpoint if s is not None else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a Dataset passed to JaxTrainer(datasets=...)
+    (reference: `ray.train.get_dataset_shard` — Train splits each dataset
+    across the gang with streaming_split; each rank iterates its own)."""
+    s = _get_session()
+    if s is None or name not in s.datasets:
+        raise RuntimeError(
+            f"no dataset shard {name!r}: pass datasets={{{name!r}: ds}} to "
+            "JaxTrainer and call get_dataset_shard inside train_func"
+        )
+    return s.datasets[name]

@@ -138,7 +138,10 @@ class JaxTrainer:
         for name, ds in self.datasets.items():
             splitter = getattr(ds, "streaming_split", None)
             if splitter is not None and n > 1:
-                out[name] = splitter(n)
+                # equal=True row-balances the shards: every SPMD rank must
+                # see the SAME batch count, or one rank exits the loop
+                # while the others sit in a collective (gang hang)
+                out[name] = splitter(n, equal=True)
             else:
                 out[name] = [ds] * n
         return out

@@ -45,8 +45,10 @@ class TrainWorker:
         config: Dict[str, Any],
         context: TrainContext,
         resume_checkpoint: Optional[Checkpoint],
+        datasets: Optional[Dict[str, Any]] = None,
     ) -> Any:
-        self.session = _TrainSession(context, resume_checkpoint)
+        self.session = _TrainSession(context, resume_checkpoint,
+                                     datasets=datasets)
         _set_session(self.session)
         try:
             return train_func(config)
@@ -156,10 +158,16 @@ class WorkerGroup:
         refs = []
         for rank, w in enumerate(self.workers):
             cfg = dict(config)
+            rank_datasets = None
             if datasets_per_rank is not None:
-                cfg["datasets"] = {
+                rank_datasets = {
                     name: shards[rank] for name, shards in datasets_per_rank.items()
                 }
+                # legacy surface: loops written against config["datasets"]
+                # keep working; train.get_dataset_shard reads the session
+                # copy (the explicit parameter), so a user-provided
+                # "datasets" CONFIG key is never mistaken for shards
+                cfg["datasets"] = rank_datasets
             ctx = TrainContext(
                 world_rank=rank,
                 world_size=self.scaling.num_workers,
@@ -170,7 +178,8 @@ class WorkerGroup:
                 gang_name=self.gang_name,
                 topology=self._topology_for_rank(rank),
             )
-            refs.append(w.run.remote(train_func, cfg, ctx, resume_checkpoint))
+            refs.append(w.run.remote(train_func, cfg, ctx, resume_checkpoint,
+                                     datasets=rank_datasets))
         return refs
 
     def _topology_for_rank(self, rank: int):
