@@ -188,6 +188,10 @@ class Request:
     finished_at: Optional[float] = None
     # streaming consumers: tokens pushed as generated, None terminates
     stream_q: Optional["queue.Queue"] = None
+    # set by engine.cancel(): the request finishes ("cancelled") at its
+    # next scheduling point and its pages free — wherever it currently is
+    cancelled: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
 
     def _emit(self, tok: Optional[int]) -> None:
         if self.stream_q is not None:
@@ -421,6 +425,8 @@ class InferenceEngine:
         # the TPU-static-shape form of vLLM's mixed prefill/decode sched)
         self._chunk_queue: "list[_ChunkState]" = []
         self._chunk_lock = threading.Lock()
+        self._requests: Dict[str, Request] = {}  # live (uncompleted) ids
+        self._req_lock = threading.Lock()
 
     # ------------------------------------------------------------- compiled
 
@@ -728,8 +734,63 @@ class InferenceEngine:
             req.done.set()
             req._emit(None)
             return
+        with self._req_lock:
+            self._requests[req.request_id] = req
         self.pending.put(req)
         self._ensure_loop()
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a live request (reference: serve's disconnect-driven
+        cancellation). Wherever it currently is — pending, parked for
+        pages, mid-chunked-prefill, awaiting install, or decoding — it
+        finishes with finish_reason="cancelled" at its next scheduling
+        point and its pages free. Returns False for unknown/finished ids.
+        The device is never interrupted mid-program: an in-flight prefill
+        completes and the result is dropped at install."""
+        with self._req_lock:
+            req = self._requests.get(request_id)
+        if req is None or req.done.is_set():
+            return False
+        req.cancelled.set()
+        # Chunked-prefill and active-slot retirement belong to the DECODE
+        # thread alone (it checks the flag at every chunk/step boundary):
+        # removing a _ChunkState here would race the in-flight chunk and
+        # double-free its pages. Only the stations no thread is actively
+        # driving get swept here.
+        with self._ready_lock:
+            for item in list(self._ready):
+                if item[0] is req:
+                    self._ready.remove(item)
+                    self._free_pages_and_revive(item[1])
+                    self._finish_request(req, "cancelled")
+        with self._alloc_lock:
+            parked = req in self._waiting
+            if parked:
+                self._waiting.remove(req)
+        if parked:
+            self._finish_request(req, "cancelled")
+        self._work.set()  # decode thread sweeps chunks/slots promptly
+        return True
+
+    def _finish_request(self, req: Request, reason: Optional[str] = None,
+                        error: Optional[str] = None) -> None:
+        """The one request-completion choreography (finish/fail/cancel all
+        route here): stamp, count, unregister, signal, terminate stream."""
+        if req.done.is_set():
+            return
+        if error is not None:
+            req.error = error
+        else:
+            req.finish_reason = reason
+            _m_requests.inc(tags={"finish_reason": reason})
+        req.finished_at = time.monotonic()
+        self._forget(req)
+        req.done.set()
+        req._emit(None)
+
+    def _forget(self, req: Request) -> None:
+        with self._req_lock:
+            self._requests.pop(req.request_id, None)
 
     def _ensure_loop(self):
         with self._lock:
@@ -807,9 +868,7 @@ class InferenceEngine:
                 self._prefill_inflight -= 1
 
     def _fail_request(self, req: Request, msg: str) -> None:
-        req.error = msg
-        req.done.set()
-        req._emit(None)
+        self._finish_request(req, error=msg)
 
     def _free_pages_and_revive(self, pages: List[int]) -> None:
         """Free pages AND re-queue page-starved parked requests: every
@@ -861,10 +920,22 @@ class InferenceEngine:
             if pages is None:
                 if shared:  # drop the refs we just took
                     self.prefix.release_and_filter(shared)
-                # no capacity now; revived by _maybe_finish when pages free
-                self._waiting.append(req)
-                return None
-            pages = shared + pages
+                # Cancelled while we were admitting? Park nothing: no
+                # station re-checks _waiting, and cancel()'s sweep may
+                # already have run (it takes this same lock, so either
+                # its sweep sees our append or we see its flag here).
+                if req.cancelled.is_set():
+                    cancelled = True
+                else:
+                    # no capacity; revived by _maybe_finish on page frees
+                    self._waiting.append(req)
+                    return None
+            else:
+                cancelled = False
+                pages = shared + pages
+        if cancelled:
+            self._finish_request(req, "cancelled")
+            return None
         cached_len = len(shared) * self.ecfg.page_size
         if cached_len:
             _m_prefix_hit_tokens.inc(cached_len)
@@ -892,6 +963,9 @@ class InferenceEngine:
         (error set, pages freed) — independently of its batch-mates."""
         admitted: List[tuple] = []
         for req in reqs:
+            if req.cancelled.is_set():  # cancelled while queued
+                self._finish_request(req, "cancelled")
+                continue
             try:
                 out = self._admit_for_prefill(req)
             except Exception as e:  # noqa: BLE001 — fail just this request
@@ -984,6 +1058,11 @@ class InferenceEngine:
                 if not self._ready or not free_slots:
                     return installed
                 req, pages, cache, T = self._ready.pop(0)
+            if req.cancelled.is_set():  # cancelled between prefill/install
+                self._free_pages_and_revive(pages)
+                self._finish_request(req, "cancelled")
+                installed = True
+                continue
             if cache is not None:  # chunked prefills wrote pages directly
                 self._scatter_prefill(cache, pages, T)
             if self.prefix is not None:
@@ -1013,6 +1092,11 @@ class InferenceEngine:
             if not self._chunk_queue:
                 return False
             st = self._chunk_queue[0]
+            if st.request.cancelled.is_set():  # cancelled between chunks
+                self._chunk_queue.pop(0)
+                self._free_pages_and_revive(st.pages)
+                self._finish_request(st.request, "cancelled")
+                return True
         C = self.ecfg.prefill_chunk
         start = st.next_chunk * C
         toks = st.request.prompt[start:start + C]
@@ -1115,12 +1199,15 @@ class InferenceEngine:
             return
         eos = self.ecfg.eos_token_id
         stopped = eos is not None and last_tok == eos
-        if slot.generated >= req.max_tokens or stopped:
-            req.finish_reason = "stop" if stopped else "length"
+        cancelled = req.cancelled.is_set()
+        if slot.generated >= req.max_tokens or stopped or cancelled:
+            req.finish_reason = ("cancelled" if cancelled
+                                 else "stop" if stopped else "length")
             _m_requests.inc(tags={"finish_reason": req.finish_reason})
             if eos is not None and req.output and req.output[-1] == eos:
                 req.output.pop()
             req.finished_at = time.monotonic()
+            self._forget(req)
             # free BEFORE signalling completion: a caller that returns from
             # generate() and reads stats() must see this request's pages
             # already released (and _free_pages_and_revive is the one
@@ -1154,6 +1241,9 @@ class InferenceEngine:
         )
         self.add_request(req)
         if not req.done.wait(timeout_s):
+            # the caller is gone: cancel so the slot/pages free instead of
+            # decoding to max_tokens for nobody
+            self.cancel(req.request_id)
             raise TimeoutError(f"request {req.request_id} timed out")
         if req.error:
             raise ValueError(req.error)
