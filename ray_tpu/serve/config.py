@@ -27,3 +27,8 @@ class DeploymentConfig:
     health_check_period_s: float = 10.0
     health_check_timeout_s: float = 30.0
     graceful_shutdown_timeout_s: float = 10.0
+    # STARTING budget: a replica whose __init__ never completes within
+    # this window is replaced. Generous by default — LLM replicas
+    # legitimately spend minutes loading weights and warming compiles
+    # (reference serve's initialization deadline is likewise long).
+    startup_timeout_s: float = 600.0

@@ -44,6 +44,15 @@ class _DeploymentState:
         self.fail_counts: Dict[Any, int] = {}
         # in-flight async health probes: actor id -> (ref, issued_at)
         self.health_pending: Dict[Any, Any] = {}
+        # STARTING -> RUNNING tracking (reference deployment_state
+        # semantics): a replica's __init__ may legitimately block for
+        # minutes (model load, engine warmup compiles), so health-probe
+        # timeouts only count as misses once the replica has STARTED —
+        # marked by the readiness probe issued at spawn completing.
+        # STARTING replicas are replaced only on provable actor death or
+        # after startup_timeout_s with no readiness.
+        self.started: set = set()
+        self.ready_pending: Dict[Any, Any] = {}  # actor id -> (ref, spawned)
         self.last_health_check = 0.0
         self.target = config.num_replicas
         self._last_scale_up = 0.0
@@ -175,6 +184,21 @@ class ServeController:
         now = time.monotonic()
         dead: Dict[Any, Any] = {}  # actor id -> handle (deduped)
         by_id = {r._actor_id: r for r in state.replicas}
+        for rid, (ref, spawned) in list(state.ready_pending.items()):
+            if rid not in by_id:
+                state.ready_pending.pop(rid, None)
+                continue
+            ready, _ = api.wait([ref], timeout=0)
+            if ready:
+                state.ready_pending.pop(rid, None)
+                try:
+                    api.get(ref, timeout=0)
+                except Exception:
+                    pass  # init raised -> actor-table death handles it
+                state.started.add(rid)  # STARTING -> RUNNING
+            elif now - spawned > cfg.startup_timeout_s:
+                state.ready_pending.pop(rid, None)
+                dead[rid] = by_id[rid]  # never became ready: replace
         for r in state.replicas:  # plane 1: actor-table death
             info = rt.control_plane.get_actor(r._actor_id)
             if info is not None and info.state is ActorState.DEAD:
@@ -190,6 +214,7 @@ class ServeController:
                 try:
                     api.get(ref, timeout=0)
                     state.fail_counts.pop(rid, None)
+                    state.started.add(rid)  # STARTING -> RUNNING
                     continue
                 except Exception as e:
                     if isinstance(e, RayActorError):
@@ -199,6 +224,11 @@ class ServeController:
                 continue  # probe still in flight and within budget
             else:
                 state.health_pending.pop(rid, None)
+            if rid not in state.started:
+                # STARTING: __init__ may block for minutes (engine warmup
+                # compiles); misses don't count — actor-table death is the
+                # only thing that replaces a starting replica
+                continue
             fails = state.fail_counts.get(rid, 0) + 1
             state.fail_counts[rid] = fails
             if fails >= _HEALTH_FAIL_THRESHOLD:
@@ -227,6 +257,8 @@ class ServeController:
                 )
                 state.fail_counts.pop(r._actor_id, None)
                 state.health_pending.pop(r._actor_id, None)
+                state.ready_pending.pop(r._actor_id, None)
+                state.started.discard(r._actor_id)
                 try:
                     api.kill(r)
                 except Exception:
@@ -237,6 +269,11 @@ class ServeController:
             live_ids = {r._actor_id for r in live}
             state.fail_counts = {
                 rid: c for rid, c in state.fail_counts.items() if rid in live_ids
+            }
+            state.started &= live_ids
+            state.ready_pending = {
+                rid: v for rid, v in state.ready_pending.items()
+                if rid in live_ids
             }
             with self._lock:
                 if self._deployments.get(state.name) is not state:
@@ -259,6 +296,14 @@ class ServeController:
                     state.config.max_ongoing_requests,
                 )
                 state.replicas.append(replica)
+                # readiness probe: completes when __init__ has finished
+                # (the actor's first task can only run then) — the
+                # STARTING -> RUNNING edge for health accounting
+                try:
+                    state.ready_pending[replica._actor_id] = (
+                        replica.health_check.remote(), time.monotonic())
+                except Exception:
+                    pass
             while len(state.replicas) > state.target:
                 changed = True
                 victim = state.replicas.pop()
@@ -274,9 +319,16 @@ class ServeController:
         cfg: Optional[AutoscalingConfig] = state.config.autoscaling_config
         if cfg is None or not state.replicas:
             return
+        # probe only RUNNING replicas: one replica blocked in __init__
+        # (the long STARTING grace) would time this batched get out and
+        # freeze scaling for the whole deployment exactly when load is
+        # piling onto the live replicas
+        ready = [r for r in state.replicas if r._actor_id in state.started]
+        if not ready:
+            return
         try:
             loads = api.get(
-                [r.queue_len.remote() for r in state.replicas], timeout=5.0
+                [r.queue_len.remote() for r in ready], timeout=5.0
             )
         except Exception:
             return

@@ -178,6 +178,17 @@ class Request:
     prompt: List[int]
     max_tokens: int
     temperature: float = 0.0
+    top_p: float = 1.0   # nucleus sampling mass (1.0 = off)
+    top_k: int = 0       # rank cut (0 = off)
+    # stop sequences as TOKEN-ID lists; a matched suffix finishes the
+    # request ("stop") and is stripped from the final output. A flat
+    # [int, ...] (vLLM's stop_token_ids convention) normalizes to one
+    # single-token stop per id at admission.
+    stop: Optional[List[List[int]]] = None
+    # stream hold-back: with stops configured, the newest max(stop)-1
+    # tokens wait here before emitting so a matched stop sequence never
+    # leaks to streaming consumers (flushed at finish)
+    _held: List[int] = dataclasses.field(default_factory=list)
     # filled by the engine
     output: List[int] = dataclasses.field(default_factory=list)
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
@@ -442,7 +453,8 @@ class InferenceEngine:
         # to the XLA reference path
         tp_mesh = self.mesh if self._tp > 1 else None
 
-        def decode(params, k_pages, v_pages, tokens, positions, page_tables, temps, key):
+        def decode(params, k_pages, v_pages, tokens, positions, page_tables,
+                   temps, key, top_ps=None, top_ks=None, advanced=False):
             """tokens/positions [B]; page_tables [B, pages_per_seq]."""
             dtype = jnp.dtype(cfg.dtype)
             B = tokens.shape[0]
@@ -499,20 +511,26 @@ class InferenceEngine:
             )
             if cfg.logits_softcap:
                 logits = cfg.logits_softcap * jnp.tanh(logits / cfg.logits_softcap)
-            # per-slot sampling: temp<=0 -> greedy
-            greedy = jnp.argmax(logits, axis=-1)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(key, scaled, axis=-1)
-            toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            if advanced:
+                toks = _device_sample_topk_topp(logits, temps, top_ps,
+                                                top_ks, key)
+            else:
+                # per-slot sampling: temp<=0 -> greedy
+                greedy = jnp.argmax(logits, axis=-1)
+                scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+                sampled = jax.random.categorical(key, scaled, axis=-1)
+                toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
             return toks, new_k, new_v
 
         def decode_span(params, k_pages, v_pages, tokens, positions,
-                        page_tables, temps, key, n_steps):
+                        page_tables, temps, top_ps, top_ks, key, n_steps,
+                        advanced):
             def sub(carry, i):
                 toks_in, pos, kp, vp = carry
                 ki = jax.random.fold_in(key, i)
                 toks, kp, vp = decode(
-                    params, kp, vp, toks_in, pos, page_tables, temps, ki
+                    params, kp, vp, toks_in, pos, page_tables, temps, ki,
+                    top_ps, top_ks, advanced,
                 )
                 return (toks, pos + 1, kp, vp), toks
 
@@ -521,15 +539,20 @@ class InferenceEngine:
             )
             return seq, kp, vp  # seq [n_steps, B]
 
-        cache: Dict[int, Any] = {}
+        cache: Dict[Any, Any] = {}
 
-        def for_span(n_steps: int):
-            if n_steps not in cache:
-                cache[n_steps] = self._under_mesh(jax.jit(
-                    functools.partial(decode_span, n_steps=n_steps),
+        def for_span(n_steps: int, advanced: bool = False):
+            # `advanced` compiles the top-k/top-p sampler (one vocab sort
+            # per step) as a SEPARATE program: default-sampling batches
+            # never pay for it
+            key_ = (n_steps, advanced)
+            if key_ not in cache:
+                cache[key_] = self._under_mesh(jax.jit(
+                    functools.partial(decode_span, n_steps=n_steps,
+                                      advanced=advanced),
                     donate_argnums=(1, 2),
                 ))
-            return cache[n_steps]
+            return cache[key_]
 
         return for_span
 
@@ -668,14 +691,20 @@ class InferenceEngine:
             spans.add(max(1, self.ecfg.busy_span))
         for span in sorted(spans):
             # positions 0 + all-zero page tables write only the reserved
-            # trash page, so a warmup span never touches live cache state
-            seq, self.k_pages, self.v_pages = self._decode(span)(
-                self.params, self.k_pages, self.v_pages,
-                jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B, pps), jnp.int32), jnp.zeros((B,), jnp.float32),
-                jax.random.PRNGKey(0),
-            )
-            _np.asarray(seq)  # block until compiled + executed
+            # trash page, so a warmup span never touches live cache state.
+            # Both sampler modes compile: the first top-p/top-k request
+            # must not jit inside the decode loop under live traffic.
+            for advanced in (False, True):
+                seq, self.k_pages, self.v_pages = self._decode(
+                    span, advanced)(
+                    self.params, self.k_pages, self.v_pages,
+                    jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B, pps), jnp.int32),
+                    jnp.zeros((B,), jnp.float32),
+                    jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+                    jax.random.PRNGKey(0),
+                )
+                _np.asarray(seq)  # block until compiled + executed
         if self.ecfg.chunked_prefill:
             C = self.ecfg.prefill_chunk
             logits, self.k_pages, self.v_pages = self._chunk_fn(C)(
@@ -714,6 +743,11 @@ class InferenceEngine:
     # ------------------------------------------------------------- requests
 
     def add_request(self, req: Request) -> None:
+        try:
+            req.stop = _normalize_stops(req.stop)
+        except ValueError as e:
+            self._finish_request(req, error=str(e))
+            return
         total = len(req.prompt) + req.max_tokens
         if total > self.ecfg.max_seq_len:
             req.error = (
@@ -785,6 +819,9 @@ class InferenceEngine:
             _m_requests.inc(tags={"finish_reason": reason})
         req.finished_at = time.monotonic()
         self._forget(req)
+        for tok in req._held:  # flush the stream hold-back (post-strip)
+            req._emit(tok)
+        req._held.clear()
         req.done.set()
         req._emit(None)
 
@@ -1027,7 +1064,8 @@ class InferenceEngine:
         # safely because no request has been published to _ready yet.
         logits_host = np.asarray(logits)
         firsts = [
-            _sample_host(logits_host[i], req.temperature)
+            _sample_host(logits_host[i], req.temperature,
+                         req.top_p, req.top_k)
             for i, (req, _p, _T, _b, _cl) in enumerate(group)
         ]
         now = time.monotonic()
@@ -1039,7 +1077,11 @@ class InferenceEngine:
                 _m_ttft.observe(now - req.submitted_at)
                 _m_tokens.inc()
                 req.output.append(int(first))
-                if eos is None or int(first) != eos:  # eos is control
+                if eos is not None and int(first) == eos:
+                    pass  # eos is control
+                elif req.stop:
+                    req._held.append(int(first))  # hold-back from token 1
+                else:
                     req._emit(int(first))
                 row_cache = {
                     "k": cache["k"][:, i:i + 1],
@@ -1114,14 +1156,19 @@ class InferenceEngine:
         with self._chunk_lock:
             self._chunk_queue.pop(0)
         req = st.request
-        first = _sample_host(np.asarray(logits), req.temperature)
+        first = _sample_host(np.asarray(logits), req.temperature,
+                             req.top_p, req.top_k)
         now = time.monotonic()
         req.first_token_at = now
         _m_ttft.observe(now - req.submitted_at)
         _m_tokens.inc()
         req.output.append(int(first))
         eos = self.ecfg.eos_token_id
-        if eos is None or int(first) != eos:
+        if eos is not None and int(first) == eos:
+            pass  # eos is control
+        elif req.stop:
+            req._held.append(int(first))  # hold-back from token 1
+        else:
             req._emit(int(first))
         with self._ready_lock:
             # cache=None: this prompt's KV is already in its pages
@@ -1150,6 +1197,9 @@ class InferenceEngine:
         positions = np.zeros((B,), np.int32)
         tables = np.zeros((B, pps), np.int32)  # page 0 = trash
         temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        advanced = False
         for i, s in enumerate(self.slots):
             if s.request is None:
                 continue
@@ -1157,6 +1207,11 @@ class InferenceEngine:
             positions[i] = s.position
             tables[i, : len(s.pages)] = s.pages
             temps[i] = s.request.temperature
+            top_ps[i] = s.request.top_p
+            top_ks[i] = s.request.top_k
+            if s.request.temperature > 0 and (
+                    s.request.top_p < 1.0 or s.request.top_k > 0):
+                advanced = True  # the sort-based sampler program runs
         # Adaptive span (VERDICT r3 #2): while prefill work is queued or
         # running, shrink the span so the device yields between decode
         # dispatches and arriving requests get their first token (emitted
@@ -1171,10 +1226,10 @@ class InferenceEngine:
             span = max(1, self.ecfg.decode_span)
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
-        seq, self.k_pages, self.v_pages = self._decode(span)(
+        seq, self.k_pages, self.v_pages = self._decode(span, advanced)(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray(temps), key,
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), key,
         )
         seq = np.asarray(seq)  # [span, B] — one readback per span
         for t in range(span):
@@ -1188,7 +1243,15 @@ class InferenceEngine:
                     s.generated += 1
                     _m_tokens.inc()
                     eos = self.ecfg.eos_token_id
-                    if eos is None or tok != eos:  # eos is control, not content
+                    if eos is not None and tok == eos:
+                        pass  # eos is control, not content
+                    elif s.request.stop:
+                        # hold back: _maybe_finish drains tokens that can
+                        # no longer be part of a stop match, strips matched
+                        # tails, and _finish_request flushes the rest — a
+                        # matched stop never leaks to streaming consumers
+                        s.request._held.append(tok)
+                    else:
                         s.request._emit(tok)
                 self._maybe_finish(s, tok)
         return True
@@ -1199,27 +1262,38 @@ class InferenceEngine:
             return
         eos = self.ecfg.eos_token_id
         stopped = eos is not None and last_tok == eos
+        stop_len = 0 if stopped else _match_stop(req.output, req.stop)
+        stopped = stopped or stop_len > 0
         cancelled = req.cancelled.is_set()
-        if slot.generated >= req.max_tokens or stopped or cancelled:
-            req.finish_reason = ("cancelled" if cancelled
-                                 else "stop" if stopped else "length")
-            _m_requests.inc(tags={"finish_reason": req.finish_reason})
-            if eos is not None and req.output and req.output[-1] == eos:
-                req.output.pop()
-            req.finished_at = time.monotonic()
-            self._forget(req)
-            # free BEFORE signalling completion: a caller that returns from
-            # generate() and reads stats() must see this request's pages
-            # already released (and _free_pages_and_revive is the one
-            # place that knows the release/free/revive choreography)
-            self._free_pages_and_revive(slot.pages)
-            slot.request = None
-            slot.pages = []
-            slot.position = 0
-            slot.generated = 0
-            _m_running.set(sum(1 for s in self.slots if s.request is not None))
-            req.done.set()
-            req._emit(None)
+        if not (slot.generated >= req.max_tokens or stopped or cancelled):
+            if req._held:
+                # no match right now: tokens older than the longest
+                # possible stop suffix can safely reach the stream
+                hold = max(len(x) for x in req.stop) - 1
+                while len(req._held) > hold:
+                    req._emit(req._held.pop(0))
+            return
+        reason = ("cancelled" if cancelled
+                  else "stop" if stopped else "length")
+        if eos is not None and req.output and req.output[-1] == eos:
+            req.output.pop()
+        elif stop_len:
+            # the stop sequence is control: strip it from the result AND
+            # from the stream hold-back so it never reaches consumers
+            del req.output[-stop_len:]
+            if req._held:
+                del req._held[-min(stop_len, len(req._held)):]
+        # free BEFORE signalling completion: a caller that returns from
+        # generate() and reads stats() must see this request's pages
+        # already released (and _free_pages_and_revive is the one place
+        # that knows the release/free/revive choreography)
+        self._free_pages_and_revive(slot.pages)
+        slot.request = None
+        slot.pages = []
+        slot.position = 0
+        slot.generated = 0
+        _m_running.set(sum(1 for s in self.slots if s.request is not None))
+        self._finish_request(req, reason)
 
     # ------------------------------------------------------------- blocking
 
@@ -1230,6 +1304,9 @@ class InferenceEngine:
         temperature: float = 0.0,
         request_id: Optional[str] = None,
         timeout_s: float = 600.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        stop: Optional[List[List[int]]] = None,
     ) -> Dict[str, Any]:
         import uuid
 
@@ -1238,6 +1315,9 @@ class InferenceEngine:
             prompt=list(prompt),
             max_tokens=max_tokens,
             temperature=temperature,
+            top_p=top_p,
+            top_k=top_k,
+            stop=stop,
         )
         self.add_request(req)
         if not req.done.wait(timeout_s):
@@ -1262,6 +1342,9 @@ class InferenceEngine:
         temperature: float = 0.0,
         request_id: Optional[str] = None,
         timeout_s: float = 600.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        stop: Optional[List[List[int]]] = None,
     ):
         """-> (Request, token generator). The request object exposes
         finish_reason/error/timing after the generator is exhausted."""
@@ -1272,6 +1355,9 @@ class InferenceEngine:
             prompt=list(prompt),
             max_tokens=max_tokens,
             temperature=temperature,
+            top_p=top_p,
+            top_k=top_k,
+            stop=stop,
             stream_q=queue.Queue(),
         )
         self.add_request(req)
@@ -1294,12 +1380,16 @@ class InferenceEngine:
         temperature: float = 0.0,
         request_id: Optional[str] = None,
         timeout_s: float = 600.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        stop: Optional[List[List[int]]] = None,
     ):
         """Yield token ids as they are generated (first at TTFT, not at
         completion). Raises the request's error, if any, after the stream."""
         _, gen = self.open_stream(
             prompt, max_tokens=max_tokens, temperature=temperature,
             request_id=request_id, timeout_s=timeout_s,
+            top_p=top_p, top_k=top_k, stop=stop,
         )
         return gen
 
@@ -1339,11 +1429,80 @@ def _scatter_pages_jit(k_pages, v_pages, k, v, page_arr, n_full, ps):
     return k_pages, v_pages
 
 
-def _sample_host(logits: np.ndarray, temperature: float) -> int:
+def _normalize_stops(stop) -> Optional[List[List[int]]]:
+    """Accept [[ids...]...] or the flat [id...] form (vLLM stop_token_ids,
+    each id a stop on its own); reject anything else with a clear error
+    instead of letting a bad shape reach the decode thread."""
+    if stop is None:
+        return None
+    if not isinstance(stop, (list, tuple)):
+        raise ValueError(f"stop must be a list, got {type(stop).__name__}")
+    out: List[List[int]] = []
+    for s in stop:
+        if isinstance(s, (int, np.integer)):
+            out.append([int(s)])
+        elif isinstance(s, (list, tuple)) and s and all(
+                isinstance(t, (int, np.integer)) for t in s):
+            out.append([int(t) for t in s])
+        else:
+            raise ValueError(
+                "stop entries must be token ids or non-empty token-id "
+                f"lists, got {s!r}"
+            )
+    return out or None
+
+
+def _match_stop(output: List[int],
+                stops: Optional[List[List[int]]]) -> int:
+    """Length of the stop sequence `output` currently ends with, or 0."""
+    if not stops:
+        return 0
+    for s in stops:
+        n = len(s)
+        if n and len(output) >= n and output[-n:] == list(s):
+            return n
+    return 0
+
+
+def _device_sample_topk_topp(logits, temps, top_ps, top_ks, key):
+    """Per-row temperature + top-k + nucleus (top-p) sampling on device.
+    top_k<=0 disables the rank cut; top_p>=1 disables the nucleus cut;
+    temp<=0 is greedy. One descending sort serves both filters."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)                      # [B,V] desc
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(logits.shape[-1])[None, :]
+    # nucleus keeps every token whose preceding mass is under top_p (the
+    # first token crossing the boundary stays in, matching vLLM)
+    keep = (cum - probs) < top_ps[:, None]
+    keep &= jnp.where(top_ks[:, None] > 0, ranks < top_ks[:, None], True)
+    keep = keep.at[:, 0].set(True)  # never mask everything
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    choice = jax.random.categorical(key, masked, axis=-1)      # sorted index
+    sampled = jnp.take_along_axis(order, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _sample_host(logits: np.ndarray, temperature: float,
+                 top_p: float = 1.0, top_k: int = 0) -> int:
     if temperature <= 0:
         return int(np.argmax(logits))
     logits = logits / temperature
     logits -= logits.max()
     p = np.exp(logits)
     p /= p.sum()
+    if top_k > 0 or top_p < 1.0:
+        order = np.argsort(-p)
+        sp = p[order]
+        cum = np.cumsum(sp)
+        keep = (cum - sp) < top_p
+        if top_k > 0:
+            keep &= np.arange(len(sp)) < top_k
+        keep[0] = True
+        sp = np.where(keep, sp, 0.0)
+        sp /= sp.sum()
+        return int(order[np.random.choice(len(sp), p=sp)])
     return int(np.random.choice(len(p), p=p))

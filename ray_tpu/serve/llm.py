@@ -20,7 +20,8 @@ from .engine import EngineConfig, InferenceEngine
 class LLMServer:
     """Token-level LLM server.
 
-    Request: {"prompt_ids": [int], "max_tokens": int, "temperature": float}
+    Request: {"prompt_ids": [int], "max_tokens": int, "temperature": float,
+              "top_p": float, "top_k": int, "stop_token_ids": [[int]]}
     Response: {"token_ids": [...], "ttft_s": ..., "latency_s": ...}
 
     params_fn: optional () -> (params, model_cfg) to load real weights;
@@ -68,6 +69,9 @@ class LLMServer:
             prompt=list(request["prompt_ids"]),
             max_tokens=int(request.get("max_tokens", 32)),
             temperature=float(request.get("temperature", 0.0)),
+            top_p=float(request.get("top_p", 1.0)),
+            top_k=int(request.get("top_k", 0)),
+            stop=request.get("stop_token_ids"),
             request_id=request.get("request_id"),
         )
 
@@ -78,6 +82,9 @@ class LLMServer:
             prompt=list(request["prompt_ids"]),
             max_tokens=int(request.get("max_tokens", 32)),
             temperature=float(request.get("temperature", 0.0)),
+            top_p=float(request.get("top_p", 1.0)),
+            top_k=int(request.get("top_k", 0)),
+            stop=request.get("stop_token_ids"),
             request_id=request.get("request_id"),
         )
 

@@ -112,6 +112,28 @@ class OpenAIServer:
 
     # ------------------------------------------------------------- routes
 
+    def _stop_ids(self, body) -> "Optional[list]":
+        """OpenAI `stop`: string or list of strings -> token-id sequences
+        via this app's tokenizer (plus stop_token_ids passthrough).
+
+        Contract: matching is TOKEN-level on the encoded stop string —
+        exact for the byte tokenizer (1 byte = 1 token always), while a
+        merging tokenizer (HF) only fires when the model emits the stop
+        text on the same token boundaries. Full detokenized string
+        matching (vLLM's behavior) would need decode-per-token in the
+        engine loop; use stop_token_ids for exact token-level control."""
+        stops = []
+        raw = body.get("stop")
+        if isinstance(raw, str):
+            raw = [raw]
+        for s in raw or []:
+            ids = self.tokenizer.encode(str(s))
+            if ids:
+                stops.append(ids)
+        for tid in body.get("stop_token_ids") or []:
+            stops.append([int(tid)])
+        return stops or None
+
     def completions(self, body: Dict[str, Any]):
         prompt = body.get("prompt", "")
         ids = (
@@ -121,12 +143,17 @@ class OpenAIServer:
         )
         max_tokens = int(body.get("max_tokens", 16))
         temperature = float(body.get("temperature", 0.0))
+        top_p = float(body.get("top_p", 1.0))
+        stop = self._stop_ids(body)
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         if body.get("stream"):
             return self._stream_sse(
-                rid, "text_completion", ids, max_tokens, temperature
+                rid, "text_completion", ids, max_tokens, temperature, top_p,
+                stop,
             )
-        out = self.engine.generate(ids, max_tokens=max_tokens, temperature=temperature)
+        out = self.engine.generate(ids, max_tokens=max_tokens,
+                                   temperature=temperature, top_p=top_p,
+                                   stop=stop)
         text = self.tokenizer.decode(out["token_ids"])
         return {
             "id": rid,
@@ -149,10 +176,15 @@ class OpenAIServer:
         ids = self.tokenizer.encode(_chat_prompt(messages))
         max_tokens = int(body.get("max_tokens", 16))
         temperature = float(body.get("temperature", 0.0))
+        top_p = float(body.get("top_p", 1.0))
+        stop = self._stop_ids(body)
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         if body.get("stream"):
-            return self._stream_sse(rid, "chat.completion", ids, max_tokens, temperature)
-        out = self.engine.generate(ids, max_tokens=max_tokens, temperature=temperature)
+            return self._stream_sse(rid, "chat.completion", ids, max_tokens,
+                                    temperature, top_p, stop)
+        out = self.engine.generate(ids, max_tokens=max_tokens,
+                                   temperature=temperature, top_p=top_p,
+                                   stop=stop)
         text = self.tokenizer.decode(out["token_ids"])
         return {
             "id": rid,
@@ -189,13 +221,15 @@ class OpenAIServer:
 
     # ------------------------------------------------------------ helpers
 
-    def _stream_sse(self, rid, obj, ids, max_tokens, temperature):
+    def _stream_sse(self, rid, obj, ids, max_tokens, temperature, top_p=1.0,
+                    stop=None):
         """Generator of OpenAI stream chunks; the HTTP proxy emits each as
         a server-sent event (in-process runtime: generators cross the
         handle live)."""
         tokenizer, model = self.tokenizer, self.model_name
         req, stream = self.engine.open_stream(
-            ids, max_tokens=max_tokens, temperature=temperature
+            ids, max_tokens=max_tokens, temperature=temperature, top_p=top_p,
+            stop=stop,
         )
 
         def gen():
