@@ -152,6 +152,8 @@ class Dataset:
         return self.iterator().iter_device_batches(**kw)
 
     def take(self, n: int = 20) -> List[Any]:
+        if n <= 0:
+            return []
         out = []
         for row in self.iter_rows():
             out.append(row)
@@ -161,6 +163,43 @@ class Dataset:
 
     def take_all(self) -> List[Any]:
         return list(self.iter_rows())
+
+    # -- whole-dataset converters (reference: Dataset.to_pandas /
+    # to_arrow_refs / to_numpy_refs — driver-side materialization for
+    # datasets known to fit in memory) --------------------------------
+
+    def to_pandas(self, limit: Optional[int] = None):
+        """Materialize as one pandas DataFrame (caps at `limit` rows when
+        given). Small-result ergonomics, not a data path: blocks pull to
+        the driver."""
+        import pandas as pd
+
+        rows = self.take(limit) if limit is not None else self.take_all()
+        return pd.DataFrame(rows)
+
+    def to_arrow(self, limit: Optional[int] = None):
+        """Materialize as one pyarrow Table (via pandas for mixed rows)."""
+        import pyarrow as pa
+
+        return pa.Table.from_pandas(self.to_pandas(limit),
+                                    preserve_index=False)
+
+    def to_numpy(self, column: Optional[str] = None):
+        """Materialize as {column: np.ndarray} (or one array for a single
+        named column)."""
+        import numpy as np
+
+        rows = self.take_all()
+        if not rows:
+            return np.array([]) if column else {}
+        if not isinstance(rows[0], dict):
+            if column is not None:
+                raise ValueError(
+                    f"column={column!r} requested but rows are plain values"
+                )
+            return np.asarray(rows)
+        cols = {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+        return cols[column] if column is not None else cols
 
     def count(self) -> int:
         # metadata travels to the driver, blocks stay put

@@ -121,6 +121,16 @@ class HTTPProxy:
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     logger.debug("SSE client disconnected")
+                finally:
+                    # close the chunk generator NOW (not at GC): its
+                    # finally-blocks cancel abandoned upstream work (e.g.
+                    # the LLM engine request) promptly on disconnect
+                    close = getattr(chunks, "close", None)
+                    if callable(close):
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001
+                            pass
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._server.server_address[1]

@@ -227,12 +227,27 @@ class OpenAIServer:
         a server-sent event (in-process runtime: generators cross the
         handle live)."""
         tokenizer, model = self.tokenizer, self.model_name
-        req, stream = self.engine.open_stream(
-            ids, max_tokens=max_tokens, temperature=temperature, top_p=top_p,
-            stop=stop,
-        )
+        engine = self.engine
 
         def gen():
+            # admission happens on FIRST PULL, inside the generator: a
+            # client that disconnects before consuming anything never
+            # admits a request at all (a never-started generator's
+            # finally cannot run, so nothing may need cancelling either)
+            req, stream = engine.open_stream(
+                ids, max_tokens=max_tokens, temperature=temperature,
+                top_p=top_p, stop=stop,
+            )
+            try:
+                yield from body(req, stream)
+            finally:
+                # consumer gone (GeneratorExit on client disconnect) or
+                # exhausted — cancel is a no-op on a finished request, and
+                # frees the slot/pages of an abandoned one (reference:
+                # serve's disconnect-driven cancellation)
+                engine.cancel(req.request_id)
+
+        def body(req, stream):
             created = int(time.time())
             for tok in stream:
                 piece = tokenizer.decode([tok])

@@ -511,3 +511,38 @@ class TestBoundedShuffle:
         # everything the shuffle made is gone once nothing references it
         leaked = self._store_bytes(rt) - base
         assert leaked < 200_000, leaked
+
+
+class TestConverters:
+    """Whole-dataset materializers (reference: Dataset.to_pandas /
+    to_arrow_refs / to_numpy_refs)."""
+
+    def test_to_pandas_roundtrip(self, ray_start_regular):
+        import pandas as pd
+
+        from ray_tpu import data as rt_data
+
+        df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+        ds = rt_data.from_pandas(df)
+        out = ds.to_pandas()
+        pd.testing.assert_frame_equal(out.reset_index(drop=True), df)
+        assert len(ds.to_pandas(limit=2)) == 2
+
+    def test_to_numpy_columns(self, ray_start_regular):
+        import numpy as np
+
+        from ray_tpu import data as rt_data
+
+        ds = rt_data.from_items([{"x": i, "y": i * 2.0} for i in range(10)])
+        cols = ds.map(lambda r: {"x": r["x"], "y": r["y"] + 1}).to_numpy()
+        np.testing.assert_array_equal(cols["x"], np.arange(10))
+        np.testing.assert_array_equal(cols["y"], np.arange(10) * 2.0 + 1)
+        y = ds.to_numpy("y")
+        assert y.shape == (10,)
+
+    def test_to_arrow(self, ray_start_regular):
+        from ray_tpu import data as rt_data
+
+        ds = rt_data.from_items([{"a": i} for i in range(5)])
+        table = ds.to_arrow()
+        assert table.num_rows == 5 and table.column_names == ["a"]

@@ -983,3 +983,35 @@ class TestSampling:
         assert engine.generate([1, 2], max_tokens=2,
                                temperature=0.0)["token_ids"]
         engine.stop()
+
+
+class TestDisconnectCancel:
+    """Client disconnect mid-SSE cancels the engine request (reference:
+    serve's disconnect-driven cancellation end to end)."""
+
+    def test_closed_stream_generator_cancels_request(self):
+        from ray_tpu.serve.openai_api import OpenAIServer
+
+        cls = OpenAIServer._target
+        srv = cls(model_name="tiny-llama",
+                  engine_config=dict(max_batch_size=2, page_size=8,
+                                     max_pages=64, max_seq_len=128,
+                                     prefill_buckets=(16, 32)))
+        try:
+            chunks = srv.completions({"prompt": "ab", "max_tokens": 100,
+                                      "stream": True})
+            first = next(chunks)  # generation underway
+            assert first["object"].endswith(".chunk")
+            chunks.close()  # the proxy does this on client disconnect
+            # the abandoned request is cancelled: slot frees, pool drains
+            deadline = time.monotonic() + 15
+            ok = False
+            while time.monotonic() < deadline:
+                s = srv.engine.stats()
+                if s["active"] == 0 and s["free_pages"] == 64 - 1:
+                    ok = True
+                    break
+                time.sleep(0.05)
+            assert ok, srv.engine.stats()
+        finally:
+            srv.engine.stop()
