@@ -73,6 +73,13 @@ class ObjectPullError(RuntimeError):
     pass
 
 
+class ObjectPullConnectionError(ObjectPullError):
+    """Transport-class pull failure (connection lost / garbled response):
+    the CONNECTION is suspect, not the holder's answer. Retrying the same
+    holder on a fresh socket makes sense; an application-level refusal
+    (plain ObjectPullError — e.g. the object is not there) does not."""
+
+
 _NATIVE_MISS = object()  # sentinel: native path unavailable, use chunks
 
 
@@ -373,10 +380,12 @@ class ObjectTransferClient:
                 msg_type, resp = recv_msg(sock)
             except (WireError, OSError) as e:
                 self._drop(address)
-                raise ObjectPullError(f"transfer connection to {address} lost: {e}")
+                raise ObjectPullConnectionError(
+                    f"transfer connection to {address} lost: {e}")
         if msg_type != MSG_RESPONSE or resp.get("id") != req_id:
             self._drop(address)
-            raise ObjectPullError(f"bad transfer response from {address}")
+            raise ObjectPullConnectionError(
+                f"bad transfer response from {address}")
         if not resp.get("ok"):
             raise ObjectPullError(resp.get("error", "pull failed"))
         return resp["value"]
@@ -472,8 +481,17 @@ class ObjectTransferClient:
                         if n is None:
                             # staged blob evicted between stage and pull:
                             # restage once (the holder re-pins it), then
-                            # give up to chunks
-                            self._call(address, "stage", oid_hex, raw)
+                            # give up to chunks. The holder may have
+                            # restarted its native plane (or resealed a
+                            # different-size blob) since the first stage —
+                            # retry against the RESPONSE's port/size, not
+                            # the stale ones
+                            restaged = self._call(address, "stage", oid_hex,
+                                                  raw)
+                            native_port = restaged.get("native_port")
+                            total = restaged.get("size", total)
+                            if native_port is None:
+                                return _NATIVE_MISS
                             n = native.pull_into(host, native_port, sid,
                                                  staging)
                             if n is None:
@@ -559,16 +577,22 @@ def pull_from_any(control_plane, object_id,
         address = control_plane.kv_get(key)
         if not address:
             continue
-        # two attempts per holder: the shared client pools connections, so
-        # the first failure after a holder restart (or an idle conn being
-        # dropped) is just the stale socket — the client drops it and the
-        # retry dials fresh
+        # two attempts per holder, but ONLY for transport-class failures:
+        # the shared client pools connections, so the first failure after
+        # a holder restart (or an idle conn being dropped) is just the
+        # stale socket — the client drops it and the retry dials fresh. An
+        # application-level refusal ("object not here") is the holder's
+        # real answer; re-asking the same holder just doubles pull latency
+        # across a large fleet.
         for attempt in (0, 1):
             try:
                 return client.pull(address, object_id)
-            except ObjectPullError as e:
+            except ObjectPullConnectionError as e:
                 if attempt == 1:
                     errors.append((address, str(e)))
+            except ObjectPullError as e:
+                errors.append((address, str(e)))
+                break
     raise ObjectPullError(
         f"no advertised holder served {object_id}: {errors}"
     )

@@ -382,12 +382,19 @@ class ProcessPool:
         with self._inflight_lock:
             if not self._inflight:
                 return None
-            _lane, (pid, _t0) = max(self._inflight.items(),
-                                    key=lambda kv: kv[1][1])
-        try:
-            os.kill(pid, signal.SIGKILL)
-        except OSError:
-            return None
+            lane, (pid, t0) = max(self._inflight.items(),
+                                  key=lambda kv: kv[1][1])
+        # The victim may finish (and its lane restart a new worker — or the
+        # OS may even reuse the pid) between choosing it and signalling:
+        # re-verify the SAME (pid, start time) still holds the lane right
+        # before SIGKILL, under the lock so _lane can't swap it mid-check.
+        with self._inflight_lock:
+            if self._inflight.get(lane) != (pid, t0):
+                return None
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                return None
         return pid
 
     def ensure_memory_monitor(self) -> None:

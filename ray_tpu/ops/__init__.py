@@ -16,4 +16,8 @@ CPU mesh. Set RAY_TPU_FORCE_PALLAS=0/1 to override globally.
 from .attention import flash_attention, mha_reference  # noqa: F401
 from .norm import layer_norm, rms_norm, rms_norm_reference  # noqa: F401
 from .rope import apply_rope, rope_frequencies  # noqa: F401
-from .paged_attention import paged_attention_chunk, paged_attention_decode  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_attention_chunk,
+    paged_attention_decode,
+    paged_attention_verify,
+)

@@ -22,7 +22,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .dispatch import interpret_mode, platform_dispatch, use_pallas
+from .dispatch import (
+    interpret_mode,
+    platform_dispatch,
+    shard_map_compat,
+    tpu_compiler_params,
+    use_pallas,
+)
 
 _NEG_INF = -2.0e30
 _LANES = 128
@@ -182,7 +188,7 @@ def _paged_pallas(q, k_pages, v_pages, page_table, lengths, scale):
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, g, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret_mode(),
@@ -282,7 +288,7 @@ def _chunk_pallas(q, k_pages, v_pages, page_table, meta, scale):
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((KVH, rows, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret_mode(),
@@ -340,6 +346,187 @@ def paged_attention_chunk(
     )
 
 
+def _verify_reference(q, k_pages, v_pages, page_table, positions, scale):
+    """Gather-based fallback for speculative verify. q [B,S,H,D] ->
+    o [B,S,H,D]; key j visible to query (b, s) iff j <= positions[b] + s."""
+    B, S, H, D = q.shape
+    KVH, _, page_size, _ = k_pages.shape
+    g = H // KVH
+    pages_per_seq = page_table.shape[1]
+    ctx = pages_per_seq * page_size
+    # [KVH, B, pages, ps, D] -> [B, KVH, ctx, D]
+    kg = jnp.moveaxis(k_pages[:, page_table], 1, 0).reshape(B, KVH, ctx, D)
+    vg = jnp.moveaxis(v_pages[:, page_table], 1, 0).reshape(B, KVH, ctx, D)
+    qf = q.reshape(B, S, KVH, g, D).astype(jnp.float32)
+    s = jnp.einsum("bscgd,bctd->bscgt", qf, kg.astype(jnp.float32)) * scale
+    keypos = jnp.arange(ctx)
+    qpos = positions[:, None] + jnp.arange(S)[None, :]  # [B, S]
+    mask = keypos[None, None, :] <= qpos[:, :, None]  # [B, S, ctx]
+    s = jnp.where(mask[:, :, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bscgt,bctd->bscgd", p, vg.astype(jnp.float32))
+    return o.reshape(B, S, H, D).astype(q.dtype)
+
+
+def _verify_kernel(
+    # scalar prefetch
+    pt_ref, pos_ref,
+    # inputs
+    q_ref, k_hbm, v_hbm,
+    # outputs
+    o_ref,
+    # scratch
+    k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+    *, page_size, pages_per_seq, scale, rows, group, span,
+):
+    """Speculative-verify attention for one (sequence, kv head): the
+    decode kernel generalized from one query token to a span of S=k+1
+    (last committed + k draft tokens, KV already written into the
+    sequence's pages by the caller). Same double-buffered page streaming;
+    the mask becomes the chunk kernel's per-ROW causal bound anchored at
+    this sequence's start position."""
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    start = pos_ref[b]
+    total = start + span
+    # clamp to THIS sequence's table: a span launched near max_seq_len
+    # would otherwise walk into the next sequence's flat table entries
+    # (the overflow keys are dead anyway — every row the caller commits
+    # has qpos below pages_per_seq * page_size)
+    n_pages = jnp.minimum(
+        jax.lax.div(total + page_size - 1, page_size), pages_per_seq)
+
+    def mask(i):
+        keypos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 0) // group
+        return keypos <= qpos
+
+    out = _flash_page_loop(
+        q_ref[0, 0].astype(jnp.float32), n_pages,
+        lambda i: pt_ref[b * pages_per_seq + i], mask, c,
+        k_hbm, v_hbm, k_buf, v_buf, acc_ref, m_ref, l_ref, sem_ref,
+        page_size=page_size, scale=scale,
+    )
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _verify_pallas(q, k_pages, v_pages, page_table, positions, scale):
+    B, S, H, D = q.shape
+    KVH, _, page_size, _ = k_pages.shape
+    g = H // KVH
+    pages_per_seq = page_table.shape[1]
+    rows = S * g
+    # [B,S,H,D] -> [B, KVH, S*g, D]: each kv head's q rows contiguous,
+    # row = s*g + gi so row // g recovers the span offset (mask anchor)
+    qr = (q.reshape(B, S, KVH, g, D)
+          .transpose(0, 2, 1, 3, 4).reshape(B, KVH, rows, D))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, D), lambda b, c, *_: (b, c, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, c, *_: (b, c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, D), k_pages.dtype),
+            pltpu.VMEM((2, page_size, D), v_pages.dtype),
+            pltpu.VMEM((rows, D), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel, page_size=page_size, pages_per_seq=pages_per_seq,
+            scale=scale, rows=rows, group=g, span=S,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, rows, D), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode(),
+    )(page_table.reshape(-1), positions, qr, k_pages, v_pages)
+    # [B, KVH, S*g, D] -> [B, S, H, D]
+    return (out.reshape(B, KVH, S, g, D)
+            .transpose(0, 2, 1, 3, 4).reshape(B, S, H, D))
+
+
+def paged_attention_verify(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    positions: jax.Array,
+    scale: float | None = None,
+    force_xla: bool = False,
+    mesh=None,
+    tp_axis: str = "tp",
+) -> jax.Array:
+    """Speculative-decode verify attention over the paged KV cache.
+
+    The engine writes the span's KV (last committed token + k draft
+    tokens, at positions p..p+k) into each sequence's pages, then scores
+    all S=k+1 positions in ONE forward: key j is visible to query row s
+    of sequence b iff ``j <= positions[b] + s`` (committed prefix +
+    causal within the speculative window). S=1 degenerates to exactly
+    paged_attention_decode's semantics.
+
+    Args:
+      q: [B, S, H, D] — span queries per sequence (rope applied).
+      k_pages/v_pages: [KVH, num_pages, page_size, D] (span KV written).
+      page_table: [B, pages_per_seq] int32 page ids.
+      positions: [B] int32 — position of each sequence's row 0 (== its
+        committed length; rows past a shorter draft are masked by the
+        caller's accept logic, not here).
+      mesh/tp_axis: tensor-parallel serving, same shard_map wrap as
+        paged_attention_decode (q heads + page-pool KVH dim sharded).
+    Returns [B, S, H, D].
+    """
+    D = q.shape[-1]
+    KVH = k_pages.shape[0]
+    if scale is None:
+        scale = D**-0.5
+
+    def dispatch(q, kp, vp, pt, pos):
+        return platform_dispatch(
+            lambda *a: _verify_pallas(*a, scale),
+            lambda *a: _verify_reference(*a, scale),
+            q, kp, vp, pt, pos,
+        )
+
+    tp = int(mesh.shape.get(tp_axis, 1)) if mesh is not None else 1
+    kernel_ok = (
+        use_pallas()
+        and D % _LANES == 0
+        and q.shape[2] % KVH == 0
+        and (tp == 1 or KVH % tp == 0)
+    )
+    if force_xla or not kernel_ok:
+        return _verify_reference(q, k_pages, v_pages, page_table,
+                                 positions, scale)
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        return shard_map_compat(
+            dispatch,
+            mesh,
+            in_specs=(
+                P(None, None, tp_axis, None),  # q: heads sharded
+                P(tp_axis), P(tp_axis),        # page pools: KVH sharded
+                P(), P(),                      # table/positions replicated
+            ),
+            out_specs=P(None, None, tp_axis, None),
+        )(q, k_pages, v_pages, page_table, positions)
+    return dispatch(q, k_pages, v_pages, page_table, positions)
+
+
 def paged_attention_decode(
     q: jax.Array,
     k_pages: jax.Array,
@@ -392,17 +579,16 @@ def paged_attention_decode(
     if tp > 1:
         from jax.sharding import PartitionSpec as P
 
-        return jax.shard_map(
+        return shard_map_compat(
             dispatch,
-            mesh=mesh,
+            mesh,
             in_specs=(
                 P(None, tp_axis, None),        # q: heads sharded
                 P(tp_axis), P(tp_axis),        # page pools: KVH sharded
                 P(), P(),                      # table/lengths replicated
             ),
-            out_specs=P(None, tp_axis, None),
             # no collectives in the body; pallas_call outputs don't carry
             # vma annotations, so the varying-axes checker can't see through
-            check_vma=False,
+            out_specs=P(None, tp_axis, None),
         )(q, k_pages, v_pages, page_table, lengths)
     return dispatch(q, k_pages, v_pages, page_table, lengths)

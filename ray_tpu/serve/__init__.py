@@ -19,7 +19,11 @@ from .api import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
-from .config import AutoscalingConfig, DeploymentConfig  # noqa: F401
+from .config import (  # noqa: F401
+    AutoscalingConfig,
+    DeploymentConfig,
+    SpeculationConfig,
+)
 from .deployment import Application, Deployment, deployment  # noqa: F401
 from .engine import EngineConfig, InferenceEngine, Request  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401

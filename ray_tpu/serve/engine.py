@@ -58,6 +58,8 @@ from ..ops import (
     paged_attention_decode,
     rope_frequencies,
 )
+from .config import SpeculationConfig
+from .spec_decode import SpecDecoder
 
 logger = get_logger("serve.engine")
 
@@ -75,6 +77,21 @@ _m_ttft = Histogram(
     "serve_ttft_seconds", "Time to first token.",
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
 )
+# Per-feature decode-step breakdown: every step() iteration observes each
+# phase once, tagged {phase, mode} — mode is "spec" when speculative
+# decoding drives the step, "plain" for the classic span path. "verify"
+# is the device dispatch (the span/verify program), "sample" the blocking
+# readback, "cache_bookkeeping" the host commit loop.
+_m_step_phase = Histogram(
+    "serve_decode_step_phase_seconds",
+    "Decode step wall time by phase "
+    "(propose/verify/sample/cache_bookkeeping/cancellation_check).",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 1.0, 5.0),
+)
+_m_tokens_per_step = Gauge(
+    "serve_tokens_per_decode_step",
+    "Cumulative committed tokens per slot-step of decode participation.")
 
 
 @dataclasses.dataclass
@@ -148,6 +165,23 @@ class EngineConfig:
     # allocator pressure, so caching never reduces serveable capacity.
     # Requires chunked_prefill (hits enter through the chunk scheduler).
     prefix_caching: bool = True
+    # Speculative decoding (serve/spec_decode.py): None/"off" = classic
+    # one-token decode; a SpeculationConfig (or its dict form from YAML)
+    # with mode "ngram"/"draft" turns decode steps into propose-k +
+    # verify-once rounds committing 1..k+1 tokens each.
+    speculation: Optional[Any] = None
+
+    def __post_init__(self) -> None:
+        if (self.chunked_prefill or self.prefix_caching) and (
+                self.prefill_chunk % self.page_size != 0):
+            raise ValueError(
+                "prefill_chunk must be a multiple of page_size when "
+                "chunked prefill or prefix caching is enabled (chunk KV "
+                "lands directly in pages and cache hits are chunk-aligned): "
+                f"prefill_chunk={self.prefill_chunk} "
+                f"page_size={self.page_size}")
+        if self.speculation is not None:
+            self.speculation = SpeculationConfig.parse(self.speculation)
 
     @property
     def pages_per_seq(self) -> int:
@@ -365,6 +399,7 @@ class InferenceEngine:
         model_cfg: ModelConfig,
         engine_cfg: EngineConfig,
         mesh=None,
+        draft_params=None,
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg
@@ -429,6 +464,15 @@ class InferenceEngine:
         self._decode = self._build_decode()
         self._prefill_cache: Dict[int, Any] = {}
         self._chunk_fn = self._build_chunk_prefill()
+        scfg = engine_cfg.speculation
+        self._spec: Optional[SpecDecoder] = (
+            SpecDecoder(self, scfg, draft_params=draft_params)
+            if scfg is not None and scfg.enabled else None)
+        # tokens-per-decode-step accounting: committed tokens over slot
+        # participations (plain: span per active slot per dispatch; spec:
+        # one per active slot per round)
+        self._tps_committed = 0
+        self._tps_steps = 0
         # long-prompt chunk states, consumed one chunk per step() by the
         # DECODE thread (chunk programs donate the same page pool the
         # decode program does — two threads dispatching donated updates
@@ -713,6 +757,8 @@ class InferenceEngine:
                 jnp.zeros((pps,), jnp.int32), jnp.int32(C - 1),
             )
             _np.asarray(logits)
+        if self._spec is not None:
+            self._spec.warmup()
 
     def _prefill_fn(self, bucket: int, batch: int = 1):
         key = (bucket, batch)
@@ -1119,6 +1165,11 @@ class InferenceEngine:
             slot.pages = pages
             slot.position = T  # the sampled token will be written at T
             slot.generated = 1
+            if self._spec is not None:
+                # draft proposer: prefill the prompt into the slot's draft
+                # pages (runs on the decode thread — donated draft pools
+                # are only ever touched here and in run_step)
+                self._spec.on_install(self.slots.index(slot), req)
             self._maybe_finish(slot, req.output[-1])
             installed = True
             _m_running.set(sum(1 for s in self.slots if s.request is not None))
@@ -1184,12 +1235,30 @@ class InferenceEngine:
         by the host loop, and its extra KV writes are harmless — table
         entries past the allocated pages are 0 (the reserved trash page),
         and page frees happen on the host only after this span's readback,
-        so no recycled page can be written. Returns True if work happened."""
+        so no recycled page can be written. Returns True if work happened.
+
+        With speculation enabled (EngineConfig.speculation) the span is
+        replaced by ONE propose-k/verify-once round per iteration
+        committing 1..k+1 tokens per slot (spec_decode.SpecDecoder).
+
+        Every iteration with active slots observes the per-phase timing
+        histogram (serve_decode_step_phase_seconds, tagged phase+mode)."""
         chunked = self._advance_chunk()
         installed = self._install_ready()
+        # Cancellation sweep: a request cancelled mid-decode (or mid-
+        # speculation round) frees its slot at this step boundary instead
+        # of riding out the span / the committed draft prefix.
+        t0 = time.monotonic()
+        for s in self.slots:
+            if s.request is not None and s.request.cancelled.is_set():
+                self._maybe_finish(s, -1)
+        t_cancel = time.monotonic() - t0
         active = self._active()
         if not active:
             return installed or chunked
+        mode = "spec" if self._spec is not None else "plain"
+        _m_step_phase.observe(
+            t_cancel, tags={"phase": "cancellation_check", "mode": mode})
 
         B = self.ecfg.max_batch_size
         pps = self.ecfg.pages_per_seq
@@ -1212,6 +1281,12 @@ class InferenceEngine:
             if s.request.temperature > 0 and (
                     s.request.top_p < 1.0 or s.request.top_k > 0):
                 advanced = True  # the sort-based sampler program runs
+        self._step_count += 1
+        key = jax.random.fold_in(self._base_key, self._step_count)
+        if self._spec is not None:
+            self._step_spec(tokens, positions, tables, temps, top_ps,
+                            top_ks, advanced, key, len(active))
+            return True
         # Adaptive span (VERDICT r3 #2): while prefill work is queued or
         # running, shrink the span so the device yields between decode
         # dispatches and arriving requests get their first token (emitted
@@ -1224,14 +1299,17 @@ class InferenceEngine:
             span = max(1, self.ecfg.busy_span)
         else:
             span = max(1, self.ecfg.decode_span)
-        self._step_count += 1
-        key = jax.random.fold_in(self._base_key, self._step_count)
+        t0 = time.monotonic()
         seq, self.k_pages, self.v_pages = self._decode(span, advanced)(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), key,
         )
+        t1 = time.monotonic()
         seq = np.asarray(seq)  # [span, B] — one readback per span
+        t2 = time.monotonic()
+        n_participating = span * len(active)
+        committed = 0
         for t in range(span):
             for i, s in enumerate(self.slots):
                 if s.request is None:
@@ -1241,6 +1319,7 @@ class InferenceEngine:
                 if s.generated < s.request.max_tokens and not s.request.done.is_set():
                     s.request.output.append(tok)
                     s.generated += 1
+                    committed += 1
                     _m_tokens.inc()
                     eos = self.ecfg.eos_token_id
                     if eos is not None and tok == eos:
@@ -1254,7 +1333,78 @@ class InferenceEngine:
                     else:
                         s.request._emit(tok)
                 self._maybe_finish(s, tok)
+        t3 = time.monotonic()
+        _m_step_phase.observe(t1 - t0, tags={"phase": "verify",
+                                             "mode": "plain"})
+        _m_step_phase.observe(t2 - t1, tags={"phase": "sample",
+                                             "mode": "plain"})
+        _m_step_phase.observe(t3 - t2, tags={"phase": "cache_bookkeeping",
+                                             "mode": "plain"})
+        self._note_tokens_per_step(committed, n_participating)
         return True
+
+    def _step_spec(self, tokens, positions, tables, temps, top_ps, top_ks,
+                   advanced, key, n_active) -> None:
+        """One speculative round for the built batch arrays: propose up to
+        k drafts per slot (capped to the slot's remaining token budget and
+        sequence room so no verify write can land past its allocation),
+        verify them in one span forward, commit the accepted prefix plus
+        the bonus token through the same budget/eos/stop/finish path the
+        plain loop uses."""
+        spec = self._spec
+        ecfg = self.ecfg
+        caps = np.zeros((ecfg.max_batch_size,), np.int32)
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            caps[i] = max(0, min(
+                spec.k,
+                s.request.max_tokens - s.generated - 1,
+                ecfg.max_seq_len - 1 - s.position))
+        committed, n_comm, n_draft, times = spec.run_step(
+            tokens, positions, tables, caps, temps, top_ps, top_ks,
+            advanced, key)
+        t0 = time.monotonic()
+        proposed = accepted = n_tokens = 0
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            proposed += int(n_draft[i])
+            accepted += int(n_comm[i]) - 1
+            for t in range(int(n_comm[i])):
+                if s.request is None:
+                    break  # finished on an earlier committed token
+                s.position += 1
+                tok = int(committed[i, t])
+                if (s.generated < s.request.max_tokens
+                        and not s.request.done.is_set()):
+                    s.request.output.append(tok)
+                    s.generated += 1
+                    n_tokens += 1
+                    _m_tokens.inc()
+                    eos = ecfg.eos_token_id
+                    if eos is not None and tok == eos:
+                        pass  # eos is control, not content
+                    elif s.request.stop:
+                        s.request._held.append(tok)
+                    else:
+                        s.request._emit(tok)
+                self._maybe_finish(s, tok)
+        t1 = time.monotonic()
+        spec.record(proposed, accepted)
+        for phase in ("propose", "verify", "sample"):
+            _m_step_phase.observe(times[phase], tags={"phase": phase,
+                                                      "mode": "spec"})
+        _m_step_phase.observe(t1 - t0, tags={"phase": "cache_bookkeeping",
+                                             "mode": "spec"})
+        self._note_tokens_per_step(n_tokens, n_active)
+
+    def _note_tokens_per_step(self, committed: int, participations: int
+                              ) -> None:
+        self._tps_committed += committed
+        self._tps_steps += participations
+        if self._tps_steps:
+            _m_tokens_per_step.set(self._tps_committed / self._tps_steps)
 
     def _maybe_finish(self, slot: _Slot, last_tok: int) -> None:
         req = slot.request
@@ -1403,6 +1553,7 @@ class InferenceEngine:
         # free_pages counts SERVEABLE capacity: zero-ref cached pages are
         # reclaimed on demand (_alloc_with_reclaim), so they are free in
         # every sense that matters to admission
+        spec = self._spec.stats() if self._spec is not None else {}
         return {
             "active": len(self._active()),
             "pending": self.pending.qsize(),
@@ -1411,6 +1562,10 @@ class InferenceEngine:
             "free_pages": free_pages + prefix.get("reusable_pages", 0),
             **prefix,
             "steps": self._step_count,
+            "tokens_per_decode_step": (
+                self._tps_committed / self._tps_steps
+                if self._tps_steps else 0.0),
+            **spec,
         }
 
     def stop(self):

@@ -26,6 +26,11 @@ class LLMServer:
 
     params_fn: optional () -> (params, model_cfg) to load real weights;
     default builds random-init weights for the named config.
+
+    speculation: speculative-decoding config (SpeculationConfig or its
+    dict form) — shorthand for engine_config["speculation"]; the two must
+    not both be set. draft_params_fn loads the draft model's weights for
+    mode="draft" (default: random init of the named draft config).
     """
 
     def __init__(
@@ -35,13 +40,22 @@ class LLMServer:
         params_fn=None,
         model_overrides: Optional[Dict[str, Any]] = None,
         tensor_parallel: int = 1,
+        speculation: Any = None,
+        draft_params_fn=None,
     ):
         if params_fn is not None:
             params, cfg = params_fn()
         else:
             cfg = get_config(model_name, **(model_overrides or {}))
             params = init_params(cfg, jax.random.PRNGKey(0))
-        ecfg = EngineConfig(**(engine_config or {}))
+        engine_config = dict(engine_config or {})
+        if speculation is not None:
+            if engine_config.get("speculation") is not None:
+                raise ValueError(
+                    "pass speculation either as the LLMServer kwarg or "
+                    "inside engine_config, not both")
+            engine_config["speculation"] = speculation
+        ecfg = EngineConfig(**engine_config)
         mesh = None
         if tensor_parallel > 1:
             from ..comm.mesh import MeshSpec, build_mesh
@@ -56,7 +70,10 @@ class LLMServer:
                 MeshSpec.create(tp=tensor_parallel),
                 devices=devices[:tensor_parallel],
             )
-        self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
+        draft_params = (draft_params_fn()
+                        if draft_params_fn is not None else None)
+        self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh,
+                                      draft_params=draft_params)
         # compile every decode-span program at replica init: the
         # adaptive policy's busy_span would otherwise jit mid-traffic,
         # stalling the whole active batch exactly under prefill

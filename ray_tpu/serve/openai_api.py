@@ -85,6 +85,8 @@ class OpenAIServer:
         model_overrides: Optional[Dict[str, Any]] = None,
         tokenizer: Any = "byte",
         tensor_parallel: int = 1,
+        speculation: Any = None,
+        draft_params_fn=None,
     ):
         self.model_name = model_name
         self.tokenizer = _make_tokenizer(tokenizer)
@@ -95,6 +97,12 @@ class OpenAIServer:
             params = init_params(cfg, jax.random.PRNGKey(0))
         ecfg_kw = dict(engine_config or {})
         ecfg_kw.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+        if speculation is not None:
+            if ecfg_kw.get("speculation") is not None:
+                raise ValueError(
+                    "pass speculation either as the OpenAIServer kwarg or "
+                    "inside engine_config, not both")
+            ecfg_kw["speculation"] = speculation
         ecfg = EngineConfig(**ecfg_kw)
         mesh = None
         if tensor_parallel > 1:
@@ -102,7 +110,10 @@ class OpenAIServer:
 
             devices = jax.devices()[:tensor_parallel]
             mesh = build_mesh(MeshSpec.create(tp=tensor_parallel), devices=devices)
-        self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh)
+        draft_params = (draft_params_fn()
+                        if draft_params_fn is not None else None)
+        self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh,
+                                      draft_params=draft_params)
         # compile every decode-span program at replica init: the
         # adaptive policy's busy_span would otherwise jit mid-traffic,
         # stalling the whole active batch exactly under prefill

@@ -27,10 +27,25 @@ import importlib
 from typing import Any, Dict, List, Optional
 
 from ..core.logging import get_logger
-from .config import AutoscalingConfig
+from .config import AutoscalingConfig, SpeculationConfig
 from .deployment import Application, Deployment
 
 logger = get_logger("serve.schema")
+
+
+def _validate_speculation(kwargs: Dict[str, Any], app_name) -> None:
+    """LLM app kwargs may carry speculative-decoding config — top-level
+    `speculation:` or nested under `engine_config:`. Validate it at parse
+    time so a typo'd knob fails at `serve deploy` with the app named,
+    not at replica startup."""
+    ecfg = kwargs.get("engine_config")
+    for holder in (kwargs, ecfg if isinstance(ecfg, dict) else {}):
+        if holder.get("speculation") is None:
+            continue
+        try:
+            SpeculationConfig.parse(holder["speculation"])
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"app {app_name!r}: {e}") from None
 
 
 @dataclasses.dataclass
@@ -79,6 +94,8 @@ class ServeConfigSchema:
                         f"in {d.get('name', '?')!r}"
                     )
                 deps.append(DeploymentSchema(**d))
+            _validate_speculation(dict(app.get("kwargs", {})),
+                                  app.get("name", "?"))
             apps.append(ApplicationSchema(
                 name=app["name"],
                 import_path=app["import_path"],

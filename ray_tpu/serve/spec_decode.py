@@ -1,0 +1,607 @@
+"""Speculative decoding for the serving engine: propose-k, verify-once.
+
+A decode step normally yields one token per sequence per forward. Here a
+cheap PROPOSER guesses k continuation tokens per slot, and ONE batched
+verify forward scores all k+1 positions against the paged KV cache
+(ops.paged_attention_verify — the decode kernel widened to a span). The
+longest accepted draft prefix commits, plus one "bonus" token sampled
+from the first non-accepted position, so every step commits between 1
+and k+1 tokens and never fewer than the plain path. On TPU the verify
+forward costs barely more than a single decode step, so accepted drafts
+are nearly free throughput.
+
+Correctness contract (the greedy-equivalence test pins it): both
+proposers are DETERMINISTIC (point-mass proposals), which makes exact
+rejection sampling simple —
+
+- greedy rows (temp<=0): draft d at row s accepts iff
+  argmax(verify_logits[s]) == d, and the bonus is that argmax, so the
+  committed stream is bit-identical to speculation-off greedy decode.
+- sampling rows (temp>0): d accepts with probability p(d) under the
+  temperature/top-k/top-p-filtered verify distribution; on rejection the
+  bonus is drawn from that distribution with d zeroed out and
+  renormalized. For a point-mass proposal this is exactly Leviathan-style
+  speculative sampling: the output distribution equals the target's.
+
+Two proposers behind one duck-typed interface
+(on_install/propose/warmup):
+
+- NGramProposer: suffix-match lookup over the request's own
+  prompt+output (vLLM's ngram mode) — no extra model, wins on
+  repetitive/extractive continuations.
+- DraftModelProposer: a small transformer from models/ sharing the
+  tokenizer, with its OWN paged KV pool mirroring each slot's positions
+  (fixed per-slot page runs — no allocator). Prompts chunk-prefill into
+  the draft pool at install; each step runs k greedy draft-decode steps
+  in one jitted scan.
+
+KV bookkeeping: the verify forward writes span KV at positions
+p..p+n_draft per slot (rows past a slot's draft count are routed to the
+trash page). After committing a drafts + bonus, the slot advances a+1;
+the bonus token's KV is written by the NEXT round's row 0, and
+stale rejected-draft KV above the new position is invisible (attention
+is position-bounded) until overwritten.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.logging import get_logger
+from ..core.metrics import Counter, Gauge
+from ..models import get_config, init_params
+from ..models.transformer import _dense_ffn, _embed_lookup, _moe_ffn, _norm
+from ..ops import (
+    apply_rope,
+    paged_attention_chunk,
+    paged_attention_decode,
+    paged_attention_verify,
+    rope_frequencies,
+)
+from .config import SpeculationConfig
+
+logger = get_logger("serve.spec_decode")
+
+_m_spec_proposed = Counter(
+    "serve_spec_proposed_tokens",
+    "Draft tokens proposed to the verify forward.")
+_m_spec_accepted = Counter(
+    "serve_spec_accepted_tokens",
+    "Draft tokens accepted by the verify forward.")
+_m_spec_accept_rate = Gauge(
+    "serve_spec_acceptance_rate",
+    "Cumulative accepted/proposed draft-token ratio.")
+
+
+# ---------------------------------------------------------------------------
+# Device-side accept + commit
+# ---------------------------------------------------------------------------
+
+
+def _topk_topp_keep(scaled, top_ps, top_ks):
+    """Per-row keep mask in TOKEN space for the temperature-scaled logits,
+    matching engine._device_sample_topk_topp's sorted-domain semantics
+    (first token crossing the nucleus boundary stays; top-1 always kept)."""
+    order = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(scaled.shape[-1])[None, :]
+    keep = (cum - probs) < top_ps[:, None]
+    keep &= jnp.where(top_ks[:, None] > 0, ranks < top_ks[:, None], True)
+    keep = keep.at[:, 0].set(True)
+    inv = jnp.argsort(order, axis=-1)
+    return jnp.take_along_axis(keep, inv, axis=-1)
+
+
+def _accept_commit(logits, tokens, n_draft, temps, top_ps, top_ks, key,
+                   advanced):
+    """logits [B,S,V] f32 (verify forward, row s scores position p+s+1);
+    tokens [B,S] = [last committed, d_1..d_K]; n_draft [B] valid drafts.
+    -> (committed [B,S] int32, n_committed [B] int32). Columns past
+    n_committed are padding the host ignores."""
+    B, S, V = logits.shape
+    K = S - 1
+    greedy = jnp.argmax(logits, axis=-1)  # [B,S] == plain greedy decode
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    if advanced:
+        flat = scaled.reshape(B * S, V)
+        keep = _topk_topp_keep(
+            flat, jnp.repeat(top_ps, S), jnp.repeat(top_ks, S))
+        scaled = jnp.where(keep, flat, -jnp.inf).reshape(B, S, V)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    drafts = tokens[:, 1:]  # [B,K]
+    p_draft = jnp.take_along_axis(
+        probs[:, :K], drafts[:, :, None], axis=-1)[..., 0]
+    key_u, key_b = jax.random.split(key)
+    u = jax.random.uniform(key_u, (B, K))
+    # point-mass proposal (q(d)=1): accept w.p. min(1, p(d)/q(d)) = p(d)
+    ok = jnp.where(temps[:, None] > 0, u < p_draft, greedy[:, :K] == drafts)
+    ok &= jnp.arange(K)[None, :] < n_draft[:, None]
+    a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)  # [B]
+    # bonus from row a: greedy rows reuse the raw-logit argmax (exact
+    # equality with the plain path); sampling rows draw from the residual
+    # (filtered distribution with the rejected draft zeroed out)
+    row_a = jnp.take_along_axis(scaled, a[:, None, None], axis=1)[:, 0]
+    rejected = a < n_draft
+    rej_tok = jnp.take_along_axis(
+        drafts, jnp.minimum(a, K - 1)[:, None], axis=1)[:, 0]
+    resid = jnp.where(
+        rejected[:, None] & (jnp.arange(V)[None, :] == rej_tok[:, None]),
+        -jnp.inf, row_a)
+    sampled = jax.random.categorical(key_b, resid, axis=-1)
+    greedy_bonus = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+    bonus = jnp.where(temps > 0, sampled, greedy_bonus).astype(jnp.int32)
+    cols = jnp.arange(S)[None, :]
+    drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+    committed = jnp.where(
+        cols < a[:, None], drafts_pad,
+        jnp.where(cols == a[:, None], bonus[:, None], 0))
+    return committed.astype(jnp.int32), (a + 1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Proposers
+# ---------------------------------------------------------------------------
+
+
+def _ngram_lookup(ctx: np.ndarray, nmin: int, nmax: int, k: int) -> np.ndarray:
+    """Longest suffix of length in [nmin, nmax] matched against earlier
+    context; the continuation after the MOST RECENT match is the draft."""
+    T = int(ctx.shape[0])
+    for n in range(min(nmax, T - 1), nmin - 1, -1):
+        suffix = ctx[T - n:]
+        win = np.lib.stride_tricks.sliding_window_view(ctx[:T - 1], n)
+        hits = np.flatnonzero((win == suffix).all(axis=1))
+        if hits.size:
+            j = int(hits[-1])
+            return ctx[j + n: j + n + k]
+    return np.empty((0,), np.int32)
+
+
+class NGramProposer:
+    """Draft tokens from the request's own prompt+output (no model)."""
+
+    name = "ngram"
+
+    def __init__(self, spec: SpeculationConfig):
+        self.k = spec.num_speculative_tokens
+        self.nmin = spec.ngram_min
+        self.nmax = spec.ngram_max
+
+    def on_install(self, engine, slot_idx: int, request) -> None:
+        pass
+
+    def warmup(self, engine) -> None:
+        pass
+
+    def propose(self, engine, tokens, positions
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        B = engine.ecfg.max_batch_size
+        drafts = np.zeros((B, self.k), np.int32)
+        n = np.zeros((B,), np.int32)
+        for i, s in enumerate(engine.slots):
+            if s.request is None:
+                continue
+            ctx = np.asarray(s.request.prompt + s.request.output, np.int32)
+            cont = _ngram_lookup(ctx, self.nmin, self.nmax, self.k)
+            m = int(cont.shape[0])
+            if m:
+                drafts[i, :m] = cont
+                n[i] = m
+        return drafts, n
+
+
+class DraftModelProposer:
+    """Draft tokens from a small transformer with its own paged KV pool.
+
+    The draft pool mirrors the target's position bookkeeping exactly
+    (draft position == slot.position at every propose), with FIXED
+    per-slot page runs — pages_per_seq plus a small spill margin so the
+    k-step lookahead near max_seq_len never writes into a neighbour's
+    pages. Prompts chunk-prefill into the pool at install time; per step
+    one jitted scan runs k greedy draft-decode steps for the whole batch.
+    """
+
+    name = "draft"
+
+    def __init__(self, engine, spec: SpeculationConfig, draft_params=None):
+        import dataclasses as _dc
+
+        self.k = spec.num_speculative_tokens
+        ecfg = engine.ecfg
+        if spec.draft_model is None:
+            # self-speculation: share the target's weights. Acceptance is
+            # ~1.0 by construction — an upper-bound plumbing smoke, not a
+            # deployment config (name a real small model for that).
+            self.cfg = engine.cfg
+            self.params = engine.params
+        else:
+            self.cfg = get_config(
+                spec.draft_model, **dict(spec.draft_model_overrides or {}))
+            if self.cfg.vocab_size != engine.cfg.vocab_size:
+                raise ValueError(
+                    "draft model must share the target tokenizer: vocab "
+                    f"{self.cfg.vocab_size} != {engine.cfg.vocab_size}")
+            if self.cfg.max_seq_len < ecfg.max_seq_len:
+                self.cfg = _dc.replace(
+                    self.cfg, max_seq_len=ecfg.max_seq_len)
+            self.params = (draft_params if draft_params is not None
+                           else init_params(self.cfg, jax.random.PRNGKey(0)))
+        B = ecfg.max_batch_size
+        ps = ecfg.page_size
+        self.ps = ps
+        self.chunk = ecfg.prefill_chunk
+        # spill pages: propose positions reach max_seq_len - 1 + k
+        self.pps = ecfg.pages_per_seq + (-(-self.k // ps))
+        # table length additionally covers padded chunk rows at install
+        # (entries past the real run are 0 — the draft pool's trash page)
+        tbl_len = max(self.pps, -(-(ecfg.max_seq_len + self.chunk) // ps))
+        tables = np.zeros((B, tbl_len), np.int32)
+        for i in range(B):
+            tables[i, : self.pps] = 1 + i * self.pps + np.arange(self.pps)
+        self._tables = jnp.asarray(tables)
+        L, KVH, hd = self.cfg.n_layers, self.cfg.kv_heads, self.cfg.hdim
+        P = 1 + B * self.pps
+        dtype = jnp.dtype(ecfg.cache_dtype)
+        self.k_pages = jnp.zeros((L, KVH, P, ps, hd), dtype)
+        self.v_pages = jnp.zeros((L, KVH, P, ps, hd), dtype)
+        self._chunk_fn = self._build_chunk()
+        self._propose_fn = self._build_propose()
+
+    # -------------------------------------------------------- compiled
+
+    def _build_chunk(self):
+        """Draft-prompt prefill: the engine's chunk program minus the LM
+        head (only the KV writes matter)."""
+        cfg = self.cfg
+        ps = self.ps
+
+        def chunk_step(params, k_pages, v_pages, tokens, start, page_table):
+            dtype = jnp.dtype(cfg.dtype)
+            C = tokens.shape[0]
+            x = _embed_lookup(params["embed"], tokens[None, :], dtype)
+            positions = start + jnp.arange(C)
+            if cfg.positional == "learned":
+                x = x + params["pos_emb"][positions][None].astype(dtype)
+                rope_tables = None
+            else:
+                rope_tables = rope_frequencies(
+                    cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
+            page_idx = page_table[positions // ps]
+            slot_idx = positions % ps
+
+            def body(carry, xs):
+                x = carry
+                lp, kp, vp = xs
+                h = _norm(x, lp["ln1"], lp.get("ln1_b"), cfg)
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+                k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+                v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+                if cfg.positional == "rope":
+                    cos, sin = rope_tables
+                    q = apply_rope(q, cos, sin, positions[None])
+                    k = apply_rope(k, cos, sin, positions[None])
+                kp = kp.at[:, page_idx, slot_idx].set(
+                    k[0].transpose(1, 0, 2).astype(kp.dtype))
+                vp = vp.at[:, page_idx, slot_idx].set(
+                    v[0].transpose(1, 0, 2).astype(vp.dtype))
+                o = paged_attention_chunk(
+                    q[0], kp, vp, page_table, start, start + C,
+                ).astype(dtype)
+                o = jnp.einsum("chk,hkd->cd", o, lp["wo"].astype(dtype))[None]
+                x = x + o
+                h = _norm(x, lp["ln2"], lp.get("ln2_b"), cfg)
+                if cfg.is_moe:
+                    y, _ = _moe_ffn(h, lp, cfg)
+                else:
+                    y = _dense_ffn(h, lp, cfg)
+                return x + y, (kp, vp)
+
+            _, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages))
+            return new_k, new_v
+
+        cache: Dict[int, Any] = {}
+
+        def for_chunk(C: int):
+            if C not in cache:
+                cache[C] = jax.jit(chunk_step, donate_argnums=(1, 2))
+            return cache[C]
+
+        return for_chunk
+
+    def _build_propose(self):
+        """k greedy decode steps over the draft pool in one jitted scan."""
+        cfg = self.cfg
+        ps = self.ps
+        K = self.k
+
+        def one_step(params, k_pages, v_pages, tokens, positions,
+                     page_tables):
+            dtype = jnp.dtype(cfg.dtype)
+            B = tokens.shape[0]
+            x = _embed_lookup(params["embed"], tokens[:, None], dtype)
+            if cfg.positional == "learned":
+                x = x + params["pos_emb"][positions][:, None].astype(dtype)
+                rope_tables = None
+            else:
+                rope_tables = rope_frequencies(
+                    cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
+            pos2d = positions[:, None]
+            page_idx = page_tables[jnp.arange(B), positions // ps]
+            slot_idx = positions % ps
+
+            def body(carry, xs):
+                x = carry
+                lp, kp, vp = xs
+                h = _norm(x, lp["ln1"], lp.get("ln1_b"), cfg)
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+                k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+                v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+                if cfg.positional == "rope":
+                    cos, sin = rope_tables
+                    q = apply_rope(q, cos, sin, pos2d)
+                    k = apply_rope(k, cos, sin, pos2d)
+                kp = kp.at[:, page_idx, slot_idx].set(
+                    k[:, 0].transpose(1, 0, 2).astype(kp.dtype))
+                vp = vp.at[:, page_idx, slot_idx].set(
+                    v[:, 0].transpose(1, 0, 2).astype(vp.dtype))
+                o = paged_attention_decode(
+                    q[:, 0], kp, vp, page_tables, positions + 1)
+                o = jnp.einsum(
+                    "bhk,hkd->bd", o, lp["wo"].astype(dtype))[:, None]
+                x = x + o
+                h = _norm(x, lp["ln2"], lp.get("ln2_b"), cfg)
+                if cfg.is_moe:
+                    y, _ = _moe_ffn(h, lp, cfg)
+                else:
+                    y = _dense_ffn(h, lp, cfg)
+                return x + y, (kp, vp)
+
+            x, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages))
+            x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+                      cfg)
+            head = (params["embed"].T if cfg.tie_embeddings
+                    else params["lm_head"])
+            logits = jnp.einsum(
+                "bd,dv->bv", x[:, 0].astype(jnp.float32),
+                head.astype(jnp.float32))
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_k, new_v
+
+        def propose(params, k_pages, v_pages, tokens, positions, page_tables):
+            def sub(carry, _):
+                toks, pos, kp, vp = carry
+                nxt, kp, vp = one_step(params, kp, vp, toks, pos, page_tables)
+                return (nxt, pos + 1, kp, vp), nxt
+
+            (_, _, kp, vp), seq = jax.lax.scan(
+                sub, (tokens, positions, k_pages, v_pages), None, length=K)
+            return seq.T, kp, vp  # [B,K]
+
+        return jax.jit(propose, donate_argnums=(1, 2))
+
+    # -------------------------------------------------------- interface
+
+    def on_install(self, engine, slot_idx: int, request) -> None:
+        """Chunk-prefill the prompt into the slot's draft pages (the
+        target's pages may have come from the prefix cache or chunked
+        prefill — the draft pool always rebuilds from the tokens)."""
+        T = len(request.prompt)
+        C = self.chunk
+        table = self._tables[slot_idx]
+        for c0 in range(0, T, C):
+            toks = request.prompt[c0:c0 + C]
+            padded = np.zeros((C,), np.int32)
+            padded[: len(toks)] = toks
+            self.k_pages, self.v_pages = self._chunk_fn(C)(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(padded), jnp.int32(c0), table)
+
+    def warmup(self, engine) -> None:
+        B = engine.ecfg.max_batch_size
+        C = self.chunk
+        self.k_pages, self.v_pages = self._chunk_fn(C)(
+            self.params, self.k_pages, self.v_pages,
+            jnp.zeros((C,), jnp.int32), jnp.int32(0), self._tables[0])
+        drafts, self.k_pages, self.v_pages = self._propose_fn(
+            self.params, self.k_pages, self.v_pages,
+            jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+            self._tables)
+        np.asarray(drafts)
+
+    def propose(self, engine, tokens, positions
+                ) -> Tuple[jax.Array, np.ndarray]:
+        drafts, self.k_pages, self.v_pages = self._propose_fn(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions), self._tables)
+        n = np.full((engine.ecfg.max_batch_size,), self.k, np.int32)
+        return drafts, n  # drafts stay on device: verify concats there
+
+
+# ---------------------------------------------------------------------------
+# The decoder
+# ---------------------------------------------------------------------------
+
+
+class SpecDecoder:
+    """Owns the proposer, the jitted verify forward (accept/commit on
+    device — the readback is [B,S] committed tokens + [B] counts), and
+    the acceptance accounting. The engine drives it from step()."""
+
+    def __init__(self, engine, spec: SpeculationConfig, draft_params=None):
+        self.engine = engine
+        self.spec = spec
+        self.k = spec.num_speculative_tokens
+        if spec.mode == "ngram":
+            self.proposer = NGramProposer(spec)
+        elif spec.mode == "draft":
+            self.proposer = DraftModelProposer(engine, spec, draft_params)
+        else:
+            raise ValueError(f"speculation mode {spec.mode!r} is not a "
+                             "proposer mode")
+        self._verify = self._build_verify()
+        self.proposed_total = 0
+        self.accepted_total = 0
+
+    def _build_verify(self):
+        """Jit the span forward: embed the S=k+1 fed tokens, write their
+        KV at positions p..p+n_draft (rows past a slot's draft count go
+        to the trash page), attend with the span kernel, and run
+        accept/commit on device."""
+        eng = self.engine
+        cfg = eng.cfg
+        ps = eng.ecfg.page_size
+        S = self.k + 1
+        tp_mesh = eng.mesh if eng._tp > 1 else None
+
+        def verify(params, k_pages, v_pages, tokens, positions, page_tables,
+                   n_draft, temps, top_ps, top_ks, key, advanced=False):
+            """tokens [B,S]; positions/n_draft/temps/... [B]."""
+            dtype = jnp.dtype(cfg.dtype)
+            B = tokens.shape[0]
+            x = _embed_lookup(params["embed"], tokens, dtype, mesh=eng.mesh)
+            pos2d = positions[:, None] + jnp.arange(S)[None, :]  # [B,S]
+            if cfg.positional == "learned":
+                x = x + params["pos_emb"][pos2d].astype(dtype)
+                rope_tables = None
+            else:
+                rope_tables = rope_frequencies(
+                    cfg.hdim, cfg.max_seq_len, cfg.rope_theta)
+            row_valid = jnp.arange(S)[None, :] <= n_draft[:, None]
+            page_idx = jnp.where(
+                row_valid,
+                page_tables[jnp.arange(B)[:, None], pos2d // ps], 0)
+            slot_idx = pos2d % ps
+
+            def body(carry, xs):
+                x = carry
+                lp, kp, vp = xs
+                h = _norm(x, lp["ln1"], lp.get("ln1_b"), cfg)
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dtype))
+                k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dtype))
+                v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dtype))
+                if cfg.positional == "rope":
+                    cos, sin = rope_tables
+                    q = apply_rope(q, cos, sin, pos2d)
+                    k = apply_rope(k, cos, sin, pos2d)
+                kp = kp.at[:, page_idx, slot_idx].set(
+                    k.transpose(2, 0, 1, 3).astype(kp.dtype))
+                vp = vp.at[:, page_idx, slot_idx].set(
+                    v.transpose(2, 0, 1, 3).astype(vp.dtype))
+                o = paged_attention_verify(
+                    q, kp, vp, page_tables, positions, mesh=tp_mesh)
+                o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dtype))
+                x = x + o
+                h = _norm(x, lp["ln2"], lp.get("ln2_b"), cfg)
+                if cfg.is_moe:
+                    y, _ = _moe_ffn(h, lp, cfg)
+                else:
+                    y = _dense_ffn(h, lp, cfg)
+                return x + y, (kp, vp)
+
+            x, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], k_pages, v_pages))
+            x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+                      cfg)
+            head = (params["embed"].T if cfg.tie_embeddings
+                    else params["lm_head"])
+            logits = jnp.einsum(
+                "bsd,dv->bsv", x.astype(jnp.float32),
+                head.astype(jnp.float32))
+            if cfg.logits_softcap:
+                logits = cfg.logits_softcap * jnp.tanh(
+                    logits / cfg.logits_softcap)
+            committed, n_comm = _accept_commit(
+                logits, tokens, n_draft, temps, top_ps, top_ks, key,
+                advanced)
+            return committed, n_comm, new_k, new_v
+
+        cache: Dict[bool, Any] = {}
+
+        def for_mode(advanced: bool):
+            if advanced not in cache:
+                cache[advanced] = eng._under_mesh(jax.jit(
+                    functools.partial(verify, advanced=advanced),
+                    donate_argnums=(1, 2)))
+            return cache[advanced]
+
+        return for_mode
+
+    # -------------------------------------------------------- engine API
+
+    def on_install(self, slot_idx: int, request) -> None:
+        self.proposer.on_install(self.engine, slot_idx, request)
+
+    def warmup(self) -> None:
+        eng = self.engine
+        self.proposer.warmup(eng)
+        B = eng.ecfg.max_batch_size
+        pps = eng.ecfg.pages_per_seq
+        S = self.k + 1
+        for advanced in (False, True):
+            committed, _, eng.k_pages, eng.v_pages = self._verify(advanced)(
+                eng.params, eng.k_pages, eng.v_pages,
+                jnp.zeros((B, S), jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B, pps), jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32), jax.random.PRNGKey(0))
+            np.asarray(committed)
+
+    def run_step(self, tokens, positions, tables, caps, temps, top_ps,
+                 top_ks, advanced, key):
+        """One speculative round over the built batch arrays. caps [B] is
+        the per-slot draft cap (min of k, remaining budget - 1, sequence
+        room; 0 for inactive slots). Returns committed [B,S] np,
+        n_committed [B] np, n_draft [B] np, and per-phase wall times."""
+        eng = self.engine
+        t0 = time.monotonic()
+        drafts, n_prop = self.proposer.propose(eng, tokens, positions)
+        n_draft = np.minimum(n_prop, caps).astype(np.int32)
+        if isinstance(drafts, np.ndarray):
+            toks_bs = jnp.asarray(
+                np.concatenate([tokens[:, None], drafts], axis=1))
+        else:
+            toks_bs = jnp.concatenate(
+                [jnp.asarray(tokens)[:, None], drafts], axis=1)
+        t1 = time.monotonic()
+        committed, n_comm, eng.k_pages, eng.v_pages = self._verify(advanced)(
+            eng.params, eng.k_pages, eng.v_pages, toks_bs,
+            jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(n_draft), jnp.asarray(temps),
+            jnp.asarray(top_ps), jnp.asarray(top_ks), key)
+        t2 = time.monotonic()
+        committed = np.asarray(committed)
+        n_comm = np.asarray(n_comm)
+        t3 = time.monotonic()
+        return committed, n_comm, n_draft, {
+            "propose": t1 - t0, "verify": t2 - t1, "sample": t3 - t2}
+
+    def record(self, proposed: int, accepted: int) -> None:
+        self.proposed_total += int(proposed)
+        self.accepted_total += int(accepted)
+        if proposed:
+            _m_spec_proposed.inc(proposed)
+            if accepted:
+                _m_spec_accepted.inc(accepted)
+        if self.proposed_total:
+            _m_spec_accept_rate.set(
+                self.accepted_total / self.proposed_total)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "spec_mode": self.spec.mode,
+            "spec_num_speculative_tokens": self.k,
+            "spec_proposed_tokens": self.proposed_total,
+            "spec_accepted_tokens": self.accepted_total,
+            "spec_acceptance_rate": (
+                self.accepted_total / self.proposed_total
+                if self.proposed_total else 0.0),
+        }
