@@ -35,7 +35,12 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all tsan shm
+.PHONY: check check-slow check-all tsan shm bench-data
+
+# quick data-plane iteration loop: just the data + images bench suites
+# (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
+bench-data:
+	env RAY_TPU_BENCH_SUITE=data,images python bench.py
 
 shm:
 	$(MAKE) -C ray_tpu/core/_shm

@@ -76,11 +76,21 @@ def _emit(metric: str, value: float, unit: str, anchor_key: str,
 
 def _write_summary() -> None:
     """One complete {metric: value} artifact per run (plus run metadata),
-    next to bench.py."""
+    next to bench.py. Merges over the previous artifact's metrics so a
+    partial-suite run (e.g. RAY_TPU_BENCH_SUITE=data,images) updates its
+    own rows without dropping the serve/train rows — the whole fleet's
+    trajectory stays one committed file per round."""
     import jax
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_SUMMARY.json")
+    metrics: dict = {}
+    try:
+        with open(path) as f:
+            metrics = dict(json.load(f).get("metrics", {}))
+    except Exception:
+        pass
+    metrics.update(_SUMMARY)
     doc = {
         "meta": {
             "suite": os.environ.get(
@@ -90,12 +100,13 @@ def _write_summary() -> None:
             "spec_bench": os.environ.get("RAY_TPU_BENCH_SPEC", "0"),
             "finished_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         },
-        "metrics": dict(sorted(_SUMMARY.items())),
+        "metrics": dict(sorted(metrics.items())),
     }
     with open(path, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
-    print(f"# wrote {path} ({len(_SUMMARY)} metrics)", file=sys.stderr)
+    print(f"# wrote {path} ({len(_SUMMARY)} new / {len(metrics)} total "
+          "metrics)", file=sys.stderr)
 
 
 def _serve_burst(engine, prompts, max_tokens):
@@ -258,11 +269,14 @@ def bench_data() -> None:
         x = batch["id"].astype(np.float32)
         return {"x": np.sqrt(x + 1.0), "y": x * 0.5}
 
+    # training ingest is order-free: opt into out-of-order streaming +
+    # the threaded host-prefetch stage (the data-plane overlap path)
     ds = rd.range(n_rows, parallelism=32).map_batches(transform)
-    it = ds.iter_batches(batch_size=batch_size)
+    it = iter(ds.iter_batches(batch_size=batch_size, preserve_order=False,
+                              prefetch_batches=2))
     # prime the pipeline with the first batch (startup, not steady-state)
     next(it)
-    wait, steps, t_loop = 0.0, 0, time.perf_counter()
+    wait, steps, rows, t_loop = 0.0, 0, batch_size, time.perf_counter()
     while True:
         t0 = time.perf_counter()
         try:
@@ -271,6 +285,7 @@ def bench_data() -> None:
             break
         wait += time.perf_counter() - t0
         assert len(batch["x"]) > 0
+        rows += len(batch["x"])
         steps += 1
         time.sleep(step_s)  # simulated accelerator step
     total = time.perf_counter() - t_loop
@@ -287,6 +302,7 @@ def bench_data() -> None:
     )
     _emit("data_pipeline_stall_pct", stall_pct, "%", "data_anchor",
           lower_is_better=True)
+    _emit("data_rows_per_sec", rows / total, "rows/s", "data_rows_anchor")
 
 
 def bench_train(model=None, batch=None, seq=None, steps=None, span=None,
@@ -418,10 +434,14 @@ def bench_images() -> None:
         Image.fromarray(arr).save(os.path.join(img_dir, f"im_{i:05d}.jpg"),
                                   quality=85)
 
+    # image ingest is order-free: out-of-order streaming (a slow shard
+    # can't head-of-line block sealed blocks from its peers) + threaded
+    # host assembly overlapping the simulated step
     ds = rd.read_images(img_dir, size=(224, 224), files_per_block=64,
                         parallelism=8).map_batches(
         lambda b: {"x": b["image"].astype(np.float32) / 255.0})
-    it = ds.iter_batches(batch_size=batch_size)
+    it = iter(ds.iter_batches(batch_size=batch_size, preserve_order=False,
+                              prefetch_batches=2))
     next(it)  # prime (startup, not steady state)
     wait, images, t_loop = 0.0, batch_size, time.perf_counter()
     while True:

@@ -184,6 +184,11 @@ class ObjectRefGenerator:
     num_returns="streaming"). A producer error raises HERE, after every
     item produced before the failure has been yielded."""
 
+    # try_next() sentinel: the stream is exhausted (distinct from None =
+    # "nothing sealed yet"); a sentinel rather than StopIteration so
+    # callers inside generator bodies don't trip PEP 479
+    DONE = object()
+
     def __init__(self, runtime: "Runtime", task_id: TaskID, record: _StreamRecord):
         self._runtime = runtime
         self.task_id = task_id
@@ -206,6 +211,25 @@ class ObjectRefGenerator:
                         raise rec.error
                     raise StopIteration
                 rec.cv.wait(timeout=1.0)
+
+    def try_next(self):
+        """Non-blocking poll: the next sealed ref, None while the producer
+        is still working on the next one, or ObjectRefGenerator.DONE once
+        the stream is exhausted (raising the producer's error first, after
+        every ref sealed before the failure has been handed out). Lets a
+        multiplexing consumer drain whichever of several streams has data
+        without parking on any single one."""
+        rec = self._record
+        with rec.cv:
+            if self._idx < len(rec.refs):
+                ref = rec.refs[self._idx]
+                self._idx += 1
+                return ref
+            if rec.done:
+                if rec.error is not None:
+                    raise rec.error
+                return ObjectRefGenerator.DONE
+            return None
 
     def completed(self) -> bool:
         return self._record.done

@@ -133,23 +133,31 @@ class Dataset:
 
     # -- execution -----------------------------------------------------------
 
-    def _stream_refs(self) -> Iterator[Any]:
-        return StreamingExecutor(self._plan).execute()
+    def _stream_refs(self, preserve_order: bool = True) -> Iterator[Any]:
+        return StreamingExecutor(
+            self._plan, preserve_order=preserve_order).execute()
 
-    def iterator(self) -> DataIterator:
-        return DataIterator(self._stream_refs)
+    def iterator(self, *, preserve_order: bool = True) -> DataIterator:
+        """preserve_order=False lets every streaming stage yield blocks in
+        completion order (no head-of-line blocking on a slow block) — the
+        epoch's row multiset is unchanged but the order is not
+        deterministic. Default stays strictly ordered."""
+        return DataIterator(
+            lambda: self._stream_refs(preserve_order=preserve_order))
 
-    def iter_batches(self, **kw) -> Iterator[Any]:
-        return self.iterator().iter_batches(**kw)
+    def iter_batches(self, *, preserve_order: bool = True, **kw) -> Iterator[Any]:
+        return self.iterator(preserve_order=preserve_order).iter_batches(**kw)
 
     def iter_rows(self) -> Iterator[Any]:
         return self.iterator().iter_rows()
 
-    def iter_torch_batches(self, **kw) -> Iterator[Any]:
-        return self.iterator().iter_torch_batches(**kw)
+    def iter_torch_batches(self, *, preserve_order: bool = True, **kw) -> Iterator[Any]:
+        return self.iterator(
+            preserve_order=preserve_order).iter_torch_batches(**kw)
 
-    def iter_device_batches(self, **kw) -> Iterator[Any]:
-        return self.iterator().iter_device_batches(**kw)
+    def iter_device_batches(self, *, preserve_order: bool = True, **kw) -> Iterator[Any]:
+        return self.iterator(
+            preserve_order=preserve_order).iter_device_batches(**kw)
 
     def take(self, n: int = 20) -> List[Any]:
         if n <= 0:

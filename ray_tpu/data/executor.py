@@ -11,12 +11,14 @@ TPU cares about).
 from __future__ import annotations
 
 import random
-from typing import Any, Callable, Iterator, List, Optional
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
 from .. import api
 from ..core.logging import get_logger
+from ..core.metrics import Counter, Gauge
 from .block import Block, BlockAccessor
 from .aggregate import finalize, merge_partials, partial_aggregate
 from .logical import (
@@ -42,56 +44,160 @@ DEFAULT_MAX_IN_FLIGHT = 16
 # (reference: execution/resource_manager.py per-op memory backpressure)
 DEFAULT_MAX_IN_FLIGHT_BYTES = 256 << 20
 
-
-def _ready_info(refs: List[Any]):
-    """-> (ready_bytes, n_ready): size and count of completed-but-
-    unconsumed results among `refs` (block metadata from the object
-    plane)."""
-    if not refs:
-        return 0, 0
-    from ..core import core_worker as _cw
-
-    try:
-        rt = _cw.get_runtime()
-    except RuntimeError:
-        return 0, 0
-    done, _ = api.wait(list(refs), num_returns=len(refs), timeout=0)
-    total = 0
-    for ref in done:
-        for nid in rt.directory.locations(ref.object_id):
-            agent = rt.agents.get(nid)
-            store = getattr(agent, "store", None)
-            n = store.nbytes_of(ref.object_id) if hasattr(store, "nbytes_of") else None
-            if n is not None:
-                total += n
-                break
-    return total, len(done)
+# data-plane observability (north star: the stall must be visible on a
+# scrape, not just benchable): stall seconds accumulate wherever the
+# plane blocks waiting for upstream work, tagged by stage
+_m_stall = Counter(
+    "data_stage_stall_seconds",
+    "Seconds a data-plane stage spent blocked waiting on upstream blocks.",
+)
+_m_in_flight = Gauge(
+    "data_blocks_in_flight",
+    "Submitted-but-unconsumed blocks per streaming stage.",
+)
+_m_parked = Gauge(
+    "data_bytes_parked",
+    "Bytes of completed-but-unconsumed block output per streaming stage.",
+)
 
 
-class _ByteBudget:
-    """Per-stage memory gate (reference: resource_manager.py per-op
-    budgets): admits a new submission only while parked output bytes plus
-    the PROJECTED bytes of still-running tasks (running average of
-    completed output sizes) stay under the budget. Before any output size
-    is known, the in-flight warmup is capped so the first burst can't
-    blow the budget either."""
+def _nbytes_of(rt, ref) -> Optional[int]:
+    for nid in rt.directory.locations(ref.object_id):
+        agent = rt.agents.get(nid)
+        store = getattr(agent, "store", None)
+        n = store.nbytes_of(ref.object_id) if hasattr(store, "nbytes_of") else None
+        if n is not None:
+            return n
+    return None
+
+
+class _StageWindow:
+    """Submitted-but-unconsumed refs of one streaming stage.
+
+    Owns three concerns the old per-check full re-poll conflated:
+
+    - incremental completion tracking: each ref is polled only until it
+      completes (one api.wait over the still-running subset), and its
+      output size is looked up ONCE and cached — not api.wait + a
+      directory/store walk over the whole pending list on every admission
+      check;
+    - the per-stage memory gate (reference: resource_manager.py per-op
+      budgets): admits a new submission only while parked output bytes
+      plus the PROJECTED bytes of still-running tasks (running average of
+      completed output sizes) stay under the budget, with a capped
+      warmup before any size is known;
+    - completion-order pops for out-of-order yield, plus per-owner
+      outstanding counts for least-outstanding actor-pool dispatch (an
+      owner stays charged for work the consumer already took until that
+      work actually finishes).
+    """
 
     WARMUP_INFLIGHT = 4
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, name: str = "stage"):
         self.budget = budget_bytes
-        self._avg = None
+        self.name = name
+        self._avg: Optional[float] = None
+        self._order: List[Any] = []       # submission order, popped FIFO
+        self._running: List[Any] = []     # submitted, not yet known-complete
+        self._ready_ids: set = set()      # complete, not yet popped
+        self._ready_bytes = 0
+        self._sizes: Dict[Any, int] = {}  # oid -> bytes (parked refs only)
+        self._owner: Dict[Any, Any] = {}  # oid -> owner key
+        self.outstanding: Dict[Any, int] = {}  # owner -> incomplete count
+        # popped while still running: tracked only for owner accounting
+        self._detached: List[Any] = []
 
-    def may_submit(self, pending: List[Any]) -> bool:
-        ready_bytes, n_ready = _ready_info(pending)
-        inflight = len(pending) - n_ready
-        if n_ready:
-            # always refresh from what is parked NOW: a frozen early
-            # average (small header blocks) would under-project forever
-            self._avg = ready_bytes / n_ready
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def add(self, ref: Any, owner: Any = None) -> None:
+        self._order.append(ref)
+        self._running.append(ref)
+        if owner is not None:
+            self._owner[ref.object_id] = owner
+            self.outstanding[owner] = self.outstanding.get(owner, 0) + 1
+
+    def _on_complete(self, ref: Any, detached: bool) -> None:
+        owner = self._owner.pop(ref.object_id, None)
+        if owner is not None:
+            self.outstanding[owner] -= 1
+        if detached:
+            return
+        self._ready_ids.add(ref.object_id)
+        from ..core import core_worker as _cw
+
+        try:
+            n = _nbytes_of(_cw.get_runtime(), ref)
+        except RuntimeError:
+            n = None
+        self._sizes[ref.object_id] = n or 0
+        self._ready_bytes += n or 0
+
+    def poll(self, timeout: float = 0) -> None:
+        """Fold newly-completed refs into the parked set; one wait over
+        only the still-running refs (plus detached ones for owner
+        bookkeeping)."""
+        polled = self._running + self._detached
+        if polled:
+            done, _ = api.wait(polled, num_returns=len(polled),
+                               timeout=timeout)
+            done_ids = {r.object_id for r in done}
+            if done_ids:
+                for ref in [r for r in self._running
+                            if r.object_id in done_ids]:
+                    self._running.remove(ref)
+                    self._on_complete(ref, detached=False)
+                for ref in [r for r in self._detached
+                            if r.object_id in done_ids]:
+                    self._detached.remove(ref)
+                    self._on_complete(ref, detached=True)
+        if self._ready_ids:
+            # refresh from what is parked NOW: a frozen early average
+            # (small header blocks) would under-project forever
+            self._avg = self._ready_bytes / len(self._ready_ids)
+        tags = {"stage": self.name}
+        _m_in_flight.set(len(self._order), tags=tags)
+        _m_parked.set(self._ready_bytes, tags=tags)
+
+    def may_submit(self) -> bool:
+        self.poll()
         if self._avg is None:
-            return inflight < self.WARMUP_INFLIGHT
-        return ready_bytes + inflight * self._avg < self.budget
+            return len(self._running) < self.WARMUP_INFLIGHT
+        return self._ready_bytes + len(self._running) * self._avg < self.budget
+
+    def _forget(self, ref: Any) -> Any:
+        self._order.remove(ref)
+        if ref.object_id in self._ready_ids:
+            self._ready_ids.discard(ref.object_id)
+            self._ready_bytes -= self._sizes.pop(ref.object_id, 0)
+        elif ref in self._running:
+            # yielded before completion (ordered head-of-line): keep
+            # watching it so its owner's outstanding count stays honest
+            self._running.remove(ref)
+            if ref.object_id in self._owner:
+                self._detached.append(ref)
+        return ref
+
+    def pop(self, ordered: bool) -> Any:
+        """Next ref for the consumer: submission order when `ordered`
+        (may still be running — the consumer's get blocks, exactly the old
+        behavior), else whichever completed first, blocking only when
+        nothing has finished yet (the stall that makes is the metric)."""
+        self.poll()
+        if ordered:
+            return self._forget(self._order[0])
+        for ref in self._order:
+            if ref.object_id in self._ready_ids:
+                return self._forget(ref)
+        t0 = time.perf_counter()
+        api.wait(self._running, num_returns=1, timeout=None)
+        _m_stall.inc(time.perf_counter() - t0, tags={"stage": self.name})
+        self.poll()
+        for ref in self._order:
+            if ref.object_id in self._ready_ids:
+                return self._forget(ref)
+        return self._forget(self._order[0])  # unreachable safety net
 
 
 @api.remote
@@ -193,7 +299,12 @@ def _zip_blocks(left: Block, right: Block) -> Block:
         raise TypeError("zip needs tabular blocks on both sides")
     out = {k: np.asarray(v) for k, v in left.items()}
     for k, v in right.items():
-        name = k if k not in out else f"{k}_1"  # reference disambiguation
+        # reference disambiguation, probing for a free suffix: "x_1" can
+        # itself exist on the left (or from an earlier rename)
+        name, i = k, 0
+        while name in out:
+            i += 1
+            name = f"{k}_{i}"
         out[name] = np.asarray(v)
     return out
 
@@ -204,12 +315,18 @@ def _block_meta(block: Block):
     return (m.num_rows, m.size_bytes, m.schema)
 
 
-def _windowed_gen(read_tasks: List[Callable], max_in_flight: int) -> Iterator[Any]:
-    """Submit read tasks with a bounded window; yield one REF ITERATOR per
-    task, in order. Tasks marked ``.streaming`` (generators of blocks) run
-    as streaming-generator tasks — their refs surface while the task still
-    executes; plain tasks take the ordinary path (worker-process pool,
-    retries)."""
+def _windowed_gen(read_tasks: List[Callable], max_in_flight: int,
+                  preserve_order: bool = True) -> Iterator[Any]:
+    """Submit read tasks with a bounded window; yield block REFS. Tasks
+    marked ``.streaming`` (generators of blocks) run as streaming-
+    generator tasks — their refs surface while the task still executes;
+    plain tasks take the ordinary path (worker-process pool, retries).
+
+    Ordered (default): task 0's blocks, then task 1's, ... — a slow task
+    0 head-of-line blocks the stream even while peers have sealed output.
+    preserve_order=False yields blocks in COMPLETION order across every
+    in-flight task: a sealed block from any task surfaces immediately."""
+    from ..core.core_worker import ObjectRefGenerator
 
     def submit(t):
         if getattr(t, "streaming", False):
@@ -218,22 +335,73 @@ def _windowed_gen(read_tasks: List[Callable], max_in_flight: int) -> Iterator[An
 
     pending: List[Any] = []
     idx = 0
-    while idx < len(read_tasks) or pending:
-        while idx < len(read_tasks) and len(pending) < max_in_flight:
-            pending.append(submit(read_tasks[idx]))
+    if preserve_order:
+        while idx < len(read_tasks) or pending:
+            while idx < len(read_tasks) and len(pending) < max_in_flight:
+                pending.append(submit(read_tasks[idx]))
+                idx += 1
+            yield from pending.pop(0)
+        return
+
+    # out-of-order: multiplex every in-flight source; streaming sources
+    # are drained via the non-blocking try_next, plain single-ref tasks
+    # surface once api.wait reports them done
+    gens: List[Any] = []
+    plain: List[Any] = []
+    while idx < len(read_tasks) or gens or plain:
+        while idx < len(read_tasks) and len(gens) + len(plain) < max_in_flight:
+            src = submit(read_tasks[idx])
             idx += 1
-        yield pending.pop(0)
+            if isinstance(src, list):
+                plain.extend(src)
+            else:
+                gens.append(src)
+        progressed = False
+        for g in list(gens):
+            while True:
+                ref = g.try_next()
+                if ref is None:
+                    break
+                if ref is ObjectRefGenerator.DONE:
+                    gens.remove(g)
+                    break
+                progressed = True
+                yield ref
+        if plain:
+            done, plain = api.wait(plain, num_returns=len(plain), timeout=0)
+            for ref in done:
+                progressed = True
+                yield ref
+        if not progressed and (gens or plain):
+            # nothing sealed anywhere: the read genuinely is the
+            # bottleneck right now — account the stall, then nap briefly
+            # (generator seals have no waitable handle; plain refs do)
+            t0 = time.perf_counter()
+            if plain:
+                api.wait(plain, num_returns=1, timeout=0.02)
+            else:
+                time.sleep(0.002)
+            _m_stall.inc(time.perf_counter() - t0, tags={"stage": "read"})
 
 
 class StreamingExecutor:
-    """Executes a LogicalPlan, yielding block ObjectRefs."""
+    """Executes a LogicalPlan, yielding block ObjectRefs.
+
+    preserve_order=True (default) keeps the reference's strict block
+    order — byte-identical streams for existing consumers. Training-
+    ingest callers that only need the epoch's multiset opt into
+    preserve_order=False: every streaming stage (read, task map, actor-
+    pool map) then yields blocks in COMPLETION order, so one slow block
+    can't head-of-line block work that already finished."""
 
     def __init__(self, plan: LogicalPlan, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
                  max_in_flight_bytes: int = DEFAULT_MAX_IN_FLIGHT_BYTES,
+                 preserve_order: bool = True,
                  _protected: Optional[set] = None):
         self.plan = plan
         self.max_in_flight = max_in_flight
         self.max_in_flight_bytes = max_in_flight_bytes
+        self.preserve_order = preserve_order
         # ObjectIDs the PLAN owns (InputData blocks, incl. Union sub-plans):
         # re-iteration resolves them again, so eager frees (shuffle rounds)
         # must never touch them. Shared with sub-executors.
@@ -244,13 +412,11 @@ class StreamingExecutor:
         source = segments[0]
 
         if isinstance(source, Read):
-            def gen():
-                # generator-valued read tasks stream their blocks out
-                # incrementally; plain tasks go through the ordinary task
-                # path (worker-process pool, retries)
-                for t in _windowed_gen(source.read_tasks, self.max_in_flight):
-                    yield from t
-            stream: Iterator[Any] = gen()
+            # generator-valued read tasks stream their blocks out
+            # incrementally; plain tasks go through the ordinary task
+            # path (worker-process pool, retries)
+            stream: Iterator[Any] = _windowed_gen(
+                source.read_tasks, self.max_in_flight, self.preserve_order)
         elif isinstance(source, InputData):
             self._protected.update(r.object_id for r in source.blocks)
             stream = iter(list(source.blocks))
@@ -260,6 +426,7 @@ class StreamingExecutor:
                     yield from StreamingExecutor(
                         plan, self.max_in_flight,
                         self.max_in_flight_bytes,
+                        preserve_order=self.preserve_order,
                         _protected=self._protected).execute()
             stream = gen_union()
         else:
@@ -324,32 +491,35 @@ class StreamingExecutor:
 
     def _map_stream(self, upstream: Iterator[Any], stage) -> Iterator[Any]:
         def gen():
-            budget = _ByteBudget(self.max_in_flight_bytes)
-            pending: List[Any] = []
+            win = _StageWindow(self.max_in_flight_bytes,
+                               name=getattr(stage, "__name__", "map"))
             exhausted = False
             it = iter(upstream)
-            while not exhausted or pending:
+            while not exhausted or len(win):
                 while (
                     not exhausted
-                    and len(pending) < self.max_in_flight
+                    and len(win) < self.max_in_flight
                     # memory backpressure: parked + projected in-flight
                     # output bytes must stay under the stage budget
-                    and budget.may_submit(pending)
+                    and win.may_submit()
                 ):
                     try:
                         ref = next(it)
                     except StopIteration:
                         exhausted = True
                         break
-                    pending.append(_run_stage.remote(stage, ref))
-                if pending:
-                    yield pending.pop(0)
+                    win.add(_run_stage.remote(stage, ref))
+                if len(win):
+                    yield win.pop(self.preserve_order)
         return gen()
 
     def _map_stream_actors(self, upstream: Iterator[Any], op) -> Iterator[Any]:
         """map_batches(compute="actors"): the stage runs on a pool of
         stateful workers — a callable-class fn instantiates ONCE per
-        worker (model loads amortize across its blocks). Ordered output;
+        worker (model loads amortize across its blocks). Blocks dispatch
+        to the worker with the fewest incomplete applies (least-
+        outstanding), so a slow worker can't accumulate a private queue
+        while its peers idle; ordered output unless preserve_order=False;
         same count + byte backpressure as the task path. (reference:
         execution/operators/actor_pool_map_operator.py)"""
         import cloudpickle
@@ -361,28 +531,26 @@ class StreamingExecutor:
                 _MapPoolWorker.remote(op_blob)
                 for _ in range(max(1, op.concurrency))
             ]
-            budget = _ByteBudget(self.max_in_flight_bytes)
+            win = _StageWindow(self.max_in_flight_bytes, name=op.name)
             try:
-                pending: List[Any] = []
                 exhausted = False
                 it = iter(upstream)
-                i = 0
-                while not exhausted or pending:
+                while not exhausted or len(win):
                     while (
                         not exhausted
-                        and len(pending) < self.max_in_flight
-                        and budget.may_submit(pending)
+                        and len(win) < self.max_in_flight
+                        and win.may_submit()
                     ):
                         try:
                             ref = next(it)
                         except StopIteration:
                             exhausted = True
                             break
-                        worker = workers[i % len(workers)]
-                        i += 1
-                        pending.append(worker.apply.remote(ref))
-                    if pending:
-                        yield pending.pop(0)
+                        wi = min(range(len(workers)),
+                                 key=lambda j: win.outstanding.get(j, 0))
+                        win.add(workers[wi].apply.remote(ref), owner=wi)
+                    if len(win):
+                        yield win.pop(self.preserve_order)
             finally:
                 # FIFO ping barrier: yielded-but-unfinished applies must
                 # complete before their worker dies

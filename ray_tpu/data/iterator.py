@@ -1,21 +1,85 @@
-"""DataIterator: batch iteration + double-buffered HBM prefetch.
+"""DataIterator: batch iteration + threaded host prefetch + double-
+buffered HBM prefetch.
 
 Reference: `python/ray/data/iterator.py :: DataIterator.iter_batches` /
-`iter_torch_batches`. The TPU-native part is `iter_device_batches`: host
-batches are `jax.device_put` one step ahead of consumption (double
-buffering), optionally sharded straight onto a mesh — the device never
-waits on the input pipeline.
+`iter_torch_batches`. Host-side batch assembly (`api.get`, block concat,
+the user transform) runs on a bounded background thread — the prefetch
+stage — so it overlaps the consumer's device compute; the TPU-native part
+is `iter_device_batches`: host batches are `jax.device_put` one step
+ahead of consumption (double buffering) on the consumer side, optionally
+sharded straight onto a mesh — the device never waits on the input
+pipeline.
 """
 
 from __future__ import annotations
 
 import collections
+import queue as _queue
+import threading
+import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
 from .. import api
 from .block import BlockAccessor
+from .executor import _m_stall
+
+
+def _iter_in_background(make_iter: Callable[[], Iterator[Any]], depth: int,
+                        stage: str = "host_prefetch") -> Iterator[Any]:
+    """Run `make_iter()` on a daemon thread, handing items through a
+    queue bounded at `depth` (the producer runs at most `depth` items
+    ahead). Producer exceptions re-raise at the consumer's next pull;
+    abandoning the returned generator (break mid-epoch, GC) stops the
+    producer instead of leaking the thread. Consumer-side blocking time
+    accumulates into data_stage_stall_seconds{stage=...}."""
+    done = object()
+    q: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for item in make_iter():
+                if not put((None, item)):
+                    return
+            put((done, None))
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            put((e, None))
+
+    t = threading.Thread(target=run, daemon=True, name="data-host-prefetch")
+    t.start()
+
+    def gen():
+        try:
+            while True:
+                t0 = time.perf_counter()
+                kind, item = q.get()
+                _m_stall.inc(time.perf_counter() - t0, tags={"stage": stage})
+                if kind is done:
+                    return
+                if kind is not None:
+                    raise kind
+                yield item
+        finally:
+            stop.set()
+            try:  # unblock a producer parked on a full queue
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+            t.join(timeout=1.0)
+
+    return gen()
 
 
 class DataIterator:
@@ -42,8 +106,42 @@ class DataIterator:
         drop_last: bool = False,
         local_shuffle_buffer_size: Optional[int] = None,
         local_shuffle_seed: Optional[int] = None,
+        prefetch_batches: int = 1,
     ) -> Iterator[Any]:
-        """Re-chunk the block stream into exact-size batches."""
+        """Re-chunk the block stream into exact-size batches.
+
+        prefetch_batches > 0 moves batch assembly (`api.get`, block
+        concat, re-chunking) onto a bounded background thread running
+        that many batches ahead, so host assembly overlaps the caller's
+        step; the batch sequence is identical either way. 0 assembles
+        inline on the calling thread."""
+        if prefetch_batches and prefetch_batches > 0:
+            return _iter_in_background(
+                lambda: self._iter_batches_inline(
+                    batch_size=batch_size,
+                    batch_format=batch_format,
+                    drop_last=drop_last,
+                    local_shuffle_buffer_size=local_shuffle_buffer_size,
+                    local_shuffle_seed=local_shuffle_seed,
+                ),
+                prefetch_batches,
+            )
+        return self._iter_batches_inline(
+            batch_size=batch_size,
+            batch_format=batch_format,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed,
+        )
+
+    def _iter_batches_inline(
+        self,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
         rng = np.random.default_rng(local_shuffle_seed)
         buf: list = []
         buffered_rows = 0
@@ -144,23 +242,41 @@ class DataIterator:
         prefetch: int = 2,
         drop_last: bool = True,
         transform: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None,
+        host_prefetch_batches: int = 2,
     ) -> Iterator[Any]:
         """Host batches -> HBM, `prefetch` steps ahead of the consumer.
+
+        The host stage (`api.get`, block concat, the user `transform`)
+        runs `host_prefetch_batches` deep on a background thread; the
+        consumer side only dispatches `device_put` (async) and keeps the
+        `prefetch`-deep HBM double buffer — so decode, batch assembly,
+        and H2D transfer all overlap device compute. 0 assembles inline.
 
         sharding: a jax Sharding (or pytree of) for device_put — pass the
         gang mesh batch sharding for SPMD ingestion.
         """
         import jax
 
+        def host_iter():
+            for batch in self._iter_batches_inline(
+                    batch_size=batch_size, drop_last=drop_last):
+                # user transform belongs to the host stage: it runs on
+                # the prefetch thread, not the consumer thread
+                yield transform(batch) if transform is not None else batch
+
+        if host_prefetch_batches and host_prefetch_batches > 0:
+            host_batches: Iterator[Any] = _iter_in_background(
+                host_iter, host_prefetch_batches)
+        else:
+            host_batches = host_iter()
+
         def put(batch):
-            if transform is not None:
-                batch = transform(batch)
             if sharding is None:
                 return jax.tree.map(jax.numpy.asarray, batch)
             return jax.device_put(batch, sharding)
 
         window: collections.deque = collections.deque()
-        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+        for batch in host_batches:
             window.append(put(batch))  # async dispatch; no host block
             if len(window) > prefetch:
                 yield window.popleft()

@@ -262,6 +262,15 @@ class TestUnionZip:
         rows = a.zip(b).take_all()
         assert all(int(r["x_1"]) == int(r["x"]) + 1 for r in rows)
 
+    def test_zip_suffix_probes_past_taken_names(self, ray_start_regular):
+        # "x_1" already exists on the left, so the right side's "x" must
+        # probe on to the first FREE suffix ("x_2"), not clobber "x_1"
+        a = data.from_numpy({"x": np.arange(4), "x_1": np.arange(4) * 2})
+        b = data.from_numpy({"x": np.arange(4) + 7})
+        rows = a.zip(b).take_all()
+        assert all(int(r["x_1"]) == int(r["x"]) * 2 for r in rows)
+        assert all(int(r["x_2"]) == int(r["x"]) + 7 for r in rows)
+
     def test_zip_length_mismatch_raises(self, ray_start_regular):
         import ray_tpu
 
@@ -511,6 +520,210 @@ class TestBoundedShuffle:
         # everything the shuffle made is gone once nothing references it
         leaked = self._store_bytes(rt) - base
         assert leaked < 200_000, leaked
+
+
+class TestOutOfOrder:
+    """preserve_order=False: completion-order yield across every
+    streaming stage — same multiset, no head-of-line blocking; the
+    default stays strictly ordered (byte-identical streams)."""
+
+    def test_ordered_default_byte_identical(self, ray_start_regular):
+        ds = data.range(200, parallelism=8).map_batches(
+            lambda b: {"id": b["id"]})
+        ids_default = [int(i) for b in ds.iter_batches(batch_size=32)
+                       for i in b["id"]]
+        ids_explicit = [
+            int(i)
+            for b in ds.iter_batches(batch_size=32, preserve_order=True)
+            for i in b["id"]
+        ]
+        assert ids_default == list(range(200))
+        assert ids_explicit == ids_default
+
+    def test_unordered_same_multiset_task_map(self, ray_start_regular):
+        def stagger(b):
+            # early blocks finish LAST: out-of-order yield must still
+            # deliver every row exactly once
+            if int(b["id"][0]) < 100:
+                time.sleep(0.05)
+            return {"id": b["id"]}
+
+        ds = data.range(200, parallelism=8).map_batches(stagger)
+        ids = sorted(
+            int(i)
+            for b in ds.iter_batches(batch_size=25, preserve_order=False)
+            for i in b["id"]
+        )
+        assert ids == list(range(200))
+
+    def test_unordered_actor_pool_multiset(self, ray_start_regular):
+        class Tripler:
+            def __call__(self, batch):
+                return {"y": np.asarray(batch["id"]) * 3}
+
+        ds = data.range(240, parallelism=8).map_batches(
+            Tripler, compute="actors", concurrency=2)
+        ids = sorted(
+            int(v)
+            for b in ds.iter_batches(batch_size=30, preserve_order=False)
+            for v in b["y"]
+        )
+        assert ids == [i * 3 for i in range(240)]
+
+    def test_unordered_streaming_read(self, ray_start_regular, tmp_path):
+        from PIL import Image
+
+        d = tmp_path / "imgs"
+        d.mkdir()
+        rng = np.random.default_rng(1)
+        for i in range(8):
+            arr = rng.integers(0, 255, size=(12, 12, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i}.png")
+        ds = data.read_images(str(d), size=(8, 8), files_per_block=2)
+        batches = list(ds.iter_batches(batch_size=4, preserve_order=False))
+        assert sum(len(b["image"]) for b in batches) == 8
+        assert all(b["image"].shape[1:] == (8, 8, 3) for b in batches)
+
+    def test_unordered_backpressure_bounds_memory(self, ray_start_regular):
+        # mirror of test_slow_consumer_bounds_producer_memory: the count
+        # + byte budget must bound in-flight work in unordered mode too
+        from ray_tpu.data.executor import StreamingExecutor
+
+        block_bytes = 1 << 20
+        n_blocks = 24
+        budget = 4 << 20
+
+        ds = (
+            data.range(n_blocks * 10, parallelism=n_blocks)
+            .map_batches(lambda b: {"x": np.zeros(block_bytes // 8)})
+        )
+        ex = StreamingExecutor(ds._plan, max_in_flight=n_blocks,
+                               max_in_flight_bytes=budget,
+                               preserve_order=False)
+        rt = ray_start_regular
+        peak = 0
+        consumed = 0
+        for ref in ex.execute():
+            time.sleep(0.05)
+            used = sum(
+                a.store._used for a in rt.agents.values()
+                if hasattr(a.store, "_used")
+            )
+            peak = max(peak, used)
+            consumed += len(ray_get(ref)["x"])
+            del ref
+        assert consumed == n_blocks * (block_bytes // 8)
+        assert peak < budget + 8 * block_bytes, f"peak {peak} bytes"
+
+    def test_data_plane_metrics_registered(self, ray_start_regular):
+        from ray_tpu.core.metrics import registry
+
+        # touch the pipeline so per-stage samples exist
+        ds = data.range(64, parallelism=4).map_batches(lambda b: b)
+        list(ds.iter_batches(batch_size=16, preserve_order=False))
+        text = registry.render_prometheus()
+        assert "data_stage_stall_seconds" in text
+        assert "data_blocks_in_flight" in text
+        assert "data_bytes_parked" in text
+
+
+class TestHostPrefetch:
+    """Threaded host-side batch assembly: bounded queue, exception
+    propagation, and no thread leak when the consumer walks away."""
+
+    def test_queue_bound_holds(self):
+        from ray_tpu.data.iterator import _iter_in_background
+
+        produced = []
+
+        def make():
+            for i in range(50):
+                produced.append(i)
+                yield i
+
+        got = []
+        for x in _iter_in_background(make, depth=3):
+            time.sleep(0.005)
+            # producer can be at most: this item + queue(depth) + one
+            # in-hand item blocked in put()
+            assert len(produced) - len(got) <= 3 + 2
+            got.append(x)
+        assert got == list(range(50))
+
+    def test_prefetch_stream_identical_to_inline(self, ray_start_regular):
+        ds = data.range(100, parallelism=7)
+        inline = [
+            [int(i) for i in b["id"]]
+            for b in ds.iter_batches(batch_size=32, prefetch_batches=0)
+        ]
+        threaded = [
+            [int(i) for i in b["id"]]
+            for b in ds.iter_batches(batch_size=32, prefetch_batches=2)
+        ]
+        assert threaded == inline
+
+    def test_exception_propagates_from_prefetch_thread(self, ray_start_regular):
+        import ray_tpu
+
+        def boom(r):
+            raise ValueError("boom")
+
+        ds = data.range(100, parallelism=4).map(boom)
+        with pytest.raises(ray_tpu.RayTaskError):
+            list(ds.iter_batches(batch_size=10, prefetch_batches=2))
+
+    def test_no_thread_leak_on_early_break(self, ray_start_regular):
+        import threading
+
+        def alive():
+            return [t for t in threading.enumerate()
+                    if t.name == "data-host-prefetch" and t.is_alive()]
+
+        ds = data.range(1000, parallelism=8)
+        it = iter(ds.iter_batches(batch_size=10, prefetch_batches=2))
+        next(it)
+        next(it)
+        it.close()  # break mid-epoch: generator finally must stop+join
+        deadline = time.time() + 3
+        while alive() and time.time() < deadline:
+            time.sleep(0.05)
+        assert not alive()
+
+    def test_device_transform_runs_on_prefetch_thread(self, ray_start_regular):
+        import threading
+
+        names = []
+
+        def tf(b):
+            names.append(threading.current_thread().name)
+            return b
+
+        ds = data.range(64, parallelism=4)
+        batches = list(ds.iter_device_batches(batch_size=16, transform=tf))
+        assert len(batches) == 4
+        assert set(names) == {"data-host-prefetch"}
+
+    @pytest.mark.slow
+    def test_bench_length_unordered_ingest(self, ray_start_regular, tmp_path):
+        # bench-shaped: decode -> resize -> normalize -> device batches,
+        # unordered read + threaded host assembly under a simulated step
+        from PIL import Image
+
+        d = tmp_path / "imgs"
+        d.mkdir()
+        rng = np.random.default_rng(2)
+        for i in range(48):
+            arr = rng.integers(0, 255, size=(32, 32, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img_{i:02d}.png")
+        ds = data.read_images(str(d), size=(16, 16), files_per_block=4)
+        total = 0
+        for b in ds.iter_device_batches(
+                batch_size=8, drop_last=False, preserve_order=False,
+                transform=lambda b: {
+                    "x": b["image"].astype(np.float32) / 255.0}):
+            time.sleep(0.01)  # the training step
+            total += len(np.asarray(b["x"]))
+        assert total == 48
 
 
 class TestConverters:
