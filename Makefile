@@ -19,6 +19,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_actor_process.py tests/test_async_actors.py \
 	tests/test_streaming_returns.py tests/test_rpc.py \
 	tests/test_persistence.py tests/test_object_transfer.py \
+	tests/test_object_plane.py \
 	tests/test_cross_host.py tests/test_fault_tolerance.py \
 	tests/test_sched.py tests/test_dag.py tests/test_collectives.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
@@ -35,12 +36,18 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all tsan shm bench-data
+.PHONY: check check-slow check-all tsan shm bench-data bench-object
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
 bench-data:
 	env RAY_TPU_BENCH_SUITE=data,images python bench.py
+
+# object-plane iteration loop: broadcast 64MB to 4 pullers over the
+# transfer plane (object_broadcast_gbps, object_cache_hit_rate), merged
+# into BENCH_SUMMARY.json
+bench-object:
+	env RAY_TPU_BENCH_SUITE=object python bench.py
 
 shm:
 	$(MAKE) -C ray_tpu/core/_shm

@@ -305,6 +305,112 @@ def bench_data() -> None:
     _emit("data_rows_per_sec", rows / total, "rows/s", "data_rows_anchor")
 
 
+def bench_objects() -> None:
+    """Host object plane (BASELINE.md object-plane row): broadcast one
+    large object from a single origin to M pullers over the real transfer
+    plane with pull-through caching — each successful pull advertises a
+    new replica, so later pullers spread across earlier ones instead of
+    hammering the origin. Then repeat gets measure the cache-hit rate.
+
+    Env knobs: RAY_TPU_BENCH_OBJECT_MB (default 64),
+    RAY_TPU_BENCH_OBJECT_PULLERS (default 4),
+    RAY_TPU_BENCH_OBJECT_ROUNDS (repeat-get rounds, default 2)."""
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.core.control_plane import ControlPlane
+    from ray_tpu.core.ids import ObjectID, TaskID
+    from ray_tpu.core.object_store import MemoryObjectStore
+    from ray_tpu.core.object_transfer import (
+        KV_PREFIX,
+        ObjectTransferClient,
+        ObjectTransferServer,
+        _cache_hits,
+        _cache_misses,
+        pull_from_any,
+    )
+
+    size_mb = int(os.environ.get("RAY_TPU_BENCH_OBJECT_MB", "64"))
+    n_pullers = int(os.environ.get("RAY_TPU_BENCH_OBJECT_PULLERS", "4"))
+    repeat_rounds = int(os.environ.get("RAY_TPU_BENCH_OBJECT_ROUNDS", "2"))
+    nbytes = size_mb << 20
+
+    cp = ControlPlane()
+    origin_store = MemoryObjectStore(capacity_bytes=4 * nbytes)
+    origin = ObjectTransferServer(origin_store)
+    cp.kv_put(KV_PREFIX + "origin", origin.address)
+    origin.start_load_gossip(cp, "origin")
+    oid = ObjectID.for_task_return(TaskID.of(), 0)
+    origin_store.put(oid, np.arange(nbytes // 8, dtype=np.float64))
+
+    pullers = []  # (store, server, client)
+    for i in range(n_pullers):
+        store = MemoryObjectStore(capacity_bytes=4 * nbytes)
+        server = ObjectTransferServer(store)
+        server.start_load_gossip(cp, f"puller{i}")
+        pullers.append((store, server, ObjectTransferClient()))
+
+    hits0, misses0 = _cache_hits.get(), _cache_misses.get()
+
+    def cached_get(i: int) -> None:
+        """The worker-side get path: local replica first, else pull from
+        any advertised holder and become a holder ourselves."""
+        store, server, client = pullers[i]
+        if store.contains(oid):
+            _cache_hits.inc()
+            store.get(oid, timeout=0)
+            return
+        _cache_misses.inc()
+        pull_from_any(
+            cp, oid, client=client, cache_store=store,
+            on_cached=lambda _o: cp.kv_put(
+                KV_PREFIX + f"puller{i}", server.address))
+
+    def run_round() -> float:
+        errors: list = []
+
+        def work(i):
+            try:
+                cached_get(i)
+            except Exception as e:  # noqa: BLE001 — surfaced after join
+                errors.append(e)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_pullers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"object bench pull failed: {errors[0]!r}")
+        return time.perf_counter() - t0
+
+    try:
+        wall = run_round()  # cold broadcast: every puller crosses the wire
+        for _ in range(repeat_rounds):
+            run_round()  # warm: local replicas serve
+        hits = _cache_hits.get() - hits0
+        misses = _cache_misses.get() - misses0
+        hit_rate = hits / max(hits + misses, 1)
+        gbps = n_pullers * nbytes / wall / 1e9
+        print(
+            f"# objects: size={size_mb}MB pullers={n_pullers} "
+            f"broadcast_wall={wall:.3f}s hits={hits} misses={misses}",
+            file=sys.stderr,
+        )
+        _emit("object_broadcast_gbps", gbps, "GB/s",
+              "object_broadcast_anchor")
+        _emit("object_cache_hit_rate", hit_rate, "ratio",
+              "object_cache_hit_anchor")
+    finally:
+        for _, server, client in pullers:
+            client.close()
+            server.stop()
+        origin.stop()
+
+
 def bench_train(model=None, batch=None, seq=None, steps=None, span=None,
                 factored: bool = False, bf16_params: bool = False) -> None:
     import jax
@@ -593,6 +699,9 @@ def main() -> None:
         bench_grpo()
     if "data" in wanted:
         bench_data()
+    if "object" in wanted:
+        # host object plane: pure CPU/network, no device state to poison
+        bench_objects()
     if "images" in wanted:
         bench_images()
     if "train" in wanted:

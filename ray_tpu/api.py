@@ -468,7 +468,16 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     rt = _auto_init()
     if isinstance(refs, ObjectRef):
         return rt.get([refs], timeout=timeout)[0]
-    return rt.get(list(refs), timeout=timeout)
+    batch = list(refs)
+    for item in batch:
+        if not isinstance(item, ObjectRef):
+            # fail before any resolution starts: the batched path fans
+            # refs over worker threads, where a mid-batch AttributeError
+            # would surface as an opaque pool failure
+            raise TypeError(
+                f"get() expects ObjectRef(s), got {type(item).__name__}: "
+                f"{item!r}")
+    return rt.get(batch, timeout=timeout)
 
 
 def put(value: Any) -> ObjectRef:

@@ -92,6 +92,34 @@ declare("object_store_memory_bytes", 0, "Host shm store capacity; 0 = 30% of RAM
 declare("object_store_fallback_dir", "/tmp/ray_tpu_spill", "Spill directory.")
 declare("object_inline_max_bytes", 100 * 1024, "Small objects travel inline.")
 declare("object_transfer_chunk_bytes", 1024 * 1024, "Inter-node chunk size.")
+declare(
+    "get_concurrency", 8,
+    "Worker threads for batched Runtime.get: distinct refs fan out over "
+    "this many parallel resolvers so pulls from different holders overlap "
+    "(<=1 restores the serial path).",
+)
+declare(
+    "object_transfer_pool_conns", 2,
+    "Max pooled transfer connections per remote address; concurrent pulls "
+    "from one holder ride separate sockets instead of serializing on one.",
+)
+declare(
+    "object_transfer_chunk_window", 8,
+    "Outstanding chunk requests pipelined per connection on the chunked "
+    "pull path (1 = one synchronous round trip per chunk).",
+)
+declare(
+    "object_transfer_stripe_min_bytes", 8 * 1024 * 1024,
+    "Chunked pulls at or above this size stripe byte ranges across "
+    "multiple advertised holders when at least two hold the object.",
+)
+declare(
+    "object_pull_through_cache", True,
+    "Seal remotely-pulled objects into the local store and register the "
+    "location, so repeat gets are local hits and later pullers can fetch "
+    "from this runtime (objects are immutable once sealed, so replicas "
+    "never go stale).",
+)
 
 # Gang / TPU
 declare("gang_barrier_timeout_ms", 60_000, "SPMD gang entry barrier timeout.")

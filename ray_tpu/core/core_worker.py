@@ -30,7 +30,8 @@ from .node_agent import (
     TaskResult,
     WorkerCrashedError,
 )
-from .object_store import ObjectLostError
+from .object_store import ObjectLostError, SealedBytes
+from .object_transfer import _cache_hits, _cache_misses
 from .scheduler import ClusterScheduler
 from .task_spec import (
     PlacementGroupSchedulingStrategy,
@@ -236,11 +237,53 @@ class ObjectRefGenerator:
 
 
 class _Future:
-    __slots__ = ("event", "error")
+    """Completion latch. wait()/get() park on the event as before;
+    Runtime.wait registers per-future callbacks so N waiters over M refs
+    cost one notification each instead of a 1ms busy-poll O(M) rescan."""
+
+    __slots__ = ("event", "error", "_lock", "_waiters", "_next_token")
 
     def __init__(self):
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, Callable[[], None]] = {}
+        self._next_token = 0
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        """Complete the future and fire registered waiters exactly once
+        (idempotent — concurrent producers race benignly)."""
+        with self._lock:
+            if error is not None and self.error is None:
+                self.error = error
+            if self.event.is_set():
+                return
+            self.event.set()
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a waiter never blocks completion
+                pass
+
+    def add_waiter(self, callback: Callable[[], None]) -> Optional[int]:
+        """Register a completion callback; fires immediately (returning
+        None) if already complete, else returns a token for remove_waiter."""
+        with self._lock:
+            if not self.event.is_set():
+                self._next_token += 1
+                token = self._next_token
+                self._waiters[token] = callback
+                return token
+        callback()
+        return None
+
+    def remove_waiter(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        with self._lock:
+            self._waiters.pop(token, None)
 
 
 class Runtime:
@@ -268,6 +311,18 @@ class Runtime:
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._actor_specs: Dict[ActorID, TaskSpec] = {}
         self._put_index = 0
+        # batched-get fan-out pool (lazy; config.get_concurrency workers)
+        self._get_pool = None
+        self._get_pool_lock = threading.Lock()
+        # object ids this runtime pulled through from a remote holder and
+        # sealed locally — distinguishes cache hits from plain local gets
+        self._pulled_through: set = set()
+        self._cache_lock = threading.Lock()
+        # lost-object recovery coalescing: concurrent waiters on one lost
+        # object share a single reconstruction (parallel get makes the
+        # many-waiters race the common case, not the corner case)
+        self._reconstruct_inflight: Dict[ObjectID, Dict[str, Any]] = {}
+        self._reconstruct_lock = threading.Lock()
         self._driver_task_id = TaskID.of()
         self._sched_thread = threading.Thread(
             target=self._scheduling_loop, daemon=True, name="cluster-scheduler"
@@ -540,40 +595,141 @@ class Runtime:
         agent.store.put(oid, seal_value(value))
         self.directory.add_location(oid, agent.node_id)
         fut = _Future()
-        fut.event.set()
+        fut.finish()
         with self._lock:
             self._futures[oid] = fut
         return ObjectRef(oid, self)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        """Resolve a batch of refs. Distinct object ids are deduped (each
+        resolves once, every requesting slot shares the value) and fanned
+        out over a bounded pool, so pulls from different holders overlap
+        and the batch completes in ~max of the individual pull times. All
+        refs share ONE deadline derived from `timeout`, instead of each
+        ref re-budgeting whatever time the previous ones left."""
+        refs = list(refs)
+        if not refs:
+            return []
         deadline = None if timeout is None else time.monotonic() + timeout
-        out: List[Any] = []
-        for ref in refs:
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            out.append(self._get_one(ref, remaining))
+        distinct: "Dict[ObjectID, List[int]]" = {}
+        for idx, ref in enumerate(refs):
+            distinct.setdefault(ref.object_id, []).append(idx)
+        uniques = [refs[slots[0]] for slots in distinct.values()]
+        if len(uniques) == 1 or config.get_concurrency <= 1:
+            results = [self._get_one(ref, deadline) for ref in uniques]
+        else:
+            pool = self._get_executor()
+            futures = [pool.submit(self._get_one, ref, deadline)
+                       for ref in uniques]
+            results, first_error = [], None
+            for f in futures:
+                try:
+                    results.append(f.result())
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    results.append(None)
+                    if first_error is None:
+                        first_error = e
+            if first_error is not None:
+                # deterministic: the earliest failing ref wins, matching
+                # what the serial loop would have raised first
+                raise first_error
+        out: List[Any] = [None] * len(refs)
+        for value, slots in zip(results, distinct.values()):
+            for idx in slots:
+                out[idx] = value
         return out
 
-    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
-        fut = self._future_for(ref.object_id)
-        if not fut.event.wait(timeout):
+    def _get_executor(self):
+        with self._get_pool_lock:
+            if self._get_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._get_pool = ThreadPoolExecutor(
+                    max_workers=max(1, int(config.get_concurrency)),
+                    thread_name_prefix="object-get",
+                )
+            return self._get_pool
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        oid = ref.object_id
+        fut = self._future_for(oid)
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+        if not fut.event.wait(remaining):
             raise GetTimeoutError(f"get() timed out on {ref}")
         if fut.error is not None:
             raise fut.error
-        holder = self.directory.locate(ref.object_id)
+        holder = self.directory.locate(oid, prefer_local=True)
         if holder is None:
             # object lost (e.g. node died) — attempt lineage reconstruction
-            if self._try_reconstruct(ref.object_id):
-                return self._get_one(ref, timeout)
-            raise ObjectLostError(ref.object_id)
+            if self._reconstruct_once(oid, deadline):
+                return self._get_one(ref, deadline)
+            raise ObjectLostError(oid)
         try:
-            return holder.store.get(ref.object_id, timeout=10.0)
+            if not getattr(holder, "is_remote", False):
+                with self._cache_lock:
+                    if oid in self._pulled_through:
+                        _cache_hits.inc()
+                return holder.store.get(oid, timeout=10.0)
+            if config.object_pull_through_cache:
+                return self._pull_through(oid, holder)
+            return holder.store.get(oid, timeout=10.0)
         except (TimeoutError, ObjectLostError):
             # holder died between locate and pull (remote store proxies
-            # surface this as ObjectLostError) — one reconstruction attempt
-            self.directory.remove_location(ref.object_id, holder.node_id)
-            if self._try_reconstruct(ref.object_id):
-                return self._get_one(ref, timeout)
-            raise ObjectLostError(ref.object_id)
+            # surface this as ObjectLostError) — one coalesced
+            # reconstruction attempt, retried against the REMAINING time
+            # to the shared deadline, not the original timeout
+            self.directory.remove_location(oid, holder.node_id)
+            if self._reconstruct_once(oid, deadline):
+                return self._get_one(ref, deadline)
+            raise ObjectLostError(oid)
+
+    def _pull_through(self, oid: ObjectID, holder) -> Any:
+        """Remote get with pull-through caching: fetch the SEALED payload,
+        seal it into the local driver store, and register the new location
+        — repeat gets become local hits and later pullers anywhere in the
+        cluster can fetch from us instead of the origin (broadcast fans
+        out instead of hammering one holder). Objects are immutable once
+        sealed, so the replica can never go stale. Caching is best-effort:
+        any failure degrades to returning the pulled value."""
+        _cache_misses.inc()
+        raw = holder.store.get_raw(oid, timeout=10.0)
+        try:
+            agent = self.driver_agent
+            if not getattr(agent, "is_remote", False):
+                agent.store.put(oid, raw)
+                self.directory.add_location(oid, agent.node_id)
+                with self._cache_lock:
+                    self._pulled_through.add(oid)
+                return agent.store.get(oid, timeout=0.0)
+        except Exception:  # noqa: BLE001 — caching never fails the get
+            logger.debug("pull-through cache of %s failed", oid, exc_info=True)
+        return raw.load() if isinstance(raw, SealedBytes) else raw
+
+    def _reconstruct_once(self, oid: ObjectID,
+                          deadline: Optional[float]) -> bool:
+        """Lineage recovery, coalesced: the first waiter to notice the loss
+        leads the reconstruction; concurrent waiters for the same object
+        block on its outcome instead of re-running the producing task once
+        per waiter."""
+        with self._reconstruct_lock:
+            rec = self._reconstruct_inflight.get(oid)
+            leader = rec is None
+            if leader:
+                rec = {"event": threading.Event(), "ok": False}
+                self._reconstruct_inflight[oid] = rec
+        if leader:
+            try:
+                rec["ok"] = self._try_reconstruct(oid)
+            finally:
+                with self._reconstruct_lock:
+                    self._reconstruct_inflight.pop(oid, None)
+                rec["event"].set()
+            return bool(rec["ok"])
+        remaining = (60.0 if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+        rec["event"].wait(remaining)
+        return bool(rec["ok"])
 
     def wait(
         self,
@@ -581,27 +737,45 @@ class Runtime:
         num_returns: int = 1,
         timeout: Optional[float] = None,
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """Block until num_returns refs complete. Completion-driven: each
+        future notifies a shared condition variable, so the wait costs one
+        wakeup per completion instead of a 1ms busy-poll that rescans all
+        refs (which burned a core at high fan-in)."""
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
+        refs = list(refs)
+        if num_returns <= 0:
+            return [], refs
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready: List[ObjectRef] = []
-        pending = list(refs)
-        while len(ready) < num_returns:
-            progressed = False
-            for ref in list(pending):
+        cv = threading.Condition()
+        done_indices: List[int] = []
+
+        def _on_done(idx: int) -> None:
+            with cv:
+                done_indices.append(idx)
+                cv.notify_all()
+
+        registrations: List[Tuple[_Future, Optional[int]]] = []
+        try:
+            for idx, ref in enumerate(refs):
                 fut = self._future_for(ref.object_id)
-                if fut.event.is_set():
-                    ready.append(ref)
-                    pending.remove(ref)
-                    progressed = True
-                    if len(ready) >= num_returns:
+                registrations.append(
+                    (fut, fut.add_waiter(lambda i=idx: _on_done(i))))
+            with cv:
+                while len(done_indices) < num_returns:
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
                         break
-            if len(ready) >= num_returns:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            if not progressed:
-                time.sleep(0.001)
+                    cv.wait(remaining)
+                chosen = set(sorted(done_indices)[:num_returns])
+        finally:
+            # always deregister: leaked waiters would accumulate on
+            # long-lived futures across repeated wait() calls
+            for fut, token in registrations:
+                fut.remove_waiter(token)
+        ready = [ref for i, ref in enumerate(refs) if i in chosen]
+        pending = [ref for i, ref in enumerate(refs) if i not in chosen]
         return ready, pending
 
     def _future_for(self, oid: ObjectID) -> _Future:
@@ -611,9 +785,9 @@ class Runtime:
                 # ref arrived from another process / was reconstructed
                 fut = _Future()
                 if self.directory.locations(oid):
-                    fut.event.set()
+                    fut.finish()
                 else:
-                    self.directory.subscribe_once(oid, fut.event.set)
+                    self.directory.subscribe_once(oid, fut.finish)
                 self._futures[oid] = fut
             return fut
 
@@ -621,6 +795,8 @@ class Runtime:
         with self._lock:
             self._futures.pop(object_id, None)
             self._lineage.pop(object_id, None)
+        with self._cache_lock:
+            self._pulled_through.discard(object_id)
         self.directory.drop_everywhere(object_id)
 
     # ---------------------------------------------------------- health check
@@ -814,7 +990,7 @@ class Runtime:
                 futures = [self._futures.get(oid) for oid in spec.return_ids]
             for fut in futures:
                 if fut is not None:
-                    fut.event.set()
+                    fut.finish()
             return
 
         # Actor-death detection must precede the retry decision: a crashed
@@ -934,8 +1110,7 @@ class Runtime:
             futures = [self._futures.get(oid) for oid in item.spec.return_ids]
         for fut in futures:
             if fut is not None:
-                fut.error = error
-                fut.event.set()
+                fut.finish(error)
 
     def _mark_task(self, task_id: TaskID, state: str) -> None:
         from ..util import timeline
@@ -1014,6 +1189,10 @@ class Runtime:
     # -------------------------------------------------------------- shutdown
     def shutdown(self) -> None:
         self.is_shutdown = True
+        with self._get_pool_lock:
+            pool, self._get_pool = self._get_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         if config.event_log_dir:
             # durable task timeline for `ray-tpu timeline --events-dir`
             try:

@@ -10,6 +10,7 @@ format for scraping by the node agent.
 from __future__ import annotations
 
 import bisect
+import contextlib
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -77,6 +78,16 @@ class Gauge(_Metric):
     def get(self, tags: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
             return self._values.get(_tags(tags), 0.0)
+
+    @contextlib.contextmanager
+    def track(self, tags: Optional[Dict[str, str]] = None):
+        """In-flight tracking: +1 on entry, -1 on exit (exception included).
+        The gauge reads as the number of bodies currently executing."""
+        self.add(1, tags)
+        try:
+            yield
+        finally:
+            self.add(-1, tags)
 
     def samples(self):
         with self._lock:

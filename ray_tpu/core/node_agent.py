@@ -265,6 +265,11 @@ class NodeAgent:
         self._cp = control_plane
         self._directory = object_directory
         self.store = MemoryObjectStore()
+        # an object leaving this store must leave the directory too, or a
+        # pull-through replica's advertisement outlives the replica and
+        # sends pullers to a holder that no longer has the bytes
+        self.store.on_evict = (
+            lambda oid: object_directory.remove_location(oid, info.node_id))
         self.resources = ResourceTracker(info.resources_total)
         self._actors: Dict[ActorID, _ActorRunner] = {}
         self._lock = threading.Lock()
@@ -1004,15 +1009,26 @@ class ObjectDirectory:
         with self._lock:
             return list(self._locations.get(object_id, []))
 
-    def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None) -> Optional[NodeAgent]:
+    def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None,
+               prefer_local: bool = False) -> Optional[NodeAgent]:
+        """First live holder, in registration order. With prefer_local,
+        in-process agents rank ahead of cross-host proxies (is_remote
+        agents), so a pull-through replica short-circuits future network
+        pulls; a remote holder is still returned when it's the only one."""
         with self._lock:
+            remote_fallback = None
             for node_id in self._locations.get(object_id, []):
                 if node_id == exclude:
                     continue
                 agent = self._agents.get(node_id)
-                if agent is not None and not agent._stopped.is_set():
-                    return agent
-            return None
+                if agent is None or agent._stopped.is_set():
+                    continue
+                if prefer_local and getattr(agent, "is_remote", False):
+                    if remote_fallback is None:
+                        remote_fallback = agent
+                    continue
+                return agent
+            return remote_fallback
 
     def subscribe_once(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
         with self._lock:

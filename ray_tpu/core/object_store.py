@@ -129,6 +129,11 @@ class MemoryObjectStore:
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._used = 0
         self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
+        # fires (outside the lock) when an object leaves the store for good
+        # — delete, not spill (spilled objects are still gettable). The node
+        # agent hooks this to deregister the directory location, so a
+        # pull-through replica's advertisement dies with the replica.
+        self.on_evict: Optional[Callable[[ObjectID], None]] = None
 
     # -- size accounting ----------------------------------------------------
     @staticmethod
@@ -275,6 +280,13 @@ class MemoryObjectStore:
                 os.remove(path)
             except OSError:
                 pass
+        on_evict = self.on_evict
+        if entry is not None and on_evict is not None:
+            try:
+                on_evict(object_id)
+            except Exception:  # noqa: BLE001 — eviction hooks never fail a delete
+                logger.debug("on_evict hook failed for %s", object_id,
+                             exc_info=True)
 
     def used_bytes(self) -> int:
         with self._lock:

@@ -25,11 +25,14 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .config import config
 from .ids import ObjectID
 from .logging import get_logger
-from .metrics import Counter
+from .metrics import Counter, Gauge, Histogram
+from .object_store import SealedBytes
 from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
 
 logger = get_logger("object_transfer")
@@ -37,6 +40,8 @@ logger = get_logger("object_transfer")
 DEFAULT_CHUNK_BYTES = 1 << 20  # ~1MB, the reference's chunk size
 
 KV_PREFIX = "object_transfer/"  # control-plane KV key prefix for addresses
+# holder-side outstanding-pull load, gossiped so pullers can rank holders
+LOAD_PREFIX = "object_transfer_load/"
 
 # Native fast path (_shm/transfer.cc): the holder stages the serialized
 # blob in a shm arena once, a C++ thread streams it zero-copy, and the
@@ -66,6 +71,28 @@ _pulled_chunks = Counter(
 )
 _pulled_bytes = Counter(
     "object_transfer_bytes_pulled", "Bytes pulled from remote runtimes."
+)
+_pull_seconds = Histogram(
+    "object_pull_seconds",
+    "Wall seconds per completed remote pull, tagged by data path.",
+)
+_pull_bytes = Counter(
+    "object_pull_bytes", "Bytes that crossed the network on remote pulls."
+)
+_pull_inflight = Gauge(
+    "object_pull_inflight", "Remote pulls currently in flight on this side."
+)
+# pull-through cache outcomes (incremented by the get paths in
+# core_worker/worker_api; defined here because the cache IS the object
+# plane's replica mechanism)
+_cache_hits = Counter(
+    "object_cache_hits",
+    "Gets served from the local store for objects a prior get pulled "
+    "through from a remote holder.",
+)
+_cache_misses = Counter(
+    "object_cache_misses",
+    "Gets that had to pull the object from a remote holder.",
 )
 
 
@@ -117,10 +144,13 @@ class _TransferHandler(socketserver.BaseRequestHandler):
                 msg_type, req = recv_msg(sock)
                 if msg_type != MSG_REQUEST:
                     raise WireError(f"unexpected message type {msg_type}")
+                server._load_add(1)
                 try:
                     resp = self._dispatch(server, req)
                 except Exception as e:  # noqa: BLE001 — serialized to caller
                     resp = {"id": req.get("id"), "ok": False, "error": repr(e)}
+                finally:
+                    server._load_add(-1)
                 send_msg(sock, MSG_RESPONSE, resp)
         except (WireError, OSError):
             pass  # puller disconnected
@@ -149,6 +179,10 @@ class _TransferHandler(socketserver.BaseRequestHandler):
             oid = ObjectID.from_hex(oid_hex)
             return {"id": req["id"], "ok": True,
                     "value": bool(server._store.contains(oid))}
+        if method == "load":
+            # holders serve their own outstanding-pull count so pullers
+            # can rank them directly (the KV gossip is the cached form)
+            return {"id": req["id"], "ok": True, "value": server.outstanding}
         raise WireError(f"unknown method {method!r}")
 
 
@@ -252,6 +286,12 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         self._store = store
         self._blob_cache: Dict[Tuple[str, bool], bytes] = {}
         self._cache_lock = threading.Lock()
+        # outstanding-pull load: requests currently being served. Gossiped
+        # to the control-plane KV (start_load_gossip) so pullers rank
+        # lightly-loaded holders first.
+        self._load = 0
+        self._load_lock = threading.Lock()
+        self._gossip_stop = threading.Event()
         self._plane = _NativePlane("native-transfer-server",
                                    self._make_native)
         self._plane.start_async()
@@ -260,6 +300,37 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         )
         self._thread.start()
         logger.info("object transfer plane on %s:%d", *self.server_address)
+
+    def _load_add(self, delta: int) -> None:
+        with self._load_lock:
+            self._load += delta
+
+    @property
+    def outstanding(self) -> int:
+        with self._load_lock:
+            return self._load
+
+    def start_load_gossip(self, control_plane, node_hex: str,
+                          period_s: float = 0.25) -> None:
+        """Publish this holder's outstanding-pull count to the control
+        plane KV (`object_transfer_load/{node}`) on change; pull_from_any
+        ranks holders by it. Best-effort: a stale or missing value only
+        degrades ranking, never correctness."""
+
+        def loop() -> None:
+            last: Optional[int] = None
+            while not self._gossip_stop.wait(period_s):
+                load = self.outstanding
+                if load == last:
+                    continue
+                try:
+                    control_plane.kv_put(LOAD_PREFIX + node_hex, str(load))
+                    last = load
+                except Exception:  # noqa: BLE001 — control plane gone
+                    return
+
+        threading.Thread(target=loop, daemon=True,
+                         name="transfer-load-gossip").start()
 
     def _make_native(self):
         from .shm_store import NativeTransferServer, ShmObjectStore
@@ -337,106 +408,353 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         return blob
 
     def stop(self) -> None:
+        self._gossip_stop.set()
         self.shutdown()
         self.server_close()
         self._plane.teardown()
 
 
-class ObjectTransferClient:
-    """Chunked puller. One connection per remote address, reused across
-    pulls (the reference pools object-manager RPC channels likewise)."""
+class _PoolSlot:
+    """One pooled connection. The socket stays tracked here from dial to
+    close, so _ConnPool.close() can reach every fd it ever created —
+    including ones checked out by in-flight pulls."""
 
-    def __init__(self, chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+    __slots__ = ("sock", "busy", "dead")
+
+    def __init__(self):
+        self.sock: Optional[socket.socket] = None
+        self.busy = True  # born checked-out by the dialing thread
+        self.dead = False
+
+
+def _close_sock(sock: Optional[socket.socket]) -> None:
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _ConnPool:
+    """Bounded per-address connection pool. Concurrent pulls from one
+    holder each get their own socket (up to max_conns) instead of
+    serializing on a single connection lock; a checked-out socket is
+    exclusively held, which is what makes client-side request pipelining
+    on it safe."""
+
+    def __init__(self, address: str, max_conns: int):
+        self.address = address
+        self.max_conns = max(1, int(max_conns))
+        self._cv = threading.Condition()
+        self._slots: List[_PoolSlot] = []
+        self._closed = False
+
+    def checkout(self, timeout: float = 30.0) -> _PoolSlot:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._closed:
+                    raise ObjectPullConnectionError(
+                        f"transfer client closed ({self.address})")
+                slot = next((s for s in self._slots
+                             if not s.busy and not s.dead), None)
+                if slot is not None:
+                    slot.busy = True
+                    return slot
+                # idle dead slots free their capacity for a fresh dial
+                self._slots = [s for s in self._slots if s.busy or not s.dead]
+                if len(self._slots) < self.max_conns:
+                    slot = _PoolSlot()
+                    self._slots.append(slot)
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ObjectPullConnectionError(
+                        f"no transfer connection to {self.address} "
+                        f"within {timeout}s")
+                self._cv.wait(min(remaining, 1.0))
+        # dial OUTSIDE the lock (slow); the slot reserves our seat
+        try:
+            host, _, port = self.address.rpartition(":")
+            sock = socket.create_connection((host, int(port)), timeout=30.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError as e:
+            with self._cv:
+                if slot in self._slots:
+                    self._slots.remove(slot)
+                self._cv.notify_all()
+            raise ObjectPullConnectionError(
+                f"cannot connect to {self.address}: {e}")
+        with self._cv:
+            if self._closed:
+                if slot in self._slots:
+                    self._slots.remove(slot)
+                self._cv.notify_all()
+                _close_sock(sock)
+                raise ObjectPullConnectionError(
+                    f"transfer client closed ({self.address})")
+            slot.sock = sock
+        return slot
+
+    def checkin(self, slot: _PoolSlot, dead: bool = False) -> None:
+        sock = None
+        with self._cv:
+            slot.busy = False
+            if dead or self._closed or slot.dead:
+                slot.dead = True
+                sock, slot.sock = slot.sock, None
+                if slot in self._slots:
+                    self._slots.remove(slot)
+            self._cv.notify_all()
+        _close_sock(sock)
+
+    def idle_count(self) -> int:
+        with self._cv:
+            return sum(1 for s in self._slots if not s.busy and not s.dead)
+
+    def close(self) -> None:
+        """Close EVERY tracked socket, including checked-out ones: an
+        in-flight pull fails fast with a connection error instead of
+        holding a leaked fd. Busy slots fully retire at their checkin."""
+        with self._cv:
+            self._closed = True
+            socks = [s.sock for s in self._slots if s.sock is not None]
+            for s in self._slots:
+                s.dead = True
+                if not s.busy:
+                    s.sock = None
+            self._slots = [s for s in self._slots if s.busy]
+            self._cv.notify_all()
+        for sock in socks:
+            _close_sock(sock)
+
+
+class ObjectTransferClient:
+    """Chunked puller with a small per-address connection pool (the
+    reference pools object-manager RPC channels likewise; here the pool
+    additionally lets concurrent pulls from one holder overlap)."""
+
+    def __init__(self, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 pool_conns: Optional[int] = None,
+                 chunk_window: Optional[int] = None):
         self.chunk_bytes = int(chunk_bytes)
-        self._conns: Dict[str, socket.socket] = {}
-        self._locks: Dict[str, threading.Lock] = {}
+        self.pool_conns = int(pool_conns if pool_conns is not None
+                              else config.object_transfer_pool_conns)
+        self.chunk_window = max(1, int(
+            chunk_window if chunk_window is not None
+            else config.object_transfer_chunk_window))
+        self._pools: Dict[str, _ConnPool] = {}
         self._global_lock = threading.Lock()
         self._next_id = 0
+        self._closed = False
         self._plane = _NativePlane("native-transfer-client",
                                    _make_client_native)
         self._inflight: set = set()  # sids being pulled by THIS client
         self._inflight_lock = threading.Lock()
 
-    def _conn(self, address: str) -> Tuple[socket.socket, threading.Lock]:
+    def _pool(self, address: str) -> _ConnPool:
         with self._global_lock:
-            sock = self._conns.get(address)
-            if sock is None:
-                host, _, port = address.rpartition(":")
-                sock = socket.create_connection((host, int(port)), timeout=30.0)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[address] = sock
-                self._locks[address] = threading.Lock()
-            return sock, self._locks[address]
+            if self._closed:
+                raise ObjectPullConnectionError("transfer client closed")
+            pool = self._pools.get(address)
+            if pool is None:
+                pool = self._pools[address] = _ConnPool(
+                    address, self.pool_conns)
+            return pool
 
-    def _call(self, address: str, method: str, *args) -> Any:
-        sock, lock = self._conn(address)
-        with lock:
-            with self._global_lock:
-                self._next_id += 1
-                req_id = self._next_id
-            try:
-                send_msg(sock, MSG_REQUEST,
-                         {"id": req_id, "method": method, "args": args})
-                msg_type, resp = recv_msg(sock)
-            except (WireError, OSError) as e:
-                self._drop(address)
-                raise ObjectPullConnectionError(
-                    f"transfer connection to {address} lost: {e}")
+    def _new_id(self) -> int:
+        with self._global_lock:
+            self._next_id += 1
+            return self._next_id
+
+    def _request_on(self, sock: socket.socket, address: str,
+                    method: str, *args) -> Any:
+        """One request/response round trip on an exclusively-held socket."""
+        req_id = self._new_id()
+        try:
+            send_msg(sock, MSG_REQUEST,
+                     {"id": req_id, "method": method, "args": args})
+            msg_type, resp = recv_msg(sock)
+        except (WireError, OSError) as e:
+            raise ObjectPullConnectionError(
+                f"transfer connection to {address} lost: {e}")
         if msg_type != MSG_RESPONSE or resp.get("id") != req_id:
-            self._drop(address)
             raise ObjectPullConnectionError(
                 f"bad transfer response from {address}")
         if not resp.get("ok"):
             raise ObjectPullError(resp.get("error", "pull failed"))
         return resp["value"]
 
-    def _drop(self, address: str) -> None:
-        with self._global_lock:
-            sock = self._conns.pop(address, None)
-            self._locks.pop(address, None)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+    def _call(self, address: str, method: str, *args) -> Any:
+        slot = self._pool(address).checkout()
+        dead = True
+        try:
+            value = self._request_on(slot.sock, address, method, *args)
+            dead = False
+            return value
+        except ObjectPullError as e:
+            # app-level refusal: the connection itself is fine
+            dead = isinstance(e, ObjectPullConnectionError)
+            raise
+        finally:
+            self._pool(address).checkin(slot, dead=dead)
 
-    def pull(self, address: str, object_id, raw: bool = False) -> Any:
+    def _drop(self, address: str) -> None:
+        """Retire every pooled connection for an address (holder restarted
+        or unreachable); the next call dials fresh."""
+        with self._global_lock:
+            pool = self._pools.pop(address, None)
+        if pool is not None:
+            pool.close()
+
+    def pull(self, address: str, object_id, raw: bool = False,
+             peers: Sequence[str] = ()) -> Any:
         """Pull one object from the holder at `address`; returns the value
         (raw=True: the sealed payload, store.get_raw parity).
 
         Fast path: one "stage" round trip on the control connection, then
         the C++ plane streams the blob arena-to-arena (_shm/transfer.cc)
         and the value unpickles from a zero-copy view. Fallback: ~1MB
-        chunks over the control connection (matching the reference's
-        ObjectBufferPool sizing)."""
+        chunks, pipelined `chunk_window` requests deep per connection;
+        large fallback pulls stripe byte ranges across `peers` that also
+        hold the object (pull_from_any passes the ranked remainder)."""
         oid_hex = object_id.hex() if hasattr(object_id, "hex") else str(object_id)
+        t0 = time.monotonic()
+        with _pull_inflight.track():
+            try:
+                staged = self._call(address, "stage", oid_hex, raw)
+                total, native_port = staged["size"], staged["native_port"]
+            except ObjectPullError as e:
+                if "unknown method" not in str(e):
+                    raise
+                # holder predates the staged protocol: chunked via "meta"
+                total, native_port = self._call(address, "meta", oid_hex,
+                                                raw), None
+            if native_port is not None:
+                value = self._pull_native(address, native_port, oid_hex, raw,
+                                          total)
+                if value is not _NATIVE_MISS:
+                    _pull_seconds.observe(time.monotonic() - t0,
+                                          {"path": "native"})
+                    return value
+            blob = None
+            if (peers and total >= config.object_transfer_stripe_min_bytes):
+                blob = self._pull_striped(address, peers, oid_hex, raw, total)
+            if blob is None:
+                blob = self._pull_chunked(address, oid_hex, raw, 0, total)
+            _pull_seconds.observe(time.monotonic() - t0, {"path": "chunked"})
+            return pickle.loads(blob)
+
+    def _pull_chunked(self, address: str, oid_hex: str, raw: bool,
+                      start: int, end: int) -> bytes:
+        """Pull bytes [start, end) as pipelined chunk requests: a window of
+        chunk_window requests stays outstanding on one exclusively-held
+        connection instead of one synchronous round trip per ~1MB. The
+        server handles a connection's requests strictly in order, so
+        responses return in request order and match by id."""
+        pool = self._pool(address)
+        slot = pool.checkout()
+        dead = True
+        parts: List[bytes] = []
+        pending: "deque[Tuple[int, int, int]]" = deque()  # (req_id, off, len)
+        offset = start
         try:
-            staged = self._call(address, "stage", oid_hex, raw)
-            total, native_port = staged["size"], staged["native_port"]
+            sock = slot.sock
+            while offset < end or pending:
+                while offset < end and len(pending) < self.chunk_window:
+                    length = min(self.chunk_bytes, end - offset)
+                    req_id = self._new_id()
+                    send_msg(sock, MSG_REQUEST,
+                             {"id": req_id, "method": "chunk",
+                              "args": (oid_hex, offset, length, raw)})
+                    pending.append((req_id, offset, length))
+                    offset += length
+                req_id, off, _length = pending.popleft()
+                msg_type, resp = recv_msg(sock)
+                if msg_type != MSG_RESPONSE or resp.get("id") != req_id:
+                    raise ObjectPullConnectionError(
+                        f"bad transfer response from {address}")
+                if not resp.get("ok"):
+                    raise ObjectPullError(resp.get("error", "pull failed"))
+                chunk = resp["value"]
+                if not chunk:
+                    raise ObjectPullError(
+                        f"short read at {off}/{end} for {oid_hex}")
+                parts.append(chunk)
+                _pulled_chunks.inc()
+                _pulled_bytes.inc(len(chunk))
+                _pull_bytes.inc(len(chunk))
+            dead = False
+        except (WireError, OSError) as e:
+            raise ObjectPullConnectionError(
+                f"transfer connection to {address} lost: {e}")
         except ObjectPullError as e:
-            if "unknown method" not in str(e):
-                raise
-            # holder predates the staged protocol: chunked path via "meta"
-            total, native_port = self._call(address, "meta", oid_hex, raw), None
-        if native_port is not None:
-            value = self._pull_native(address, native_port, oid_hex, raw,
-                                      total)
-            if value is not _NATIVE_MISS:
-                return value
-        parts = []
-        offset = 0
-        while offset < total:
-            length = min(self.chunk_bytes, total - offset)
-            chunk = self._call(address, "chunk", oid_hex, offset, length, raw)
-            if not chunk:
-                raise ObjectPullError(
-                    f"short read at {offset}/{total} for {oid_hex}"
-                )
-            parts.append(chunk)
-            offset += len(chunk)
-            _pulled_chunks.inc()
-            _pulled_bytes.inc(len(chunk))
-        return pickle.loads(b"".join(parts))
+            # app-level refusal mid-stream: responses for the rest of the
+            # window are still queued on the socket — retire it rather
+            # than desync the next caller
+            dead = True if pending else isinstance(
+                e, ObjectPullConnectionError)
+            raise
+        finally:
+            pool.checkin(slot, dead=dead)
+        return b"".join(parts)
+
+    def _pull_striped(self, address: str, peers: Sequence[str],
+                      oid_hex: str, raw: bool, total: int) -> Optional[bytes]:
+        """Stripe a large chunked pull across holders: confirmed peers each
+        serve a contiguous byte range in parallel. Returns None when no
+        peer confirms (caller falls back to the single-holder path); any
+        stripe failure also falls back — striping is an optimization,
+        never a correctness dependency."""
+        holders = [address]
+        for peer in peers:
+            if len(holders) >= 4:  # diminishing returns past a few stripes
+                break
+            try:
+                if self._call(peer, "contains", oid_hex):
+                    holders.append(peer)
+            except ObjectPullError:
+                continue
+        if len(holders) < 2:
+            return None
+        # contiguous ranges, chunk-aligned so stripes pipeline internally
+        n = len(holders)
+        per = ((total // n) // self.chunk_bytes + 1) * self.chunk_bytes
+        ranges = []
+        off = 0
+        for h in holders:
+            if off >= total:
+                break
+            ranges.append((h, off, min(off + per, total)))
+            off += per
+        results: List[Optional[bytes]] = [None] * len(ranges)
+        errors: List[Optional[BaseException]] = [None] * len(ranges)
+
+        def work(i: int, holder: str, lo: int, hi: int) -> None:
+            try:
+                results[i] = self._pull_chunked(holder, oid_hex, raw, lo, hi)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors[i] = e
+
+        threads = [threading.Thread(
+            target=work, args=(i, h, lo, hi), daemon=True,
+            name=f"stripe-{i}") for i, (h, lo, hi) in enumerate(ranges)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if any(e is not None for e in errors) or any(
+                r is None for r in results):
+            failed = next(e for e in errors if e is not None)
+            logger.debug("striped pull of %s fell back to one holder: %r",
+                         oid_hex[:16], failed)
+            return None
+        return b"".join(results)  # type: ignore[arg-type]
 
     def _pull_native(self, address: str, native_port: int, oid_hex: str,
                      raw: bool, total: int) -> Any:
@@ -513,6 +831,7 @@ class ObjectTransferClient:
             if transferred:  # count only bytes that crossed the network
                 _pulled_chunks.inc()
                 _pulled_bytes.inc(total)
+                _pull_bytes.inc(total)
             return value
         except PullRejected:
             return _NATIVE_MISS  # does not fit the local arena
@@ -524,15 +843,17 @@ class ObjectTransferClient:
             self._plane.release()
 
     def close(self) -> None:
+        """Close every pooled connection (including ones held by in-flight
+        pulls, which fail fast with a connection error) and tear down the
+        native plane. Safe to race with concurrent pulls: each socket is
+        tracked in exactly one pool slot from dial to close, so nothing
+        leaks even if a pull checked its socket out before we got here."""
         with self._global_lock:
-            conns = list(self._conns.values())
-            self._conns.clear()
-            self._locks.clear()
-        for sock in conns:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._closed = True
+            pools = list(self._pools.values())
+            self._pools.clear()
+        for pool in pools:
+            pool.close()
         self._plane.teardown()
 
 
@@ -543,12 +864,12 @@ def serve_object_transfer(runtime, host: str = "127.0.0.1",
     remote runtimes sharing the control plane can locate the holder."""
     store = runtime.driver_agent.store
     server = ObjectTransferServer(store, host, port)
+    node_hex = runtime.driver_agent.node_id.hex()
     try:
-        runtime.control_plane.kv_put(
-            KV_PREFIX + runtime.driver_agent.node_id.hex(), server.address
-        )
+        runtime.control_plane.kv_put(KV_PREFIX + node_hex, server.address)
     except Exception:  # noqa: BLE001 — advertising is best-effort
         logger.warning("could not advertise transfer address", exc_info=True)
+    server.start_load_gossip(runtime.control_plane, node_hex)
     return server
 
 
@@ -567,16 +888,49 @@ def _shared_client() -> ObjectTransferClient:
         return _default_client
 
 
-def pull_from_any(control_plane, object_id,
-                  client: Optional[ObjectTransferClient] = None) -> Any:
-    """Resolve `object_transfer/*` advertisements from the control plane
-    and try each holder until one serves the object."""
-    client = client or _shared_client()
-    errors = []
-    for key in control_plane.kv_keys(KV_PREFIX):
+def _ranked_holders(control_plane) -> List[str]:
+    """Advertised transfer addresses, least-loaded first. Load is each
+    holder's gossiped outstanding-request count (`object_transfer_load/*`
+    KV, published by start_load_gossip); holders that never gossiped rank
+    as idle, preserving the old iteration order among ties."""
+    ranked: List[Tuple[float, int, str]] = []
+    for idx, key in enumerate(control_plane.kv_keys(KV_PREFIX)):
         address = control_plane.kv_get(key)
         if not address:
             continue
+        node_hex = key[len(KV_PREFIX):]
+        load = 0.0
+        try:
+            raw = control_plane.kv_get(LOAD_PREFIX + node_hex)
+            if raw:
+                load = float(raw)
+        except Exception:  # noqa: BLE001 — load is advisory
+            pass
+        ranked.append((load, idx, address))
+    ranked.sort()
+    return [addr for _, _, addr in ranked]
+
+
+def pull_from_any(control_plane, object_id,
+                  client: Optional[ObjectTransferClient] = None,
+                  cache_store=None, on_cached=None) -> Any:
+    """Resolve `object_transfer/*` advertisements from the control plane
+    and try holders in ascending gossiped-load order until one serves the
+    object. The unranked remainder is offered to the client as striping
+    peers for large chunked pulls.
+
+    With `cache_store`, the pull fetches the sealed payload and seals it
+    into that (local) store before returning the loaded value — the
+    pull-through replica. `on_cached(object_id)` then fires so the caller
+    can register the new location in its directory; both steps are
+    best-effort and never fail the get (objects are immutable once sealed,
+    so a cached replica can never go stale)."""
+    client = client or _shared_client()
+    errors = []
+    want_raw = cache_store is not None
+    holders = _ranked_holders(control_plane)
+    for pos, address in enumerate(holders):
+        peers = holders[pos + 1:] + holders[:pos]
         # two attempts per holder, but ONLY for transport-class failures:
         # the shared client pools connections, so the first failure after
         # a holder restart (or an idle conn being dropped) is just the
@@ -586,13 +940,25 @@ def pull_from_any(control_plane, object_id,
         # across a large fleet.
         for attempt in (0, 1):
             try:
-                return client.pull(address, object_id)
+                value = client.pull(address, object_id, raw=want_raw,
+                                    peers=peers)
             except ObjectPullConnectionError as e:
                 if attempt == 1:
                     errors.append((address, str(e)))
+                continue
             except ObjectPullError as e:
                 errors.append((address, str(e)))
                 break
+            if not want_raw:
+                return value
+            try:
+                cache_store.put(object_id, value)
+                if on_cached is not None:
+                    on_cached(object_id)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                logger.debug("pull-through cache of %s failed", object_id,
+                             exc_info=True)
+            return value.load() if isinstance(value, SealedBytes) else value
     raise ObjectPullError(
         f"no advertised holder served {object_id}: {errors}"
     )

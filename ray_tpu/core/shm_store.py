@@ -155,6 +155,12 @@ class ShmObjectStore:
             raise ShmStoreError(
                 f"cannot {'create' if create else 'open'} shm store {self.name}"
             )
+        # parity with MemoryObjectStore.on_evict: fires on explicit delete
+        # so directory locations can be deregistered. C-side LRU eviction
+        # inside the arena is NOT observable from Python, so hook users
+        # must tolerate stale advertisements (pullers fall through the
+        # ranked holder list on a miss).
+        self.on_evict = None
 
     # -- raw byte API --------------------------------------------------------
 
@@ -227,7 +233,14 @@ class ShmObjectStore:
         h = self._h
         if not h:
             return False
-        return self._lib.shm_obj_delete(h, _check_id(object_id)) == 0
+        deleted = self._lib.shm_obj_delete(h, _check_id(object_id)) == 0
+        on_evict = self.on_evict
+        if deleted and on_evict is not None:
+            try:
+                on_evict(object_id)
+            except Exception:  # noqa: BLE001 — hooks never fail a delete
+                pass
+        return deleted
 
     def contains(self, object_id: bytes) -> bool:
         h = self._h

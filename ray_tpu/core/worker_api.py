@@ -45,9 +45,10 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .config import config
 from .ids import ActorID, NodeID, ObjectID, TaskID
 from .logging import get_logger
-from .object_store import ObjectLostError, seal_value
+from .object_store import ObjectLostError, SealedBytes, seal_value
 from .rpc import RemoteControlPlane
 from .wire import WireError
 
@@ -343,6 +344,14 @@ class WorkerAPIClient:
 
     def _pull_ready(self, oid: ObjectID, h: str, stale_pulls: Dict[str, int],
                     deadline: Optional[float]) -> Tuple[Any, bool]:
+        from .object_transfer import _cache_hits, _cache_misses
+
+        if self._local_store is not None and self._local_store.contains(oid):
+            # pull-through cache hit: a prior get on this host already
+            # sealed the object locally (objects are immutable, so the
+            # replica is as good as the origin)
+            _cache_hits.inc()
+            return self._local_store.get(oid, timeout=10.0), True
         holder = self._directory.locate(oid)
         if holder is None:
             # ready but no location: sealed value lost (holder died) or
@@ -353,6 +362,22 @@ class WorkerAPIClient:
                 return self._get_via_head(oid, deadline), True
             return None, False
         try:
+            if (self._local_store is not None
+                    and self._local_node_id is not None
+                    and config.object_pull_through_cache):
+                # seal the pulled payload locally and advertise the
+                # location: repeat gets stay on-host, and OTHER hosts can
+                # pull from us instead of the origin. Best-effort: any
+                # cache failure degrades to returning the value.
+                _cache_misses.inc()
+                raw = holder.store.get_raw(oid, timeout=10.0)
+                try:
+                    self._local_store.put(oid, raw)
+                    self._directory.add_location(oid, self._local_node_id)
+                except Exception:  # noqa: BLE001 — caching never fails a get
+                    pass
+                return (raw.load() if isinstance(raw, SealedBytes)
+                        else raw), True
             return holder.store.get(oid, timeout=10.0), True
         except (TimeoutError, ObjectLostError):
             stale_pulls[h] = stale_pulls.get(h, 0) + 1

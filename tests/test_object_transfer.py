@@ -71,7 +71,11 @@ class TestPull:
         oids = list(store.object_ids())
         vals = sorted(client.pull(server.address, o) for o in oids)
         assert vals == [0, 11, 22, 33, 44]
-        assert len(client._conns) == 1  # one pooled connection
+        # serial pulls ride ONE pooled connection — the pool only grows
+        # when pulls overlap
+        pool = client._pools[server.address]
+        assert len(pool._slots) == 1
+        assert pool.idle_count() == 1
 
 
 class TestAdvertisement:
@@ -225,3 +229,253 @@ class TestNativePath:
             # whichever side committed, handles are now torn down
             assert client._plane.native is None and client._plane.staging is None
             assert server._plane.native is None and server._plane.staging is None
+
+
+class TestConnectionPool:
+    def test_concurrent_pulls_grow_pool_to_cap(self, served_store):
+        import threading
+
+        store, server, _ = served_store
+        client = ObjectTransferClient(pool_conns=2)
+        try:
+            oids = []
+            for i in range(8):
+                oid = _oid(i)
+                store.put(oid, list(range(2000)))
+                oids.append(oid)
+            results, errors = [], []
+
+            def pull(o):
+                try:
+                    results.append(client.pull(server.address, o))
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=pull, args=(o,))
+                       for o in oids]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            assert len(results) == 8
+            # the pool never exceeds its cap no matter the concurrency
+            pool = client._pools[server.address]
+            assert len(pool._slots) <= 2
+        finally:
+            client.close()
+
+    def test_close_under_concurrent_pull_leaks_no_fds(self, monkeypatch):
+        """Regression: close() racing in-flight pulls must account for
+        every socket the client ever dialed — none may stay open."""
+        import socket as socket_mod
+        import threading
+
+        import ray_tpu.core.object_transfer as ot
+
+        created = []
+        real_create = socket_mod.create_connection
+
+        def tracking_create(*args, **kwargs):
+            s = real_create(*args, **kwargs)
+            created.append(s)
+            return s
+
+        monkeypatch.setattr(ot.socket, "create_connection", tracking_create)
+        store = MemoryObjectStore()
+        server = ObjectTransferServer(store)
+        arr = np.arange(300_000, dtype=np.float64)
+        oids = []
+        for i in range(4):
+            oid = _oid(i)
+            store.put(oid, arr)
+            oids.append(oid)
+        try:
+            for _ in range(3):
+                client = ot.ObjectTransferClient(pool_conns=2)
+
+                def pull_quiet(o):
+                    try:
+                        client.pull(server.address, o)
+                    except (ObjectPullError, Exception):  # noqa: BLE001
+                        pass  # close() racing the pull is the point
+
+                threads = [threading.Thread(target=pull_quiet, args=(o,))
+                           for o in oids]
+                for t in threads:
+                    t.start()
+                time.sleep(0.01)
+                client.close()
+                for t in threads:
+                    t.join(timeout=30)
+                    assert not t.is_alive()
+        finally:
+            server.stop()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(s.fileno() == -1 for s in created):
+                break
+            time.sleep(0.02)
+        leaked = [s for s in created if s.fileno() != -1]
+        assert not leaked, f"{len(leaked)} of {len(created)} sockets leaked"
+
+    def test_pull_after_close_raises(self, served_store):
+        store, server, _ = served_store
+        client = ObjectTransferClient()
+        oid = _oid()
+        store.put(oid, 7)
+        client.close()
+        from ray_tpu.core.object_transfer import ObjectPullConnectionError
+
+        with pytest.raises(ObjectPullConnectionError):
+            client.pull(server.address, oid)
+
+
+class TestPipelinedChunks:
+    def test_windowed_chunk_pull_matches(self, monkeypatch):
+        """Chunked path with a request window >1 must reassemble exactly;
+        force the chunked path by shrinking the staging arena."""
+        import ray_tpu.core.object_transfer as ot
+
+        monkeypatch.setattr(ot, "STAGING_BYTES", 1 << 20)
+        store = MemoryObjectStore()
+        server = ot.ObjectTransferServer(store)
+        client = ot.ObjectTransferClient(chunk_bytes=128 * 1024,
+                                         chunk_window=6)
+        try:
+            arr = np.arange(400_000, dtype=np.float64)  # ~3MB, ~24 chunks
+            oid = _oid()
+            store.put(oid, arr)
+            out = client.pull(server.address, oid)
+            np.testing.assert_array_equal(out, arr)
+        finally:
+            client.close()
+            server.stop()
+
+    def test_window_of_one_still_works(self, monkeypatch):
+        import ray_tpu.core.object_transfer as ot
+
+        monkeypatch.setattr(ot, "STAGING_BYTES", 1 << 20)
+        store = MemoryObjectStore()
+        server = ot.ObjectTransferServer(store)
+        client = ot.ObjectTransferClient(chunk_bytes=256 * 1024,
+                                         chunk_window=1)
+        try:
+            arr = np.arange(300_000, dtype=np.float64)
+            oid = _oid()
+            store.put(oid, arr)
+            np.testing.assert_array_equal(
+                client.pull(server.address, oid), arr)
+        finally:
+            client.close()
+            server.stop()
+
+
+class TestStriping:
+    def test_large_pull_stripes_across_two_holders(self, monkeypatch):
+        """With two advertised holders and a large object, the chunked
+        path splits byte ranges across both and reassembles exactly."""
+        import ray_tpu.core.object_transfer as ot
+
+        monkeypatch.setattr(ot, "STAGING_BYTES", 1 << 20)
+        monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_STRIPE_MIN_BYTES",
+                           str(1 << 20))
+        store = MemoryObjectStore()
+        server_a = ot.ObjectTransferServer(store)
+        server_b = ot.ObjectTransferServer(store)  # same store: replica
+        client = ot.ObjectTransferClient(chunk_bytes=128 * 1024)
+        try:
+            arr = np.arange(500_000, dtype=np.float64)  # ~4MB
+            oid = _oid()
+            store.put(oid, arr)
+            out = client.pull(server_a.address, oid,
+                              peers=[server_b.address])
+            np.testing.assert_array_equal(out, arr)
+            # both holders served requests
+            assert server_b.address in client._pools
+        finally:
+            client.close()
+            server_a.stop()
+            server_b.stop()
+
+    def test_striping_falls_back_when_peer_lacks_object(self, monkeypatch):
+        import ray_tpu.core.object_transfer as ot
+
+        monkeypatch.setattr(ot, "STAGING_BYTES", 1 << 20)
+        monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_STRIPE_MIN_BYTES",
+                           str(1 << 20))
+        store = MemoryObjectStore()
+        empty = MemoryObjectStore()
+        server_a = ot.ObjectTransferServer(store)
+        server_b = ot.ObjectTransferServer(empty)  # does NOT hold it
+        client = ot.ObjectTransferClient(chunk_bytes=128 * 1024)
+        try:
+            arr = np.arange(500_000, dtype=np.float64)
+            oid = _oid()
+            store.put(oid, arr)
+            out = client.pull(server_a.address, oid,
+                              peers=[server_b.address])
+            np.testing.assert_array_equal(out, arr)
+        finally:
+            client.close()
+            server_a.stop()
+            server_b.stop()
+
+
+class TestLoadRanking:
+    def test_load_method_reports_outstanding(self, served_store):
+        store, server, client = served_store
+        assert client._call(server.address, "load") >= 0
+
+    def test_pull_from_any_prefers_least_loaded(self, ray_start_regular):
+        """Holders rank by gossiped load: the busy holder loses to the
+        idle one even though it was advertised first."""
+        from ray_tpu.core.object_transfer import LOAD_PREFIX, _ranked_holders
+
+        rt = ray_start_regular
+        cp = rt.control_plane
+        cp.kv_put(KV_PREFIX + "aa", "127.0.0.1:1111")
+        cp.kv_put(KV_PREFIX + "bb", "127.0.0.1:2222")
+        cp.kv_put(LOAD_PREFIX + "aa", "5")
+        cp.kv_put(LOAD_PREFIX + "bb", "0")
+        assert _ranked_holders(cp) == ["127.0.0.1:2222", "127.0.0.1:1111"]
+
+    def test_gossip_publishes_load_key(self, ray_start_regular):
+        from ray_tpu.core.object_transfer import LOAD_PREFIX
+
+        rt = ray_start_regular
+        server = serve_object_transfer(rt)
+        try:
+            ref = ray_tpu.put(np.arange(32))
+            pull_from_any(rt.control_plane, ref.object_id)
+            node_hex = rt.driver_agent.node_id.hex()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if rt.control_plane.kv_get(LOAD_PREFIX + node_hex) is not None:
+                    break
+                time.sleep(0.05)
+            assert rt.control_plane.kv_get(LOAD_PREFIX + node_hex) is not None
+        finally:
+            server.stop()
+
+
+class TestPullThroughCache:
+    def test_pull_from_any_seals_into_cache_store(self, ray_start_regular):
+        rt = ray_start_regular
+        server = serve_object_transfer(rt)
+        local = MemoryObjectStore()
+        cached = []
+        try:
+            arr = np.arange(10_000)
+            ref = ray_tpu.put(arr)
+            out = pull_from_any(rt.control_plane, ref.object_id,
+                                cache_store=local,
+                                on_cached=cached.append)
+            np.testing.assert_array_equal(out, arr)
+            assert local.contains(ref.object_id)
+            assert cached == [ref.object_id]
+            # the cached replica is the SEALED payload: a fresh get loads
+            # an equal value
+            np.testing.assert_array_equal(local.get(ref.object_id), arr)
+        finally:
+            server.stop()
