@@ -36,7 +36,7 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all tsan shm bench-data bench-object
+.PHONY: check check-slow check-all chaos tsan shm bench-data bench-object
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -64,6 +64,13 @@ check: shm
 check-slow:
 	@echo "== slow tier =="
 	$(PYTEST) -m slow tests/
+
+# fault-injection tier (head/worker SIGKILLs, partitions). The chaos tests
+# are also marked slow, so check-slow runs them in CI; this target runs
+# JUST them for iterating on fault-tolerance work.
+chaos:
+	@echo "== chaos tier =="
+	$(PYTEST) -m chaos tests/
 
 check-all: check check-slow
 

@@ -1,0 +1,340 @@
+"""Head-death chaos: SIGKILL the head mid-gang-train, restart it from the
+latest control-plane snapshot, and prove the joined worker hosts ride it
+out WITHOUT being restarted.
+
+Three roles in one file (the supervisor spawns the other two):
+
+- supervisor (default): picks a fixed port, spawns the phase-1 head,
+  spawns N worker hosts against it, waits for the first checkpoint to
+  land on disk, `chaos.kill_head()`s the head, then spawns the phase-2
+  head with ``resume_from`` the snapshot. Asserts the worker processes
+  never exited (same PIDs end to end).
+- head1: serves the control plane on the fixed port with snapshotting
+  on a tight interval, parks a probe object on a worker host (its id in
+  the KV, which IS snapshotted), and starts a JaxTrainer gang over all
+  hosts — it is killed mid-fit.
+- head2: restarts on the SAME port with ``resume_from``, waits for every
+  worker to reconnect + re-register (their RemoteControlPlane clients
+  back off and re-dial; `_rejoin` re-puts addresses, re-advertises held
+  objects, re-registers NodeInfo), proves the probe object was
+  re-advertised into the rebuilt directory, then resumes the gang from
+  the latest on-disk checkpoint to completion.
+
+Markers on stdout (asserted by tests/test_head_chaos.py): HEAD-UP,
+PROBE-SET, HEAD2-UP, NODES-REJOINED, PROBE-RELOCATED, HEAD-CHAOS-OK.
+
+Usage:
+    python examples/head_chaos.py --workers 3 --steps 6
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+from pod_cluster import train_func  # noqa: E402 — also sets the CPU-sim env
+
+import ray_tpu  # noqa: E402
+
+MARK = dict(flush=True)
+
+
+def _wait_nodes(rt, n, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(rt.control_plane.alive_nodes()) >= n:
+            return
+        time.sleep(0.2)
+    raise AssertionError(
+        f"only {len(rt.control_plane.alive_nodes())} of {n} nodes up")
+
+
+def _trainer(args, storage):
+    from ray_tpu import data
+    from ray_tpu.train import (
+        CheckpointConfig,
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    world = args.workers + 1
+    rows_per_rank = args.steps * (args.seq_len + 1)
+    ds = data.range(world * rows_per_rank, parallelism=world).map_batches(
+        lambda b: {"id": b["id"]}
+    )
+    resume = None
+    trial_dir = os.path.join(storage, "head-chaos")
+    ckpts = sorted(
+        (d for d in (os.listdir(trial_dir) if os.path.isdir(trial_dir) else [])
+         if d.startswith("ckpt-") and os.path.exists(
+             os.path.join(trial_dir, d, ".ray_tpu_checkpoint.json"))),
+        key=lambda d: int(d.split("-")[1]),
+    )
+    if ckpts:
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        resume = Checkpoint.from_directory(os.path.join(trial_dir, ckpts[-1]))
+        print(f"resuming gang from {ckpts[-1]}", **MARK)
+    return JaxTrainer(
+        train_func,
+        train_loop_config={
+            "total_steps": args.steps,
+            "seq_len": args.seq_len,
+            "checkpoint_every": 2,
+            # keep steps slow enough that the SIGKILL lands mid-train
+            "step_delay": 0.5,
+        },
+        scaling_config=ScalingConfig(
+            num_workers=world,
+            resources_per_worker={"CPU": 1.0},
+            placement_strategy="STRICT_SPREAD",
+            distributed_bootstrap=True,
+            workers_in_process=False,
+        ),
+        run_config=RunConfig(
+            name="head-chaos",
+            storage_path=storage,
+            failure_config=FailureConfig(max_failures=1),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+        datasets={"train": ds},
+        resume_from_checkpoint=resume,
+    )
+
+
+def _init_head(args, resume):
+    sysconf = {
+        "control_plane_rpc_port": args.port,
+        "worker_processes": 0,
+        "control_plane_snapshot_path": args.snapshot,
+        "control_plane_snapshot_interval_s": 0.3,
+        # reap stale gang members from before the crash promptly, but not
+        # so fast that a slow rejoin gets reaped
+        "health_check_timeout_ms": 8000,
+    }
+    # gang members from the killed head's attempt may linger on the worker
+    # hosts holding resources: workers are provisioned with headroom (4
+    # CPUs for a 1-CPU gang member), so the resumed gang still places
+    return ray_tpu.init(
+        num_cpus=4, num_tpus=0, resources={"pod_host": 1.0},
+        system_config=sysconf,
+        resume_from=(args.snapshot if resume else None),
+    )
+
+
+@ray_tpu.remote(num_cpus=0, resources={"worker_host": 0.1})
+def _hold_probe():
+    # "worker_host" exists only on the joined hosts, never the head: the
+    # probe MUST land in a worker's store (a head-local object obviously
+    # can't prove the re-advertise path — it dies with the head)
+    return os.urandom(4096)
+
+
+def run_head1(args) -> int:
+    rt = _init_head(args, resume=False)
+    print("HEAD-UP", **MARK)
+    _wait_nodes(rt, args.workers + 1, 120)
+    ref = _hold_probe.remote()
+    ray_tpu.get(ref, timeout=60)
+    rt.control_plane.kv_put("chaos/probe_oid",
+                            ref.object_id.hex().encode())
+    print("PROBE-SET", **MARK)
+    globals()["_probe_ref"] = ref  # pin until SIGKILL
+    _trainer(args, args.storage).fit()
+    # unreachable in the chaos run: the supervisor kills this process
+    return 0
+
+
+def run_head2(args) -> int:
+    from ray_tpu.core.ids import ObjectID
+
+    world = args.workers + 1
+    rt = _init_head(args, resume=True)
+    print("HEAD2-UP", **MARK)
+    # the surviving workers' clients are re-dialing this port; their
+    # _rejoin re-puts addresses and re-registers — no worker restart
+    _wait_nodes(rt, world, 90)
+    print("NODES-REJOINED", **MARK)
+    probe_hex = rt.control_plane.kv_get("chaos/probe_oid")
+    assert probe_hex, "KV did not survive the snapshot restore"
+    oid = ObjectID.from_hex(probe_hex.decode())
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if rt.directory.locations(oid):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("probe object never re-advertised after rejoin")
+    print("PROBE-RELOCATED", **MARK)
+    result = _trainer(args, args.storage).fit()
+    assert result.error is None, f"resumed training failed: {result.error}"
+    hist = result.metrics_history
+    assert hist[-1]["step"] == args.steps - 1, hist[-1]
+    resumed = [h for h in hist if h.get("start_step", 0) > 0]
+    assert resumed, f"gang restarted from scratch, not the checkpoint: {hist}"
+    print(json.dumps({"world": world, "steps": len(hist),
+                      "resume_step": resumed[0]["start_step"]}), **MARK)
+    ray_tpu.shutdown()
+    print("HEAD-CHAOS-OK", **MARK)
+    return 0
+
+
+def _spawn_worker(addr: str, tag: str, log_dir: str) -> subprocess.Popen:
+    code = textwrap.dedent(f"""
+        import ray_tpu
+        w = ray_tpu.init(address={addr!r}, num_cpus=4, num_tpus=0,
+                         resources={{"pod_host": 1.0, "worker_host": 1.0}})
+        w.wait(timeout=900)
+    """)
+    log = open(os.path.join(log_dir, f"head_chaos_worker_{tag}.log"), "w")
+    env = dict(os.environ)
+    # gang members unpickle train_func by reference (pod_cluster module) —
+    # the worker hosts and their actor processes must be able to import it
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    return subprocess.Popen(
+        [sys.executable, "-c", code], env=env,
+        stdout=log, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _spawn_head(args, role: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", role,
+         "--workers", str(args.workers), "--steps", str(args.steps),
+         "--seq-len", str(args.seq_len), "--port", str(args.port),
+         "--snapshot", args.snapshot, "--storage", args.storage],
+        env=dict(os.environ), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _drain(proc: subprocess.Popen, prefix: str) -> threading.Thread:
+    """Echo a child's stdout so its traceback is visible (and so it can
+    never block on a full pipe once the supervisor stops _await_marker-ing)."""
+    def pump():
+        for line in proc.stdout:
+            sys.stdout.write(prefix + line)
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def _await_marker(proc: subprocess.Popen, marker: str, timeout: float) -> None:
+    import select
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        # select before readline: a silent child must not pin us past the
+        # deadline on a blocking read
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not ready:
+            if proc.poll() is not None:
+                raise AssertionError(f"head exited before {marker!r}")
+            continue
+        line = proc.stdout.readline()
+        if line:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+            if marker in line:
+                return
+        elif proc.poll() is not None:
+            raise AssertionError(f"head exited before {marker!r}")
+    raise AssertionError(f"never saw {marker!r} within {timeout}s")
+
+
+def run_supervisor(args) -> int:
+    from ray_tpu.util import chaos
+
+    work = tempfile.mkdtemp(prefix="head_chaos_")
+    args.snapshot = os.path.join(work, "cp.snap")
+    args.storage = os.path.join(work, "train")
+    with socket.socket() as s:  # fixed port both head incarnations share
+        s.bind(("127.0.0.1", 0))
+        args.port = s.getsockname()[1]
+    addr = f"127.0.0.1:{args.port}"
+
+    head1 = _spawn_head(args, "head1")
+    _await_marker(head1, "HEAD-UP", 90)
+    workers = [_spawn_worker(addr, str(i), work) for i in range(args.workers)]
+    _await_marker(head1, "PROBE-SET", 120)
+    worker_pids = [w.pid for w in workers]
+    print(f"supervisor: workers up (pids {worker_pids}); training started",
+          **MARK)
+    _drain(head1, "[head1] ")
+
+    # kill the head only once a checkpoint is durably on disk
+    trial_dir = os.path.join(args.storage, "head-chaos")
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        try:
+            ckpts = [d for d in os.listdir(trial_dir)
+                     if d.startswith("ckpt-") and os.path.exists(
+                         os.path.join(trial_dir, d,
+                                      ".ray_tpu_checkpoint.json"))]
+        except OSError:
+            ckpts = []
+        if ckpts:
+            break
+        if head1.poll() is not None:
+            raise AssertionError("head1 exited before the first checkpoint")
+        time.sleep(0.3)
+    else:
+        raise AssertionError("no checkpoint within 180s")
+    print(f"supervisor: checkpoint {sorted(ckpts)[-1]} on disk — "
+          f"SIGKILLing head pid {head1.pid} mid-train", **MARK)
+    chaos.kill_head(head1)
+    time.sleep(1.0)  # let worker clients notice and enter reconnect mode
+
+    head2 = _spawn_head(args, "head2")
+    try:
+        _await_marker(head2, "HEAD-CHAOS-OK", 300)
+        # the whole point: the SAME worker processes served both heads
+        assert [w.pid for w in workers] == worker_pids
+        for w in workers:
+            assert w.poll() is None, "a worker host died across the restart"
+        print("SUPERVISOR-OK", **MARK)
+        return 0
+    finally:
+        if head2.poll() is None:
+            head2.kill()
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+            try:
+                w.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                w.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default="supervisor",
+                    choices=["supervisor", "head1", "head2"])
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--snapshot", default="")
+    ap.add_argument("--storage", default="")
+    args = ap.parse_args()
+    if args.role == "head1":
+        return run_head1(args)
+    if args.role == "head2":
+        return run_head2(args)
+    return run_supervisor(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

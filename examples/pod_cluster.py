@@ -245,7 +245,9 @@ def main() -> int:
                 victim = procs[0]
                 print(f"checkpoint {sorted(ckpts)[-1]} on disk; "
                       f"SIGKILLing worker host pid={victim.pid}")
-                victim.kill()
+                from ray_tpu.util import chaos
+
+                chaos.kill_worker_host(victim)
                 killer_state["killed"] = True
                 time.sleep(1.0)
                 print("spawning replacement worker host")

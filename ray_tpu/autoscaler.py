@@ -164,8 +164,9 @@ class SubprocessNodeProvider(NodeProvider):
         self._nodes.pop(node_id, None)
         graceful = nid is not None and nid in self.runtime.agents
         if graceful:
-            # remove_node stops the proxy, which tells the worker to exit
-            self.runtime.remove_node(nid)
+            # deliberate scale-down: notify so the worker exits instead of
+            # treating the lost head connection as a restart and rejoining
+            self.runtime.remove_node(nid, notify=True)
         if proc is not None:
             try:
                 # short grace only when the worker was actually told to

@@ -94,6 +94,17 @@ def init_distributed(
         return
     import jax
 
+    # CPU-simulated pods (JAX_PLATFORMS=cpu, one forced host device per
+    # process): jax's default cpu collectives impl is "none", which fails
+    # any cross-process computation at compile time. Gloo ships in jaxlib;
+    # opt in before the backend is created. Real TPU paths are untouched.
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in platforms.split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # jax version without the flag: keep the old behavior
+
     if coordinator_address is None:
         if process_id == 0:
             coordinator_address = publish_coordinator(gang_name)

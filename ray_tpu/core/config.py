@@ -152,6 +152,22 @@ declare(
     "another host.",
 )
 
+declare(
+    "control_plane_reconnect_max_s", 5.0,
+    "Cap on the exponential backoff between control-plane client "
+    "reconnect attempts after a lost connection (rpc.RemoteControlPlane): "
+    "attempts start at 50ms and double up to this bound, so a client "
+    "rides out a head restart instead of poisoning itself.",
+)
+declare(
+    "control_plane_call_deadline_s", 30.0,
+    "Default per-call deadline for RemoteControlPlane requests. Every "
+    "blocking call fails with the retryable ControlPlaneUnavailable "
+    "within this window; idempotent methods (heartbeat, kv_get, dir_*, "
+    "...) retry transparently across reconnects inside it, non-idempotent "
+    "ones surface the error to the caller.",
+)
+
 # Control-plane persistence (GCS-Redis analogue, file-backed)
 declare(
     "control_plane_snapshot_path", "",

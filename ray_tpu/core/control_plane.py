@@ -119,10 +119,18 @@ class ControlPlane:
     def register_node(self, info: NodeInfo) -> None:
         with self._lock:
             # the info may have crossed a process boundary: its monotonic
-            # heartbeat stamp is another clock's — restamp locally
+            # heartbeat stamp is another clock's — restamp locally. A
+            # rejoining host (falsely reaped, or head restarted) registers
+            # with the SAME node id: revive it rather than zombie it.
+            info.state = NodeState.ALIVE
             info.last_heartbeat = time.monotonic()
+            prev = self._nodes.get(info.node_id)
             self._nodes[info.node_id] = info
-        _nodes_gauge.add(1, {"state": "ALIVE"})
+        if prev is None:
+            _nodes_gauge.add(1, {"state": "ALIVE"})
+        elif prev.state is NodeState.DEAD:
+            _nodes_gauge.add(-1, {"state": "DEAD"})
+            _nodes_gauge.add(1, {"state": "ALIVE"})
         self.pubsub.publish("node", ("ALIVE", info))
 
     def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
@@ -131,6 +139,16 @@ class ControlPlane:
             if info is None or info.state is NodeState.DEAD:
                 return
             info.state = NodeState.DEAD
+            # purge the node's advertised addresses and transfer-load
+            # gossip: stale object_transfer_load/* keys would keep
+            # pull_from_any's least-loaded ranking preferring a corpse
+            # (prefix literals: object_transfer.KV_PREFIX/LOAD_PREFIX,
+            # cross_host.NODE_SERVICE_PREFIX, channels.KV_CHANNEL_PREFIX —
+            # spelled out here to avoid import cycles)
+            hexid = node_id.hex()
+            for prefix in ("object_transfer/", "object_transfer_load/",
+                           "node_service/", "channel_service/"):
+                self._kv.pop(prefix + hexid, None)
         _nodes_gauge.add(-1, {"state": "ALIVE"})
         _nodes_gauge.add(1, {"state": "DEAD"})
         logger.warning("node %s marked DEAD: %s", node_id, reason)

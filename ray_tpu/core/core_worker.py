@@ -385,8 +385,15 @@ class Runtime:
         self._kick_scheduler()
         return agent
 
-    def remove_node(self, node_id: NodeID) -> None:
-        """Simulate node failure (tests/chaos): tasks crash, objects are lost."""
+    def remove_node(self, node_id: NodeID, notify: bool = False) -> None:
+        """Drop a node: tasks crash, objects are lost.
+
+        notify=False (default, crash/reap semantics): a reaped REMOTE host
+        may only be partitioned — the stop frame would kill a survivor that
+        is about to rejoin. Clean worker exits still happen via
+        Runtime.shutdown's stop(), and local (in-process) agents ignore the
+        flag. notify=True is for DELIBERATE removal (autoscaler scale-down):
+        the stop frame tells the worker to exit instead of rejoining."""
         with self._lock:
             agent = self.agents.pop(node_id, None)
             if agent is not None and self.head_node_id == node_id:
@@ -394,9 +401,12 @@ class Runtime:
                 self.head_node_id = next(iter(self.agents), None)
         if agent is None:
             return
+        # stop before mark_node_dead: a notified worker must learn it was
+        # deliberately removed BEFORE its heartbeat sees the DEAD state, or
+        # it would race a rejoin against its own shutdown
+        agent.stop(notify=notify)
         self.control_plane.mark_node_dead(node_id, "removed")
         self.directory.unregister_agent(node_id)
-        agent.stop()
         # actors on that node die; restart-eligible ones are rescheduled
         for actor in self.control_plane.list_actors():
             if actor.node_id == node_id and actor.state is ActorState.ALIVE:

@@ -50,7 +50,7 @@ from .object_transfer import (
     ObjectTransferClient,
     ObjectTransferServer,
 )
-from .rpc import RemoteControlPlane
+from .rpc import ControlPlaneUnavailable, RemoteControlPlane
 from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
 
 logger = get_logger("cross_host")
@@ -677,13 +677,18 @@ class RemoteNodeAgent:
     def _sync_load(self) -> None:
         """No-op: the worker host heartbeats the control plane itself."""
 
-    def stop(self) -> None:
+    def stop(self, notify: bool = True) -> None:
+        """notify=False drops the proxy without telling the worker host to
+        exit: used when the head reaps a node on a stale heartbeat — the
+        host may only be partitioned and will rejoin, so sending the stop
+        frame would kill a survivor."""
         if self._stopped.is_set():
             return
-        try:
-            self._send("stop")
-        except (WorkerCrashedError, OSError):
-            pass
+        if notify:
+            try:
+                self._send("stop")
+            except (WorkerCrashedError, OSError):
+                pass
         self._fail_outstanding(WorkerCrashedError("node removed"))
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
@@ -722,6 +727,14 @@ def enable_cross_host(runtime) -> ObjectTransferServer:
 
     def on_node_event(event: Tuple[str, NodeInfo]) -> None:
         state, info = event
+        if state == "DEAD":
+            # drop the proxy so a rejoining host (same ID, re-register)
+            # dials fresh instead of reusing a dead socket. remove_node is
+            # idempotent (agent already popped -> early return) and its
+            # mark_node_dead on an already-DEAD node does not re-publish,
+            # so this cannot loop.
+            runtime.remove_node(info.node_id)
+            return
         if state != "ALIVE":
             return
         with runtime._lock:
@@ -751,6 +764,12 @@ def enable_cross_host(runtime) -> ObjectTransferServer:
         runtime._kick_scheduler()
 
     runtime.control_plane.pubsub.subscribe("node", on_node_event)
+    # catch-up sweep: the RPC server starts serving BEFORE this subscribe
+    # (api.init order), so a worker re-registering into a restarted head in
+    # that window would be ALIVE in the table but never dialed — replay
+    # registrations that raced in
+    for info in runtime.control_plane.alive_nodes():
+        on_node_event(("ALIVE", info))
     # workers block on object availability via this channel (obj_loc):
     # publish every directory add so RemoteDirectoryClient.subscribe_once
     # wakes without polling
@@ -1033,7 +1052,8 @@ class WorkerRuntime:
             node_host = config.node_host
 
         self.head_address = address
-        self.control_plane = RemoteControlPlane(address)
+        self._node_host = node_host
+        self.control_plane = RemoteControlPlane(address, role="worker")
         node_resources = default_node_resources(num_cpus, num_tpus, resources)
         self.info = NodeInfo(
             node_id=NodeID.generate(),
@@ -1061,6 +1081,10 @@ class WorkerRuntime:
         self.control_plane.kv_put(
             KV_CHANNEL_PREFIX + self.node_id.hex(), ensure_service(node_host))
         self.control_plane.register_node(self.info)
+        # head restart: the reconnected client has resubscribed pubsub, but
+        # the head's node table and object directory are not persisted —
+        # push our registration and held-object locations back
+        self.control_plane.add_reconnect_listener(self._rejoin)
         self._api_client = None
         self._api_client_lock = threading.Lock()
         # pool-worker children inherit this and build their own back-channel
@@ -1093,26 +1117,71 @@ class WorkerRuntime:
                 )
             return self._api_client
 
+    def _rejoin(self) -> None:
+        """Re-introduce this host to a restarted head: the snapshot restores
+        KV/jobs/named actors but deliberately NOT the node table or object
+        directory (restored liveness would be a lie — see persistence.py),
+        so the survivors rebuild both. Re-put the advertised addresses,
+        re-advertise every locally-held object, then register_node LAST —
+        the head's node-ALIVE handler resolves the KV addresses when it
+        dials back. Also the recovery path for a false reap (heartbeat
+        returned False): same sequence, same ordering constraint."""
+        if self._stopped.is_set():
+            return
+        from .channels import KV_CHANNEL_PREFIX, ensure_service
+
+        try:
+            nid = self.node_id.hex()
+            self.control_plane.kv_put(
+                NODE_SERVICE_PREFIX + nid, self.dispatch_server.address)
+            self.control_plane.kv_put(
+                KV_PREFIX + nid, self.transfer_server.address)
+            self.control_plane.kv_put(
+                KV_CHANNEL_PREFIX + nid, ensure_service(self._node_host))
+            held = self.agent.store.list_objects()
+            for oid, _nbytes in held:
+                self.control_plane.dir_add_location(oid.hex(), nid)
+            self.control_plane.register_node(self.info)
+            logger.info("re-registered with head at %s (%d objects "
+                        "re-advertised)", self.head_address, len(held))
+        except (ConnectionError, RuntimeError) as e:
+            # head flapped again mid-rejoin: the next reconnect (or the
+            # heartbeat loop seeing False) retries the whole sequence
+            logger.warning("rejoin attempt failed (%s); will retry", e)
+
     def _heartbeat_loop(self) -> None:
         period = config.health_check_period_ms / 1000.0
         while not self._stopped.is_set():
-            try:
-                alive = self.control_plane.heartbeat(
-                    self.node_id, self.agent.resources.available())
-            except (WireError, OSError, RuntimeError):
-                logger.warning("head unreachable; shutting worker down")
-                self.shutdown()
-                return
-            if alive is False:
-                # the head reaped us (e.g. a partition outlived the health
-                # timeout): stop serving rather than zombie on
-                logger.warning("head declared this node DEAD; shutting down")
-                self.shutdown()
-                return
+            # a stop request beats everything, including an unreachable
+            # head: the owner asked us to exit
             if self.dispatch_server.owner_requested_stop.is_set():
                 logger.info("head requested stop; shutting worker down")
                 self.shutdown()
                 return
+            try:
+                alive = self.control_plane.heartbeat(
+                    self.node_id, self.agent.resources.available(),
+                    _deadline_s=max(2.0, period))
+            except ControlPlaneUnavailable:
+                # head down or restarting: ride it out — the client is
+                # already reconnecting with backoff, and _rejoin fires on
+                # the reconnect listener
+                logger.warning("head unreachable; worker riding out the "
+                               "outage (reconnect in progress)")
+                self._stopped.wait(period)
+                continue
+            except (WireError, OSError, RuntimeError):
+                if self._stopped.is_set():
+                    return
+                self._stopped.wait(period)
+                continue
+            if alive is False:
+                # the head reaped us (partition outlived the health timeout)
+                # or restarted without our registration: re-register instead
+                # of zombie-ing on or dying — tasks we hold results for may
+                # still be wanted
+                logger.warning("head does not know this node; re-registering")
+                self._rejoin()
             self._stopped.wait(period)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -1136,9 +1205,16 @@ class WorkerRuntime:
                 self._api_client.close()
                 self._api_client = None
         try:
-            self.control_plane.kv_del(NODE_SERVICE_PREFIX + self.node_id.hex())
-            self.control_plane.kv_del(KV_PREFIX + self.node_id.hex())
-            self.control_plane.mark_node_dead(self.node_id, "worker shutdown")
+            # short deadlines: when the head is gone this is best-effort
+            # cleanup, not worth stalling shutdown for the full default
+            self.control_plane._call(
+                "kv_del", NODE_SERVICE_PREFIX + self.node_id.hex(),
+                _deadline_s=2.0)
+            self.control_plane._call(
+                "kv_del", KV_PREFIX + self.node_id.hex(), _deadline_s=2.0)
+            self.control_plane._call(
+                "mark_node_dead", self.node_id, "worker shutdown",
+                _deadline_s=2.0)
         except (WireError, OSError, RuntimeError):
             pass
         self.dispatch_server.stop()

@@ -304,6 +304,14 @@ class NodeAgent:
             ).start()
         # test hook: simulate a hung host (stops heartbeating, keeps running)
         self.suspend_heartbeat = False
+        # remote control plane: bound each monitor-sweep heartbeat tightly
+        # instead of the default call deadline (see _sync_load)
+        from .rpc import RemoteControlPlane
+
+        self._hb_kwargs = (
+            {"_deadline_s": max(2.0, config.health_check_period_ms / 1000.0)}
+            if isinstance(control_plane, RemoteControlPlane) else {}
+        )
 
     # ------------------------------------------------------------------ api
     def submit(self, spec: TaskSpec, done: DoneCallback,
@@ -908,8 +916,16 @@ class NodeAgent:
 
     # ------------------------------------------------------------- lifecycle
     def _sync_load(self) -> None:
-        if not self.suspend_heartbeat:
-            self._cp.heartbeat(self.node_id, self.resources.available())
+        if self.suspend_heartbeat:
+            return
+        try:
+            # short deadline when the control plane is remote: the head
+            # monitor loop pumps every agent serially, so one unreachable
+            # head must not stall the sweep for the full call deadline
+            self._cp.heartbeat(self.node_id, self.resources.available(),
+                               **self._hb_kwargs)
+        except (ConnectionError, RuntimeError):
+            pass  # head restarting; the next sweep retries
 
     def kill_running_tasks(self) -> None:
         """Failure injection: crash every task currently executing here."""
@@ -918,7 +934,10 @@ class NodeAgent:
         for e in events:
             e.set()
 
-    def stop(self) -> None:
+    def stop(self, notify: bool = True) -> None:
+        # notify is part of the RemoteNodeAgent duck surface (suppresses
+        # the remote stop frame); a local agent has no one to notify
+        del notify
         self._stopped.set()
         with self._pool_lock:
             pool, self._pool = self._pool, False

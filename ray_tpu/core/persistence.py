@@ -38,10 +38,22 @@ import cloudpickle
 
 from .config import config
 from .logging import get_logger
+from .metrics import Counter, Gauge
 
 logger = get_logger("persistence")
 
 SNAPSHOT_VERSION = 1
+
+# A silently-failing snapshot loop is a durability hole that only shows up
+# when the head dies: make it alertable instead of a log line.
+_snapshot_age = Gauge(
+    "control_plane_snapshot_age_seconds",
+    "Seconds since the last successful control-plane snapshot write",
+)
+_snapshot_failures = Counter(
+    "control_plane_snapshot_failures_total",
+    "Control-plane snapshot write attempts that raised",
+)
 
 
 def take_snapshot(runtime) -> Dict[str, Any]:
@@ -185,6 +197,7 @@ class SnapshotWriter:
         )
         self._stop = threading.Event()
         self._write_lock = threading.Lock()
+        self._last_ok = time.monotonic()  # age counts from writer birth
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="cp-snapshot"
         )
@@ -198,8 +211,12 @@ class SnapshotWriter:
         with self._write_lock:  # interval vs final write share a tmp path
             try:
                 write_snapshot(self._rt, self._path)
+                self._last_ok = time.monotonic()
+                _snapshot_age.set(0.0)
             except Exception:
                 logger.warning("control-plane snapshot failed", exc_info=True)
+                _snapshot_failures.inc()
+                _snapshot_age.set(time.monotonic() - self._last_ok)
 
     def stop(self, final_write: bool = True) -> None:
         """Stop the interval loop (joining any in-flight write) and take one

@@ -8,11 +8,24 @@ surface, so a Runtime on another host (or another OS process on the same
 host) can share one authority. Pubsub crosses the wire as pushed EVENT
 frames feeding the client's local Pubsub — subscribers are oblivious.
 
+Fault tolerance (reference: GCS-FT — Redis-backed tables plus client-side
+accessor resubscribe): the client survives head death. Connection loss
+triggers bounded exponential-backoff reconnect (config
+`control_plane_reconnect_max_s`); every call runs under a deadline
+(`control_plane_call_deadline_s`); idempotent methods retry transparently
+across reconnects, non-idempotent ones surface the retryable
+`ControlPlaneUnavailable`. On reconnect every channel in `_subscribed`
+re-registers server-side, so pubsub survives a head restart invisibly.
+Request/reply state is PER CONNECTION (`_Conn`): a straggler response from
+connection N can never satisfy a request issued on connection N+1, even
+though request ids restart at 1 on each connection.
+
 Threading model: one handler thread per connection (control-plane call
 rates are low; no need for an event loop), one push thread per subscribed
 client. The client proxy serializes request/response pairs over one
-socket with a lock and routes pushed events to its Pubsub from a reader
-thread.
+socket with a lock and routes pushed events to its Pubsub from a
+per-connection reader thread; a short-lived reconnect thread re-dials
+after a loss and exits once a connection is installed.
 """
 
 from __future__ import annotations
@@ -20,12 +33,20 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
-from typing import Any, Dict, Optional, Set
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
 
+from .config import config
 from .logging import get_logger
+from .metrics import Counter
 from .wire import MSG_EVENT, MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
 
 logger = get_logger("rpc")
+
+_reconnects_total = Counter(
+    "control_plane_reconnects_total",
+    "Control-plane client connections re-established after a loss, by role",
+)
 
 # the served surface (N1's public API): anything else is rejected
 _ALLOWED_METHODS: Set[str] = {
@@ -45,6 +66,37 @@ _ALLOWED_METHODS: Set[str] = {
     "proxy_keepalive", "proxy_submit_streaming",
 }
 
+# Methods safe to resend after an ambiguous connection loss (the reply may
+# have been lost AFTER the head applied the request): reads, liveness
+# refreshes, and set-semantics writes. Everything else (register_actor,
+# proxy_submit_*, ...) surfaces ControlPlaneUnavailable instead — a blind
+# resend could duplicate the mutation, so the caller decides.
+_IDEMPOTENT_METHODS: Set[str] = {
+    "heartbeat", "alive_nodes", "get_node", "all_nodes",
+    "get_actor", "get_named_actor", "list_actors", "list_jobs",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
+    "dir_add_location", "dir_remove_location", "dir_locations",
+    "subscribe",
+    "proxy_job_id", "proxy_ref_state", "proxy_keepalive", "proxy_free",
+    "proxy_pin", "proxy_get_value",
+}
+
+
+class ControlPlaneUnavailable(ConnectionError):
+    """Retryable: the control plane is unreachable (head down or
+    restarting) or the call's deadline elapsed before a reply landed.
+    Idempotent methods never raise this while the deadline allows a
+    retry; for non-idempotent methods the caller owns the retry decision
+    (the request MAY have been applied)."""
+
+
+class _ConnLost(Exception):
+    """Internal: the connection died before this call's reply arrived."""
+
+
+class _DeadlineExceeded(Exception):
+    """Internal: the per-call deadline elapsed while waiting for a reply."""
+
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
@@ -53,6 +105,7 @@ class _Handler(socketserver.BaseRequestHandler):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_lock = threading.Lock()
         unsubscribes = []
+        server._track(sock)
         try:
             while True:
                 msg_type, req = recv_msg(sock)
@@ -60,20 +113,26 @@ class _Handler(socketserver.BaseRequestHandler):
                     raise WireError(f"unexpected message type {msg_type}")
                 method = req.get("method", "")
                 if method == "subscribe":
-                    # push this channel's events to the client as EVENT frames
+                    # push this channel's events to the client as EVENT
+                    # frames; on the first push failure the subscription is
+                    # dropped immediately — a client that reconnects many
+                    # times must not accumulate dead sinks head-side until
+                    # the next request on this (gone) handler
                     channel = req["args"][0]
+                    unsub_cell: List[Callable[[], None]] = []
 
-                    def push(message, _ch=channel):
+                    def push(message, _ch=channel, _cell=unsub_cell):
                         try:
                             with send_lock:
                                 send_msg(sock, MSG_EVENT,
                                          {"channel": _ch, "message": message})
                         except OSError:
-                            pass  # client gone; reaped on next request
+                            if _cell:
+                                _cell[0]()
 
-                    unsubscribes.append(
-                        server.control_plane.pubsub.subscribe(channel, push)
-                    )
+                    unsub = server.control_plane.pubsub.subscribe(channel, push)
+                    unsub_cell.append(unsub)
+                    unsubscribes.append(unsub)
                     resp = {"id": req["id"], "ok": True, "value": True}
                 elif method not in _ALLOWED_METHODS:
                     resp = {"id": req["id"], "ok": False,
@@ -102,6 +161,7 @@ class _Handler(socketserver.BaseRequestHandler):
         except (WireError, OSError):
             pass  # client disconnected
         finally:
+            server._untrack(sock)
             for unsub in unsubscribes:
                 try:
                     unsub()
@@ -114,15 +174,28 @@ class ControlPlaneServer(socketserver.ThreadingTCPServer):
 
     daemon_threads = True
     allow_reuse_address = True
+    # handler threads are daemons blocked in recv: joining them on close
+    # would hang until every client disconnects — stop() severs them instead
+    block_on_close = False
 
     def __init__(self, control_plane, host: str = "127.0.0.1", port: int = 0):
         super().__init__((host, port), _Handler)
         self.control_plane = control_plane
+        self._conn_lock = threading.Lock()
+        self._conns: Set[socket.socket] = set()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True, name="cp-rpc-server"
         )
         self._thread.start()
         logger.info("control-plane RPC on %s:%d", *self.server_address)
+
+    def _track(self, sock: socket.socket) -> None:
+        with self._conn_lock:
+            self._conns.add(sock)
+
+    def _untrack(self, sock: socket.socket) -> None:
+        with self._conn_lock:
+            self._conns.discard(sock)
 
     @property
     def address(self) -> str:
@@ -132,6 +205,16 @@ class ControlPlaneServer(socketserver.ThreadingTCPServer):
     def stop(self) -> None:
         self.shutdown()
         self.server_close()
+        # sever established connections: a stopped head must look exactly
+        # like a dead one to its clients (their read loops wake with a
+        # WireError and begin reconnecting), and the handler threads exit
+        with self._conn_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 def serve_control_plane(control_plane, host: str = "127.0.0.1",
@@ -141,85 +224,276 @@ def serve_control_plane(control_plane, host: str = "127.0.0.1",
     return ControlPlaneServer(control_plane, host, port)
 
 
+class _Conn:
+    """One TCP connection's request/reply state. Replies land in THIS
+    connection's map only, so a stale response delivered after a reconnect
+    cannot be confused with a reply to a request on the new connection
+    (request ids restart at 1 per connection by design)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.next_id = 0
+        self.replies: Dict[int, Any] = {}
+        self.cv = threading.Condition()
+        self.dead = threading.Event()
+
+    def fail(self) -> None:
+        with self.cv:
+            self.dead.set()
+            self.cv.notify_all()
+
+    def close(self) -> None:
+        self.fail()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class RemoteControlPlane:
     """Client proxy with ControlPlane's duck-typed surface.
 
-    Method calls serialize over one socket; `pubsub.subscribe(channel, cb)`
+    Method calls serialize over one socket; `subscribe(channel, cb)`
     transparently registers a server-side push and dispatches EVENT frames
-    from a reader thread into a local Pubsub."""
+    from a reader thread into a local Pubsub. The connection self-heals
+    (see module docstring); callers observe at most a retryable
+    ControlPlaneUnavailable, bounded by the per-call deadline."""
 
-    def __init__(self, address: str, connect_timeout: float = 10.0):
+    def __init__(self, address: str, connect_timeout: float = 10.0,
+                 role: str = "client"):
         from .control_plane import Pubsub
 
-        host, _, port = address.rpartition(":")
-        self._sock = socket.create_connection((host, int(port)), connect_timeout)
-        # create_connection leaves its timeout on the socket: clear it, or
-        # an idle read loop dies with TimeoutError after connect_timeout
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
-        self._next_id = 0
-        self._replies: Dict[int, Any] = {}
-        self._reply_cv = threading.Condition()
+        self._address = address
+        self._connect_timeout = connect_timeout
+        self._role = role
         self.pubsub = Pubsub()
         self._subscribed: Set[str] = set()
+        self._sub_lock = threading.Lock()
         self._closed = threading.Event()
-        self._reader = threading.Thread(
-            target=self._read_loop, daemon=True, name="cp-rpc-client"
-        )
-        self._reader.start()
+        self._conn_cv = threading.Condition()
+        self._conn: Optional[_Conn] = None
+        self._reconnect_listeners: List[Callable[[], None]] = []
+        self.reconnect_count = 0
+        # the first dial is synchronous: an unreachable head at construction
+        # surfaces to the caller (join-time errors must not become silent
+        # background retries)
+        conn = self._dial()
+        with self._conn_cv:
+            self._conn = conn
+            self._conn_cv.notify_all()
 
-    # -- plumbing -----------------------------------------------------------
-    def _read_loop(self) -> None:
+    # -- connection lifecycle ------------------------------------------------
+    def _dial(self) -> _Conn:
+        host, _, port = self._address.rpartition(":")
+        sock = socket.create_connection((host, int(port)), self._connect_timeout)
+        # create_connection leaves its timeout on the socket: clear it, or
+        # an idle read loop dies with TimeoutError after connect_timeout
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock)
+        threading.Thread(
+            target=self._read_loop, args=(conn,), daemon=True,
+            name="cp-rpc-client",
+        ).start()
+        return conn
+
+    def _read_loop(self, conn: _Conn) -> None:
         try:
-            while not self._closed.is_set():
-                msg_type, payload = recv_msg(self._sock)
+            while True:
+                msg_type, payload = recv_msg(conn.sock)
                 if msg_type == MSG_EVENT:
                     self.pubsub.publish(payload["channel"], payload["message"])
                 elif msg_type == MSG_RESPONSE:
-                    with self._reply_cv:
-                        self._replies[payload["id"]] = payload
-                        self._reply_cv.notify_all()
+                    with conn.cv:
+                        conn.replies[payload["id"]] = payload
+                        conn.cv.notify_all()
         except Exception:  # noqa: BLE001 — ANY reader death must wake waiters
-            with self._reply_cv:
-                self._replies[-1] = None  # poison: wake waiters
-                self._closed.set()
-                self._reply_cv.notify_all()
+            pass
+        finally:
+            conn.close()
+            self._on_conn_lost(conn)
 
-    def _call(self, method: str, *args, **kwargs) -> Any:
-        with self._lock:
-            self._next_id += 1
-            req_id = self._next_id
-            send_msg(self._sock, MSG_REQUEST,
-                     {"id": req_id, "method": method,
-                      "args": args, "kwargs": kwargs})
-        with self._reply_cv:
-            while req_id not in self._replies:
+    def _on_conn_lost(self, conn: _Conn) -> None:
+        with self._conn_cv:
+            if self._conn is not conn:
+                return  # stale connection; the current one is healthy
+            self._conn = None
+            self._conn_cv.notify_all()
+        if self._closed.is_set():
+            return
+        logger.warning("control-plane connection to %s lost; reconnecting",
+                       self._address)
+        threading.Thread(
+            target=self._reconnect_loop, daemon=True, name="cp-rpc-reconnect"
+        ).start()
+
+    def _reconnect_loop(self) -> None:
+        backoff = 0.05
+        while not self._closed.is_set():
+            try:
+                conn = self._dial()
+            except OSError:
+                self._closed.wait(backoff)
+                backoff = min(backoff * 2,
+                              max(0.05, config.control_plane_reconnect_max_s))
+                continue
+            # re-register every subscribed channel BEFORE installing the
+            # connection, so pubsub resumes atomically with the reconnect
+            with self._sub_lock:
+                channels = list(self._subscribed)
+            try:
+                deadline = time.monotonic() + max(5.0, self._connect_timeout)
+                for ch in channels:
+                    self._roundtrip(conn, "subscribe", (ch,), {}, deadline)
+            except Exception:  # noqa: BLE001 — died mid-resubscribe: redial
+                conn.close()
+                self._closed.wait(backoff)
+                backoff = min(backoff * 2,
+                              max(0.05, config.control_plane_reconnect_max_s))
+                continue
+            with self._conn_cv:
                 if self._closed.is_set():
-                    raise WireError("control-plane connection lost")
-                self._reply_cv.wait(timeout=1.0)
-            resp = self._replies.pop(req_id)
-        if resp["ok"]:
-            return resp["value"]
-        if resp.get("exc") is not None:
-            raise resp["exc"]
-        raise RuntimeError(resp["error"])
+                    conn.close()
+                    return
+                if conn.dead.is_set():
+                    # the reader died BEFORE install, so its _on_conn_lost
+                    # saw a non-current conn and spawned nothing: installing
+                    # this corpse would strand the client with no reconnect
+                    # thread — retry the dial instead
+                    continue
+                self._conn = conn
+                self.reconnect_count += 1
+                self._conn_cv.notify_all()
+            _reconnects_total.inc(tags={"role": self._role})
+            logger.info(
+                "control-plane connection to %s re-established "
+                "(%d channels resubscribed)", self._address, len(channels))
+            for cb in list(self._reconnect_listeners):
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — listeners are best-effort
+                    logger.warning("reconnect listener failed", exc_info=True)
+            return
+
+    def add_reconnect_listener(self, cb: Callable[[], None]) -> Callable[[], None]:
+        """Run cb after every re-established connection (on the reconnect
+        thread) — the hook worker hosts use to re-register their NodeInfo
+        and re-advertise held objects. Returns a remover."""
+        self._reconnect_listeners.append(cb)
+
+        def remove() -> None:
+            try:
+                self._reconnect_listeners.remove(cb)
+            except ValueError:
+                pass
+
+        return remove
+
+    # -- plumbing -----------------------------------------------------------
+    def _wait_conn(self, deadline: float, method: str) -> _Conn:
+        with self._conn_cv:
+            while True:
+                if self._closed.is_set():
+                    raise WireError("control-plane client closed")
+                conn = self._conn
+                if conn is not None and not conn.dead.is_set():
+                    return conn
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ControlPlaneUnavailable(
+                        f"control plane at {self._address} unreachable: "
+                        f"{method!r} deadline exceeded")
+                self._conn_cv.wait(min(0.5, remaining))
+
+    def _roundtrip(self, conn: _Conn, method: str, args, kwargs,
+                   deadline: float) -> Any:
+        with conn.send_lock:
+            if conn.dead.is_set():
+                raise _ConnLost()
+            conn.next_id += 1
+            req_id = conn.next_id
+            try:
+                send_msg(conn.sock, MSG_REQUEST,
+                         {"id": req_id, "method": method,
+                          "args": args, "kwargs": kwargs})
+            except (WireError, OSError):
+                # close so the blocked reader wakes and triggers reconnect
+                # even when only the send path is broken (e.g. chaos drop)
+                conn.close()
+                raise _ConnLost() from None
+        with conn.cv:
+            while req_id not in conn.replies:
+                if conn.dead.is_set():
+                    raise _ConnLost()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise _DeadlineExceeded()
+                conn.cv.wait(min(0.5, remaining))
+            return conn.replies.pop(req_id)
+
+    def _call(self, method: str, *args, _deadline_s: Optional[float] = None,
+              **kwargs) -> Any:
+        """One RPC under a deadline. `_deadline_s` overrides the config
+        default (it is consumed here — never forwarded to the server)."""
+        if _deadline_s is None:
+            _deadline_s = config.control_plane_call_deadline_s
+        deadline = time.monotonic() + _deadline_s
+        retryable = method in _IDEMPOTENT_METHODS
+        while True:
+            conn = self._wait_conn(deadline, method)
+            try:
+                resp = self._roundtrip(conn, method, args, kwargs, deadline)
+            except _ConnLost:
+                if retryable:
+                    continue  # _wait_conn enforces the deadline
+                raise ControlPlaneUnavailable(
+                    f"control-plane connection lost during non-idempotent "
+                    f"{method!r}; the request may or may not have been "
+                    f"applied — the caller owns the retry") from None
+            except _DeadlineExceeded:
+                raise ControlPlaneUnavailable(
+                    f"control-plane call {method!r} exceeded its "
+                    f"{_deadline_s:.1f}s deadline") from None
+            if resp["ok"]:
+                return resp["value"]
+            if resp.get("exc") is not None:
+                raise resp["exc"]
+            raise RuntimeError(resp["error"])
 
     def subscribe(self, channel: str, callback) -> Any:
         """Subscribe via the local pubsub, lazily registering the remote
-        push for this channel."""
-        if channel not in self._subscribed:
-            self._call("subscribe", channel)
+        push for this channel. The channel is recorded FIRST: if the head
+        is unreachable right now, the reconnect path registers it as soon
+        as a connection lands, so the subscription still takes effect."""
+        with self._sub_lock:
+            first = channel not in self._subscribed
             self._subscribed.add(channel)
+        if first:
+            try:
+                # short deadline: if the head is down, don't park the caller
+                # for the full default — the reconnect path registers the
+                # channel anyway
+                self._call("subscribe", channel, _deadline_s=5.0)
+            except ControlPlaneUnavailable:
+                logger.warning(
+                    "subscribe(%r) deferred: head unreachable (will "
+                    "register on reconnect)", channel)
         return self.pubsub.subscribe(channel, callback)
 
     def close(self) -> None:
+        if self._closed.is_set():
+            return
         self._closed.set()
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self._sock.close()
+        with self._conn_cv:
+            conn, self._conn = self._conn, None
+            self._conn_cv.notify_all()
+        if conn is not None:
+            conn.close()
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

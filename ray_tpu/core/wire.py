@@ -28,7 +28,7 @@ from __future__ import annotations
 import pickle
 import socket
 import struct
-from typing import Any, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 WIRE_VERSION = 1
 
@@ -44,7 +44,22 @@ class WireError(ConnectionError):
     pass
 
 
+# Chaos fault-injection hook (ray_tpu.util.chaos): when set, consulted
+# before every frame send/recv in THIS process. Raising OSError simulates
+# a partition at the RPC socket layer; sleeping simulates link delay.
+_fault_injector: Optional[Callable[[socket.socket, str], None]] = None
+
+
+def set_fault_injector(fn: Optional[Callable[[socket.socket, str], None]]) -> None:
+    """Install (or clear, with None) the process-wide wire fault hook."""
+    global _fault_injector
+    _fault_injector = fn
+
+
 def send_msg(sock: socket.socket, msg_type: int, payload: Any) -> None:
+    inj = _fault_injector
+    if inj is not None:
+        inj(sock, "send")
     body = pickle.dumps(payload, protocol=5)
     if len(body) + 2 > _MAX_FRAME:
         raise WireError(f"frame too large: {len(body)} bytes")
@@ -64,6 +79,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def recv_msg(sock: socket.socket) -> Tuple[int, Any]:
     """-> (msg_type, payload). Raises WireError on close/corruption."""
+    inj = _fault_injector
+    if inj is not None:
+        inj(sock, "recv")
     header = _recv_exact(sock, _HEADER.size)
     length, version, msg_type = _HEADER.unpack(header)
     if version != WIRE_VERSION:

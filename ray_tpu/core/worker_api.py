@@ -187,7 +187,12 @@ class WorkerAPIClient:
                     self._cp.proxy_keepalive(self.client_id)
                     last_beat = time.monotonic()
             except (WireError, OSError, RuntimeError):
-                return  # head gone: nothing left to free against
+                if self.is_shutdown:
+                    return
+                # head restarting: drop this batch (a restarted head has no
+                # pins for us anyway) and keep the thread alive so frees
+                # and keepalives resume once the client reconnects
+                continue
 
     def _enqueue_free(self, oid: ObjectID) -> None:
         if not self.is_shutdown:
@@ -394,9 +399,14 @@ class WorkerAPIClient:
         import pickle
 
         rem = 30.0 if deadline is None else max(1.0, deadline - time.monotonic())
+        wait_s = min(rem, 60.0)
         cp = RemoteControlPlane(self.head_address)
         try:
-            blob = cp.proxy_get_value(oid.hex(), min(rem, 60.0))
+            # the server parks up to wait_s before replying: the call
+            # deadline must outlast it or every slow resolve would abort
+            # as ControlPlaneUnavailable at the config default
+            blob = cp._call("proxy_get_value", oid.hex(), wait_s,
+                            _deadline_s=wait_s + 10.0)
         finally:
             cp.close()
         return pickle.loads(blob)
@@ -435,8 +445,9 @@ class WorkerAPIClient:
 
     @property
     def is_alive(self) -> bool:
-        """False once close()d OR the head connection dropped (read loop
-        died) — callers caching a client must rebuild on either."""
+        """False only once close()d: a dropped head connection now heals
+        itself (rpc.RemoteControlPlane reconnects), so cached clients stay
+        valid across a head restart."""
         return not self.is_shutdown and not self._cp._closed.is_set()
 
     # --------------------------------------------------------------- misc

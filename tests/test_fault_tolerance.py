@@ -130,3 +130,57 @@ class TestActorMethodOptions:
         a = A.remote()
         with pytest.raises(TypeError):
             a.m.options(max_task_retries=3)
+
+
+class TestWorkerRejoin:
+    """A falsely-reaped worker host (partition outlived the health timeout)
+    re-registers instead of shutting down: heartbeat() returning False now
+    triggers the rejoin protocol (cross_host.WorkerRuntime._rejoin)."""
+
+    def test_reaped_worker_re_registers(self):
+        from ray_tpu.core.control_plane import NodeState
+        from ray_tpu.core.cross_host import WorkerRuntime
+
+        rt = ray_tpu.init(
+            num_cpus=2, num_tpus=0,
+            system_config={"control_plane_rpc_port": 0, "worker_processes": 0,
+                           "health_check_period_ms": 200},
+        )
+        w = None
+        try:
+            w = WorkerRuntime(rt._cp_server.address, num_cpus=1, num_tpus=0)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                info = rt.control_plane.get_node(w.node_id)
+                if info is not None and info.state is NodeState.ALIVE:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("worker never registered")
+            # seed an object on the worker so the rejoin re-advertises it
+            oid = _oid()
+            w.agent.store.put(oid, b"held-across-reap")
+            w.directory.add_location(oid, w.node_id)
+            rt.control_plane.mark_node_dead(w.node_id, "test reap")
+            # the worker's next heartbeat sees False -> _rejoin
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                info = rt.control_plane.get_node(w.node_id)
+                if info is not None and info.state is NodeState.ALIVE:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("reaped worker never re-registered")
+            assert w.is_running, "worker must ride out the reap, not die"
+            # its held object is discoverable again on the rebuilt directory
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if w.node_id in rt.directory.locations(oid):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("held object was not re-advertised")
+        finally:
+            if w is not None:
+                w.shutdown()
+            ray_tpu.shutdown()

@@ -174,3 +174,209 @@ class TestCliAttach:
             assert "svc" in out.stdout and "ALIVE" in out.stdout
         finally:
             proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# Head fault tolerance: the reconnecting client (GCS-FT analogue)
+# ---------------------------------------------------------------------------
+
+
+def _restart_server(cp, port):
+    """Re-serve cp on the SAME port, as a restarted head would."""
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            return serve_control_plane(cp, port=port)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _wait_reconnected(client, count=1, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with client._conn_cv:
+            if client.reconnect_count >= count and client._conn is not None:
+                return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"client never reconnected (count={client.reconnect_count})")
+
+
+class TestReconnect:
+    def test_idempotent_call_rides_out_head_restart(self, served_cp):
+        """An idempotent call issued DURING downtime completes once the
+        head is back, within its deadline — the caller never notices."""
+        cp, server = served_cp
+        port = server.server_address[1]
+        cp.kv_put("ft/k", b"survives")
+        client = RemoteControlPlane(server.address)
+        assert client.kv_get("ft/k") == b"survives"
+        server.stop()
+        result = {}
+
+        def call():
+            result["v"] = client.kv_get("ft/k", _deadline_s=15.0)
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.3)  # the call is now parked waiting for a connection
+        assert "v" not in result
+        server2 = _restart_server(cp, port)
+        try:
+            t.join(timeout=15)
+            assert not t.is_alive(), "idempotent call never completed"
+            assert result["v"] == b"survives"
+        finally:
+            client.close()
+            server2.stop()
+
+    def test_nonidempotent_raises_and_is_not_duplicated(self, served_cp):
+        """register_actor during a partition surfaces the retryable error
+        WITHOUT having been applied; the caller's retry lands exactly once."""
+        from ray_tpu.core.rpc import ControlPlaneUnavailable
+        from ray_tpu.util import chaos
+
+        cp, server = served_cp
+        client = RemoteControlPlane(server.address)
+        aid = ActorID.of(JobID.next())
+        info = ActorInfo(actor_id=aid, name="ft-actor")
+        with chaos.partition():
+            with pytest.raises(ControlPlaneUnavailable):
+                client.register_actor(info, _deadline_s=3.0)
+        _wait_reconnected(client)
+        client.register_actor(info)  # the caller owns the retry
+        actors = [a for a in cp.list_actors() if a.name == "ft-actor"]
+        assert len(actors) == 1, "non-idempotent call was duplicated"
+        client.close()
+
+    def test_nonidempotent_deadline_bounds_downtime(self, served_cp):
+        from ray_tpu.core.rpc import ControlPlaneUnavailable
+
+        cp, server = served_cp
+        client = RemoteControlPlane(server.address)
+        server.stop()
+        start = time.monotonic()
+        with pytest.raises(ControlPlaneUnavailable):
+            client.register_job(JobID.next(), {}, _deadline_s=1.0)
+        assert time.monotonic() - start < 5.0, "deadline did not bound the call"
+        client.close()
+
+    def test_subscription_survives_head_restart(self, served_cp):
+        """Events published by the RESTARTED head (a fresh ControlPlane, as
+        resume_from produces) reach a subscriber from before the crash."""
+        cp, server = served_cp
+        port = server.server_address[1]
+        client = RemoteControlPlane(server.address)
+        got = []
+        evt = threading.Event()
+
+        def on_node(msg):
+            got.append(msg)
+            evt.set()
+
+        client.subscribe("node", on_node)
+        server.stop()
+        cp2 = ControlPlane()  # the restarted head: brand-new authority
+        server2 = _restart_server(cp2, port)
+        try:
+            _wait_reconnected(client)
+            nid = NodeID.generate()
+            cp2.register_node(
+                NodeInfo(node_id=nid, address="h", resources_total={}))
+            assert evt.wait(10), "event after restart never reached subscriber"
+            state, info = got[0]
+            assert state == "ALIVE" and info.node_id == nid
+        finally:
+            client.close()
+            server2.stop()
+
+    def test_no_reply_id_crosstalk_across_reconnects(self, served_cp):
+        """A straggler response from connection N must not satisfy a
+        request on connection N+1, even though ids restart at 1."""
+        cp, server = served_cp
+        cp.kv_put("ft/x", b"real")
+        client = RemoteControlPlane(server.address)
+        assert client.kv_get("ft/x") == b"real"  # old conn used id 1
+        old = client._conn
+        assert old is not None and old.next_id >= 1
+        # sever the connection out from under the client
+        old.sock.shutdown(2)
+        _wait_reconnected(client)
+        new = client._conn
+        assert new is not old, "reconnect must build a fresh connection"
+        assert new.next_id == 0 and not new.replies
+        # a stale reply for id 1 lands on the OLD conn's map: invisible
+        with old.cv:
+            old.replies[1] = {"id": 1, "ok": True, "value": b"STALE"}
+            old.cv.notify_all()
+        assert client.kv_get("ft/x") == b"real"
+        client.close()
+
+    def test_three_kill_restart_cycles_leak_nothing(self, served_cp):
+        """Acceptance: >=3 consecutive kill/restart cycles, then thread and
+        fd counts return to baseline — no leaked reader/reconnect threads
+        or sockets."""
+        import os
+
+        cp, server = served_cp
+        port = server.server_address[1]
+        cp.kv_put("ft/cycle", b"ok")
+        client = RemoteControlPlane(server.address)
+        assert client.kv_get("ft/cycle") == b"ok"
+        time.sleep(0.2)  # let setup threads settle
+        base_threads = threading.active_count()
+        base_fds = len(os.listdir("/proc/self/fd"))
+        srv = server
+        for cycle in range(3):
+            srv.stop()
+            srv = _restart_server(cp, port)
+            assert client.kv_get("ft/cycle", _deadline_s=15.0) == b"ok", (
+                f"cycle {cycle}: call after restart failed")
+        assert client.reconnect_count >= 3
+        # settle: dead readers/handlers/reconnectors must wind down
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (threading.active_count() <= base_threads
+                    and len(os.listdir("/proc/self/fd")) <= base_fds):
+                break
+            time.sleep(0.1)
+        assert threading.active_count() <= base_threads, (
+            f"leaked threads: {[t.name for t in threading.enumerate()]}")
+        assert len(os.listdir("/proc/self/fd")) <= base_fds, "leaked fds"
+        client.close()
+        srv.stop()
+
+    def test_partition_delay_mode_slows_but_completes(self, served_cp):
+        from ray_tpu.util import chaos
+
+        cp, server = served_cp
+        cp.kv_put("ft/d", b"v")
+        client = RemoteControlPlane(server.address)
+        with chaos.partition(mode="delay", delay_s=0.2):
+            start = time.monotonic()
+            assert client.kv_get("ft/d") == b"v"
+            assert time.monotonic() - start >= 0.2
+        client.close()
+
+    def test_deferred_subscribe_registers_on_reconnect(self, served_cp):
+        """subscribe() while the head is down still takes effect: the
+        channel re-registers as soon as a connection lands."""
+        cp, server = served_cp
+        port = server.server_address[1]
+        client = RemoteControlPlane(server.address)
+        server.stop()
+        time.sleep(0.2)
+        got = threading.Event()
+        client.subscribe("node", lambda m: got.set())  # head is DOWN here
+        cp2 = ControlPlane()
+        server2 = _restart_server(cp2, port)
+        try:
+            _wait_reconnected(client)
+            cp2.register_node(NodeInfo(node_id=NodeID.generate(), address="h",
+                                       resources_total={}))
+            assert got.wait(10), "deferred subscription never registered"
+        finally:
+            client.close()
+            server2.stop()
