@@ -28,7 +28,8 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
-	tests/test_serve_cross_host.py tests/test_dashboard.py \
+	tests/test_serve_cross_host.py tests/test_disagg.py \
+	tests/test_dashboard.py \
 	tests/test_integrations.py tests/test_platform.py \
 	tests/test_microbenchmark.py
 
@@ -36,7 +37,8 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all chaos tsan shm bench-data bench-object
+.PHONY: check check-slow check-all chaos tsan shm bench-data bench-object \
+	bench-serve
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -48,6 +50,13 @@ bench-data:
 # into BENCH_SUMMARY.json
 bench-object:
 	env RAY_TPU_BENCH_SUITE=object python bench.py
+
+# serve iteration loop: continuous-batching burst (req/s, p50/p95 TTFT,
+# decode tok/s) plus the disagg-vs-colocated pass (same burst through a
+# prefill+decode pair with KV migrating over the object plane), merged
+# into BENCH_SUMMARY.json
+bench-serve:
+	env RAY_TPU_BENCH_SUITE=serve python bench.py
 
 shm:
 	$(MAKE) -C ray_tpu/core/_shm

@@ -185,8 +185,11 @@ def bench_serve(model: str) -> None:
         file=sys.stderr,
     )
     mname = model.replace("-", "_")
+    p95_ttft = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
     _emit(f"serve_req_per_s_{mname}", n_req / wall, "req/s", "serve_anchor")
     _emit(f"serve_p50_ttft_{mname}", p50_ttft, "s", "serve_ttft_anchor",
+          lower_is_better=True)
+    _emit(f"serve_p95_ttft_{mname}", p95_ttft, "s", "serve_p95_ttft_anchor",
           lower_is_better=True)
     # end-to-end output-token throughput (prefill + queueing included)
     _emit(f"serve_output_tok_per_s_{mname}", total_toks / wall, "tokens/s",
@@ -194,8 +197,65 @@ def bench_serve(model: str) -> None:
     _emit(f"serve_decode_tok_per_s_per_req_{mname}", mean_decode, "tokens/s",
           "serve_decode_anchor")
 
+    _bench_serve_disagg(cfg, mname, rng, n_req, prompt_len, max_tokens,
+                        n_req / wall)
+
     if os.environ.get("RAY_TPU_BENCH_SPEC", "0") not in ("", "0", "false"):
         _bench_serve_spec(cfg, mname, rng, n_req)
+
+
+def _bench_serve_disagg(cfg, mname: str, rng, n_req: int, prompt_len: int,
+                        max_tokens: int, colocated_req_per_s: float) -> None:
+    """Disagg-vs-colocated serve pass: the SAME burst through a
+    prefill+decode replica pair with KV migrating over the object plane,
+    compared against the colocated rows just emitted. In-process pair on
+    one host — the row measures the migration tax and the phase split,
+    not cross-host network (run the slow cross-host test for that)."""
+    import jax
+
+    from ray_tpu.models import init_params
+    from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    def make_engine():
+        ecfg = EngineConfig(max_batch_size=16, max_seq_len=512,
+                            prefill_batch_size=8, busy_span=4)
+        e = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                            ecfg)
+        e.warmup(buckets=[prompt_len])
+        return e
+
+    pe, de = make_engine(), make_engine()
+    co = DisaggCoordinator([EngineWorker(pe, "prefill0")],
+                           [EngineWorker(de, "decode0")],
+                           {"small_blob_bytes": 0})  # always object plane
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+    co.generate(prompts[0], max_tokens=4)  # warm export/import programs
+    results, wall = _serve_burst(co, prompts, max_tokens)
+    pe.stop()
+    de.stop()
+    ttfts = sorted(float(r["ttft_s"]) for r in results)
+    mig_ms = 1e3 * sum(float(r["migration_s"]) for r in results) / n_req
+    print(
+        f"# serve-disagg: model={cfg.name} n_req={n_req} prompt={prompt_len} "
+        f"max_tokens={max_tokens} wall={wall:.2f}s transport=object "
+        f"migration_mean={mig_ms:.1f}ms",
+        file=sys.stderr,
+    )
+    disagg_rps = n_req / wall
+    _emit(f"serve_disagg_req_per_s_{mname}", disagg_rps, "req/s",
+          "serve_anchor")
+    _emit(f"serve_disagg_p50_ttft_{mname}", ttfts[len(ttfts) // 2], "s",
+          "serve_ttft_anchor", lower_is_better=True)
+    # headline comparison row: 1.0 means disagg matched colocated req/s
+    # on this box (one host, so it pays migration without the win of
+    # phase-dedicated chips — the ratio is the overhead floor)
+    _emit("serve_disagg_vs_colocated_req_per_s",
+          disagg_rps / max(colocated_req_per_s, 1e-9), "ratio",
+          "serve_disagg_ratio_anchor")
+    _emit(f"serve_kv_migration_ms_mean_{mname}", mig_ms, "ms",
+          "serve_kv_migration_anchor", lower_is_better=True)
 
 
 def _bench_serve_spec(cfg, mname: str, rng, n_req: int) -> None:

@@ -67,6 +67,7 @@ class _ServiceHandler(socketserver.BaseRequestHandler):
         server: "ChannelService" = self.server  # type: ignore[assignment]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        server._track(sock)
         try:
             while True:
                 msg_type, req = recv_msg(sock)
@@ -93,19 +94,34 @@ class _ServiceHandler(socketserver.BaseRequestHandler):
                 send_msg(sock, MSG_RESPONSE, resp)
         except (WireError, OSError):
             pass  # producer disconnected
+        finally:
+            server._untrack(sock)
 
 
 class ChannelService(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, registry: _Registry, host: str = "127.0.0.1"):
-        super().__init__((host, 0), _ServiceHandler)
+    def __init__(self, registry: _Registry, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__((host, port), _ServiceHandler)
         self.registry = registry
+        # established producer connections, severed on stop() so a stopped
+        # service looks DEAD to pooled writers (mirrors ControlPlaneServer)
+        self._conn_lock = threading.Lock()
+        self._conns: set = set()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True, name="channel-service"
         )
         self._thread.start()
+
+    def _track(self, sock) -> None:
+        with self._conn_lock:
+            self._conns.add(sock)
+
+    def _untrack(self, sock) -> None:
+        with self._conn_lock:
+            self._conns.discard(sock)
 
     @property
     def address(self) -> str:
@@ -115,6 +131,16 @@ class ChannelService(socketserver.ThreadingTCPServer):
     def stop(self) -> None:
         self.shutdown()
         self.server_close()
+        # closing the listener leaves established handler conns alive:
+        # sever them too, or a producer's pooled writer keeps a half-open
+        # socket whose next put blocks instead of failing fast
+        with self._conn_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 # --------------------------------------------------------------------------
@@ -150,21 +176,41 @@ class _Writer:
     connection — never another edge's puts to the same host."""
 
     def __init__(self, addr: str):
-        host, _, port = addr.rpartition(":")
-        self._sock = socket.create_connection((host, int(port)), timeout=10.0)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.addr = addr
+        self._sock = self._dial()
         self._lock = threading.Lock()
+
+    def _dial(self) -> socket.socket:
+        host, _, port = self.addr.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=10.0)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
 
     def put(self, chan_id: str, value: Any, maxsize: int,
             timeout: float) -> None:
+        """Transport-vs-app split (mirrors object_transfer): a dead pooled
+        socket (owner restarted / transient drop) reconnects ONCE in place
+        and replays the frame; a second transport failure propagates. An
+        application-level refusal ("channel full") is the backpressure
+        signal — it never retries and raises queue.Full."""
         blob = _dumps(value)
+        frame = {
+            "op": "put", "chan": chan_id, "blob": blob,
+            "maxsize": maxsize, "timeout": timeout,
+        }
         with self._lock:
-            send_msg(self._sock, MSG_REQUEST, {
-                "op": "put", "chan": chan_id, "blob": blob,
-                "maxsize": maxsize, "timeout": timeout,
-            })
-            msg_type, resp = recv_msg(self._sock)
+            try:
+                send_msg(self._sock, MSG_REQUEST, frame)
+                _msg_type, resp = recv_msg(self._sock)
+            except (WireError, OSError):
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = self._dial()  # raises if the owner is gone
+                send_msg(self._sock, MSG_REQUEST, frame)
+                _msg_type, resp = recv_msg(self._sock)
         if not resp.get("ok"):
             raise queue.Full(resp.get("error", "remote channel put failed"))
 
@@ -232,14 +278,10 @@ class DistChannel:
         if q is not None:
             q.put(value, timeout=t)
             return
-        try:
-            _writer_for(self.owner_addr, self.chan_id).put(
-                self.chan_id, value, self.maxsize, t)
-        except (WireError, OSError):
-            # cached connection died (owner restarted / transient drop):
-            # one reconnect attempt against a possibly-recovered service
-            _writer_for(self.owner_addr, self.chan_id, fresh=True).put(
-                self.chan_id, value, self.maxsize, t)
+        # _Writer.put self-heals a stale socket (one reconnect + replay),
+        # so no fresh-writer fallback is needed here
+        _writer_for(self.owner_addr, self.chan_id).put(
+            self.chan_id, value, self.maxsize, t)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         q = self._local()

@@ -125,17 +125,26 @@ def _deserialize_ref(binary: bytes) -> "ObjectRef":
 
 
 class ReferenceCounter:
-    """Driver-side distributed refcount (simplified single-owner model)."""
+    """Driver-side distributed refcount (simplified single-owner model).
+    Escaped refs — ids that were pickled out of this process or into a
+    task result/argument (see ``ObjectRef.__reduce__``) — are exempt from
+    auto-free: a serialized copy may be deserialized long after every
+    local Python handle has been collected."""
 
     def __init__(self, runtime: "Runtime"):
         self._runtime = runtime
         self._lock = threading.Lock()
         self._counts: Dict[ObjectID, int] = {}
+        self._escaped: set = set()
         self.gc_enabled = True
 
     def add_ref(self, object_id: ObjectID) -> None:
         with self._lock:
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def note_escaped(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._escaped.add(object_id)
 
     def remove_ref(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -144,7 +153,7 @@ class ReferenceCounter:
                 self._counts[object_id] = n
                 return
             self._counts.pop(object_id, None)
-            should_free = self.gc_enabled
+            should_free = self.gc_enabled and object_id not in self._escaped
         if should_free and not self._runtime.is_shutdown:
             self._runtime.free_object(object_id)
 
@@ -800,6 +809,12 @@ class Runtime:
                     self.directory.subscribe_once(oid, fut.finish)
                 self._futures[oid] = fut
             return fut
+
+    def note_escaped(self, object_id: ObjectID) -> None:
+        """Called from ObjectRef.__reduce__: this id was serialized (task
+        result, nested argument, cross-process send) — exempt it from
+        refcount-zero auto-free so the deserialized copy still resolves."""
+        self.reference_counter.note_escaped(object_id)
 
     def free_object(self, object_id: ObjectID) -> None:
         with self._lock:

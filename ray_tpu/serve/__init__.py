@@ -22,9 +22,15 @@ from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .config import (  # noqa: F401
     AutoscalingConfig,
     DeploymentConfig,
+    DisaggConfig,
     SpeculationConfig,
 )
 from .deployment import Application, Deployment, deployment  # noqa: F401
+from .disagg import (  # noqa: F401
+    DisaggCoordinator,
+    EngineWorker,
+    deploy_disagg,
+)
 from .engine import EngineConfig, InferenceEngine, Request  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from .llm import LLMServer  # noqa: F401

@@ -89,6 +89,71 @@ class SpeculationConfig:
 
 
 @dataclasses.dataclass
+class DisaggConfig:
+    """Disaggregated prefill/decode serving (serve/disagg.py).
+
+    Requests prefill on dedicated prefill-role replicas, then their paged
+    KV migrates to a decode-role replica that streams the remaining
+    tokens — the two phases stop contending for the same chips.
+
+    kv_transfer:
+      "object"  — the prefill replica seals the KV blob into the host
+                  object plane (api.put); the decode host pulls it via
+                  the pull-through GET path. Blobs at or under
+                  small_blob_bytes ride a DistChannel instead when the
+                  decode replica advertises one (the object plane's
+                  per-object bookkeeping isn't worth it for small KV).
+      "channel" — every blob moves over a consumer-homed DistChannel to
+                  the decode replica (lowest latency; no spill/replay).
+    """
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    kv_transfer: str = "object"
+    # object mode: blobs at or under this many bytes fall back to the
+    # decode replica's DistChannel when one is available
+    small_blob_bytes: int = 262144
+    # place every replica (prefill AND decode) on a distinct host via a
+    # STRICT_SPREAD placement group; falls back to soft SPREAD when the
+    # cluster has too few hosts (e.g. single-host CPU tests)
+    strict_spread: bool = True
+
+    TRANSFERS = ("object", "channel")
+
+    def __post_init__(self) -> None:
+        if self.kv_transfer not in self.TRANSFERS:
+            raise ValueError(
+                f"kv_transfer must be one of {self.TRANSFERS}, "
+                f"got {self.kv_transfer!r}")
+        if int(self.prefill_replicas) < 1 or int(self.decode_replicas) < 1:
+            raise ValueError(
+                "disagg needs at least one replica per role, got "
+                f"prefill_replicas={self.prefill_replicas} "
+                f"decode_replicas={self.decode_replicas}")
+        if int(self.small_blob_bytes) < 0:
+            raise ValueError(
+                f"small_blob_bytes must be >= 0, got {self.small_blob_bytes}")
+
+    @classmethod
+    def parse(cls, value) -> "DisaggConfig":
+        """Normalize a YAML/JSON dict (or an existing instance), rejecting
+        unknown keys with a clear error instead of silently ignoring a
+        typo'd knob."""
+        if isinstance(value, cls):
+            return value
+        if not isinstance(value, dict):
+            raise ValueError(
+                f"disagg must be a mapping, got {type(value).__name__}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(value) - known
+        if unknown:
+            raise ValueError(
+                f"unknown disagg option(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        return cls(**value)
+
+
+@dataclasses.dataclass
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 8

@@ -1,0 +1,679 @@
+"""Disaggregated prefill/decode serving with KV-cache migration.
+
+The engine (serve/engine.py) already isolates prefill from decode
+*within* one replica; under heavy mixed traffic the two phases still
+contend for the same chips. This module splits them across replicas
+(the tf.data-service disaggregation argument, arXiv:2210.14826, applied
+to inference phases): requests prefill on prefill-role replicas, their
+paged KV migrates to a decode-role replica over the host object plane,
+and tokens stream from there.
+
+Pieces:
+
+- `DisaggCoordinator` — admits requests, picks one replica per role by
+  power-of-two-choices over role-specific load (router.pow2_choice),
+  and drives the prefill → migrate → decode pipeline. Works over local
+  `EngineWorker`s (in-process engines: tier-1 tests, bench) or
+  `ReplicaWorker`s wrapping serve replica actors (from_deployments /
+  deploy_disagg).
+- KV transfer — `api.put` + pull-through GET on the object plane by
+  default; blobs at or under DisaggConfig.small_blob_bytes fall back to
+  a consumer-homed `DistChannel` advertised by the decode replica
+  (`KvInbox`), or every blob with kv_transfer="channel".
+- `deploy_disagg` — two role deployments (`{name}-prefill`,
+  `{name}-decode`) placed on distinct hosts via a STRICT_SPREAD
+  placement group (soft SPREAD fallback on small clusters), returning a
+  coordinator bound to both.
+
+Metrics: serve_kv_migration_seconds / serve_kv_migration_bytes (the
+migration tax, per transport), serve_disagg_queue_depth{role} /
+serve_disagg_inflight{role} (admission pressure per role).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .. import api
+from ..core.logging import get_logger
+from ..core.metrics import Counter, Gauge, Histogram
+from .config import DisaggConfig
+from .engine import InferenceEngine, Request
+from .router import _replica_key, pow2_choice
+
+logger = get_logger("serve.disagg")
+
+_m_migration_s = Histogram(
+    "serve_kv_migration_seconds",
+    "KV blob fetch + import time on the decode side, tagged transport",
+)
+_m_migration_b = Counter(
+    "serve_kv_migration_bytes",
+    "KV bytes migrated prefill -> decode, tagged transport",
+)
+_m_queue_depth = Gauge(
+    "serve_disagg_queue_depth",
+    "requests admitted by the coordinator awaiting a replica pick, by role",
+)
+_m_inflight = Gauge(
+    "serve_disagg_inflight",
+    "requests currently executing on a role's replica, by role",
+)
+
+
+def _norm_request(request: Dict[str, Any]) -> Dict[str, Any]:
+    """Engine kwargs from the serve-level request dict (the LLMServer
+    request shape: prompt_ids / max_tokens / ... / stop_token_ids)."""
+    return {
+        "request_id": request.get("request_id") or uuid.uuid4().hex,
+        "prompt": list(request["prompt_ids"]),
+        "max_tokens": int(request.get("max_tokens", 32)),
+        "temperature": float(request.get("temperature", 0.0)),
+        "top_p": float(request.get("top_p", 1.0)),
+        "top_k": int(request.get("top_k", 0)),
+        "stop": request.get("stop_token_ids"),
+    }
+
+
+# --------------------------------------------------------------------------
+# replica-side primitives (shared by EngineWorker and LLMServer)
+# --------------------------------------------------------------------------
+
+
+class KvInbox:
+    """The decode replica's channel-transfer ingest: one consumer-homed
+    DistChannel per process, demultiplexing (request_id, blob) frames
+    onto per-request waiters — frames from concurrent prefills may
+    interleave in any order."""
+
+    def __init__(self, maxsize: int = 16):
+        from ..core import channels
+
+        addr = channels.service_address() or channels.ensure_service()
+        self.channel = channels.DistChannel(addr, maxsize=maxsize)
+        self._cv = threading.Condition()
+        self._parked: Dict[str, Any] = {}
+        self._draining = False
+
+    def take(self, request_id: str, timeout: float = 120.0) -> Any:
+        """Block until this request's blob arrives. Exactly one thread
+        drains the channel at a time; others wait on the condition for
+        their frame to be parked."""
+        import queue as _queue
+
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cv:
+                if request_id in self._parked:
+                    return self._parked.pop(request_id)
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"KV blob for {request_id} not received in {timeout}s")
+                if self._draining:
+                    self._cv.wait(timeout=0.25)
+                    continue
+                self._draining = True
+            item = None
+            try:
+                item = self.channel.get(timeout=0.5)
+            except _queue.Empty:
+                pass
+            finally:
+                with self._cv:
+                    self._draining = False
+                    if item is not None:
+                        self._parked[item[0]] = item[1]
+                    self._cv.notify_all()
+
+
+def replica_prefill(engine: InferenceEngine,
+                    request: Dict[str, Any]) -> Dict[str, Any]:
+    """Prefill-role entry: run a prefill_only request, export its KV,
+    and stage the blob for the decode side. The transfer decision lives
+    HERE because only the exporter knows the blob size: object plane by
+    default, DistChannel when kv_transfer=="channel" or the blob is at
+    or under small_blob_bytes and a destination channel was provided."""
+    opts = _norm_request(request)
+    req = Request(prefill_only=True, **opts)
+    engine.add_request(req)
+    blob = engine.export_kv_pages(
+        req, timeout_s=float(request.get("timeout_s", 600.0)))
+    nbytes = int(blob["k"].nbytes) + int(blob["v"].nbytes)
+    kv_dest = request.get("kv_dest")
+    kv_transfer = request.get("kv_transfer", "object")
+    small = int(request.get("small_blob_bytes", 0))
+    if kv_dest is not None and (kv_transfer == "channel" or nbytes <= small):
+        kv_dest.put((req.request_id, blob))
+        handoff = {"kind": "channel", "bytes": nbytes}
+    else:
+        handoff = {"kind": "object", "ref": api.put(blob), "bytes": nbytes}
+    return {
+        "request_id": req.request_id,
+        "first_token": int(blob["first_token"]),
+        "ttft_s": (req.first_token_at or 0) - req.submitted_at,
+        "prefill_s": (req.finished_at or 0) - req.submitted_at,
+        "kv": handoff,
+    }
+
+
+def _fetch_blob(request: Dict[str, Any],
+                inbox: Optional[KvInbox]) -> Dict[str, Any]:
+    handoff = request["kv"]
+    timeout = float(request.get("timeout_s", 600.0))
+    if handoff["kind"] == "object":
+        # pull-through GET: the blob seals into this host's local store
+        return api.get(handoff["ref"], timeout=timeout)
+    if inbox is None:
+        raise ValueError("channel handoff but this replica has no KV inbox")
+    return inbox.take(request["request_id"], timeout=timeout)
+
+
+def _import_request(engine: InferenceEngine, request: Dict[str, Any],
+                    inbox: Optional[KvInbox],
+                    stream: bool = False) -> Request:
+    """Decode-role entry: fetch the blob, import it, observe the
+    migration tax. Returns the live engine request."""
+    import queue as _queue
+
+    handoff = request["kv"]
+    t0 = time.monotonic()
+    blob = _fetch_blob(request, inbox)
+    opts = _norm_request(request)
+    req = Request(stream_q=_queue.Queue() if stream else None, **opts)
+    engine.import_kv_pages(req, blob)
+    elapsed = time.monotonic() - t0
+    tags = {"transport": handoff["kind"]}
+    _m_migration_s.observe(elapsed, tags=tags)
+    _m_migration_b.inc(int(handoff.get("bytes", 0)), tags=tags)
+    req._migration_s = elapsed
+    return req
+
+
+def replica_decode(engine: InferenceEngine, request: Dict[str, Any],
+                   inbox: Optional[KvInbox] = None) -> Dict[str, Any]:
+    req = _import_request(engine, request, inbox)
+    timeout = float(request.get("timeout_s", 600.0))
+    if not req.done.wait(timeout):
+        engine.cancel(req.request_id)
+        raise TimeoutError(f"decode for {req.request_id} timed out")
+    if req.error:
+        raise ValueError(req.error)
+    return {
+        "request_id": req.request_id,
+        "token_ids": list(req.output),
+        "finish_reason": req.finish_reason,
+        "migration_s": req._migration_s,
+        "migration_bytes": int(request["kv"].get("bytes", 0)),
+        "kv_transport": request["kv"]["kind"],
+    }
+
+
+def replica_decode_stream(engine: InferenceEngine, request: Dict[str, Any],
+                          inbox: Optional[KvInbox] = None):
+    """Streaming decode: yields token ids (the seeded first token
+    included), then ONE trailing dict with finish_reason/error — the
+    coordinator strips it (generators cross actor handles live in the
+    in-process runtime, so this rides the same path `stream` does)."""
+    req = _import_request(engine, request, inbox, stream=True)
+    timeout = float(request.get("timeout_s", 600.0))
+
+    def gen():
+        while True:
+            tok = req.stream_q.get(timeout=timeout)
+            if tok is None:
+                break
+            yield tok
+        yield {
+            "finish_reason": req.finish_reason,
+            "error": req.error,
+            "migration_s": req._migration_s,
+            "migration_bytes": int(request["kv"].get("bytes", 0)),
+            "kv_transport": request["kv"]["kind"],
+        }
+
+    return gen()
+
+
+# --------------------------------------------------------------------------
+# workers: one per replica, tracking role-specific load locally
+# --------------------------------------------------------------------------
+
+
+class _LoadTracker:
+    def __init__(self):
+        self._outstanding = 0
+        self._load_lock = threading.Lock()
+
+    def load(self) -> int:
+        return self._outstanding
+
+    def _begin(self) -> None:
+        with self._load_lock:
+            self._outstanding += 1
+
+    def _end(self) -> None:
+        with self._load_lock:
+            self._outstanding -= 1
+
+
+class EngineWorker(_LoadTracker):
+    """One in-process InferenceEngine acting as a prefill or decode
+    replica — the unit the tier-1 e2e test and bench.py drive."""
+
+    def __init__(self, engine: InferenceEngine, name: str = "engine"):
+        super().__init__()
+        self.engine = engine
+        self.name = name
+        self.key = f"engine-worker-{id(self)}"
+        self._inbox: Optional[KvInbox] = None
+        self._inbox_lock = threading.Lock()
+
+    def kv_dest(self):
+        with self._inbox_lock:
+            if self._inbox is None:
+                self._inbox = KvInbox()
+            return self._inbox.channel
+
+    def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        self._begin()
+        try:
+            return replica_prefill(self.engine, request)
+        finally:
+            self._end()
+
+    def decode_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        self._begin()
+        try:
+            return replica_decode(self.engine, request, self._inbox)
+        finally:
+            self._end()
+
+    def decode_stream(self, request: Dict[str, Any]):
+        # load accounting brackets the whole stream, not just the call
+        self._begin()
+
+        def gen():
+            try:
+                yield from replica_decode_stream(
+                    self.engine, request, self._inbox)
+            finally:
+                self._end()
+
+        return gen()
+
+    def cancel(self, request_id: str) -> bool:
+        return self.engine.cancel(request_id)
+
+
+class ReplicaWorker(_LoadTracker):
+    """One serve replica actor (LLMServer) addressed directly, NOT via a
+    DeploymentHandle: channel transfer needs the KV destination and the
+    decode call to land on the SAME replica, which per-call handle
+    routing cannot guarantee."""
+
+    def __init__(self, replica: Any):
+        super().__init__()
+        self._replica = replica
+        self.key = _replica_key(replica)
+        self._kv_dest = None
+
+    def _call(self, method: str, request: Dict[str, Any],
+              timeout: float) -> Any:
+        ref = self._replica.handle_request.remote(method, (request,), {}, "")
+        return api.get(ref, timeout=timeout)
+
+    def kv_dest(self):
+        if self._kv_dest is None:
+            self._kv_dest = self._call("kv_ingest", {}, 30.0)
+        return self._kv_dest
+
+    def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        self._begin()
+        try:
+            return self._call("prefill_request", request,
+                              float(request.get("timeout_s", 600.0)) + 30.0)
+        finally:
+            self._end()
+
+    def decode_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        self._begin()
+        try:
+            return self._call("decode_request", request,
+                              float(request.get("timeout_s", 600.0)) + 30.0)
+        finally:
+            self._end()
+
+    def decode_stream(self, request: Dict[str, Any]):
+        self._begin()
+        try:
+            inner = self._call("decode_stream", request,
+                               float(request.get("timeout_s", 600.0)) + 30.0)
+        except BaseException:
+            self._end()
+            raise
+
+        def gen():
+            try:
+                yield from inner
+            finally:
+                self._end()
+
+        return gen()
+
+    def cancel(self, request_id: str) -> bool:
+        try:
+            return self._call("cancel", {"request_id": request_id}, 30.0)
+        except Exception:  # noqa: BLE001 — best-effort on a dying replica
+            return False
+
+
+# --------------------------------------------------------------------------
+# the coordinator
+# --------------------------------------------------------------------------
+
+
+class DisaggStream:
+    """Handle for one streaming disagg request: `tokens()` yields ids;
+    finish_reason/error/migration stats populate once exhausted."""
+
+    def __init__(self, request_id: str, raw_gen, coordinator):
+        self.request_id = request_id
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.migration_s: Optional[float] = None
+        self.migration_bytes: Optional[int] = None
+        self._raw = raw_gen
+        self._co = coordinator
+
+    def tokens(self):
+        for item in self._raw:
+            if isinstance(item, dict):  # the replica's trailing summary
+                self.finish_reason = item.get("finish_reason")
+                self.error = item.get("error")
+                self.migration_s = item.get("migration_s")
+                self.migration_bytes = item.get("migration_bytes")
+                break
+            yield item
+        if self.error:
+            raise ValueError(self.error)
+
+    def cancel(self) -> None:
+        self._co.cancel(self.request_id)
+
+
+class DisaggCoordinator:
+    """Admission + role routing + KV handoff for disaggregated serving.
+
+    Pick order is decode-first: channel transfer must know its
+    destination inbox before the prefill replica pushes the blob."""
+
+    def __init__(self, prefill_workers: List[Any], decode_workers: List[Any],
+                 config: Any = None):
+        self.cfg = DisaggConfig.parse(config or {})
+        self._workers = {
+            "prefill": list(prefill_workers),
+            "decode": list(decode_workers),
+        }
+        self._lock = threading.Lock()
+        self._live: Dict[str, Any] = {}  # request_id -> (pworker, dworker)
+        # serve mode (from_deployments): re-synced against the controller
+        self._deployments: Optional[Dict[str, str]] = None
+        self._controller = None
+        self._last_sync = 0.0
+        self._sync_period = 1.0
+        self._pg = None  # placement group owned by deploy_disagg
+
+    # -------------------------------------------------------------- serve
+
+    @classmethod
+    def from_deployments(cls, prefill_deployment: str, decode_deployment: str,
+                         config: Any = None,
+                         controller: Any = None) -> "DisaggCoordinator":
+        co = cls([], [], config)
+        co._deployments = {
+            "prefill": prefill_deployment,
+            "decode": decode_deployment,
+        }
+        co._controller = controller
+        co._sync(force=True)
+        return co
+
+    def _controller_handle(self):
+        if self._controller is None:
+            self._controller = api.get_actor("SERVE_CONTROLLER")
+        return self._controller
+
+    def _sync(self, force: bool = False) -> None:
+        """Refresh per-role worker lists from the controller, REUSING the
+        worker object for any replica that survived (its in-flight count
+        and cached KV channel must not reset on a version bump — the same
+        invariant Pow2Router.update_replicas keeps)."""
+        if self._deployments is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_sync < self._sync_period:
+                return
+            self._last_sync = now
+        for role, name in self._deployments.items():
+            replicas, _version = api.get(
+                self._controller_handle().get_replicas.remote(name))
+            with self._lock:
+                cur = {w.key: w for w in self._workers[role]}
+                self._workers[role] = [
+                    cur.get(_replica_key(r)) or ReplicaWorker(r)
+                    for r in replicas
+                ]
+
+    # -------------------------------------------------------------- picks
+
+    def _pick(self, role: str, deadline: float):
+        _m_queue_depth.add(1, tags={"role": role})
+        try:
+            while True:
+                self._sync()
+                with self._lock:
+                    workers = list(self._workers[role])
+                if workers:
+                    idx = pow2_choice(
+                        len(workers), lambda i: workers[i].load())
+                    return workers[idx]
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"no {role} replicas available")
+                time.sleep(0.1)
+                self._sync(force=True)
+        finally:
+            _m_queue_depth.add(-1, tags={"role": role})
+
+    def _base_request(self, prompt, max_tokens, temperature, top_p, top_k,
+                      stop, request_id, timeout_s) -> Dict[str, Any]:
+        return {
+            "prompt_ids": list(prompt),
+            "max_tokens": int(max_tokens),
+            "temperature": float(temperature),
+            "top_p": float(top_p),
+            "top_k": int(top_k),
+            "stop_token_ids": stop,
+            "request_id": request_id or uuid.uuid4().hex,
+            "timeout_s": float(timeout_s),
+            "kv_transfer": self.cfg.kv_transfer,
+            "small_blob_bytes": self.cfg.small_blob_bytes,
+        }
+
+    def _run_prefill(self, base: Dict[str, Any], deadline: float,
+                     dworker) -> Dict[str, Any]:
+        kv_dest = None
+        if self.cfg.kv_transfer == "channel" or self.cfg.small_blob_bytes > 0:
+            kv_dest = dworker.kv_dest()
+        pworker = self._pick("prefill", deadline)
+        self._live[base["request_id"]] = (pworker, dworker)
+        with _m_inflight.track(tags={"role": "prefill"}):
+            return pworker.prefill_request({**base, "kv_dest": kv_dest})
+
+    # ---------------------------------------------------------- blocking
+
+    def generate(self, prompt: List[int], max_tokens: int = 32,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 top_k: int = 0, stop: Optional[List[List[int]]] = None,
+                 request_id: Optional[str] = None,
+                 timeout_s: float = 600.0) -> Dict[str, Any]:
+        base = self._base_request(prompt, max_tokens, temperature, top_p,
+                                  top_k, stop, request_id, timeout_s)
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        try:
+            dworker = self._pick("decode", deadline)
+            pres = self._run_prefill(base, deadline, dworker)
+            with _m_inflight.track(tags={"role": "decode"}):
+                dres = dworker.decode_request({**base, "kv": pres["kv"]})
+        finally:
+            self._live.pop(base["request_id"], None)
+        return {
+            "request_id": base["request_id"],
+            "token_ids": dres["token_ids"],
+            "finish_reason": dres["finish_reason"],
+            "ttft_s": pres["ttft_s"],
+            "latency_s": time.monotonic() - t0,
+            "migration_s": dres["migration_s"],
+            "migration_bytes": dres["migration_bytes"],
+            "kv_transport": dres["kv_transport"],
+        }
+
+    # --------------------------------------------------------- streaming
+
+    def open_stream(self, prompt: List[int], max_tokens: int = 32,
+                    temperature: float = 0.0, top_p: float = 1.0,
+                    top_k: int = 0, stop: Optional[List[List[int]]] = None,
+                    request_id: Optional[str] = None,
+                    timeout_s: float = 600.0) -> DisaggStream:
+        """Prefill synchronously (TTFT is paid here), then return a
+        stream over the decode replica's tokens — the seeded first token
+        arrives as the stream's first item."""
+        base = self._base_request(prompt, max_tokens, temperature, top_p,
+                                  top_k, stop, request_id, timeout_s)
+        deadline = time.monotonic() + timeout_s
+        dworker = self._pick("decode", deadline)
+        try:
+            pres = self._run_prefill(base, deadline, dworker)
+            raw = dworker.decode_stream({**base, "kv": pres["kv"]})
+        except BaseException:
+            self._live.pop(base["request_id"], None)
+            raise
+
+        def finishing():
+            try:
+                yield from raw
+            finally:
+                self._live.pop(base["request_id"], None)
+
+        return DisaggStream(base["request_id"], finishing(), self)
+
+    def generate_stream(self, prompt: List[int], **kw):
+        return self.open_stream(prompt, **kw).tokens()
+
+    # ------------------------------------------------------------- admin
+
+    def cancel(self, request_id: str) -> bool:
+        workers = self._live.get(request_id)
+        if workers is None:
+            return False
+        hit = False
+        for w in workers:
+            try:
+                hit = w.cancel(request_id) or hit
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+        return hit
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "prefill_replicas": len(self._workers["prefill"]),
+                "decode_replicas": len(self._workers["decode"]),
+                "prefill_inflight": sum(
+                    w.load() for w in self._workers["prefill"]),
+                "decode_inflight": sum(
+                    w.load() for w in self._workers["decode"]),
+                "kv_transfer": self.cfg.kv_transfer,
+                "kv_migrations": _m_migration_s.count(
+                    tags={"transport": "object"}) + _m_migration_s.count(
+                    tags={"transport": "channel"}),
+            }
+
+    def close(self) -> None:
+        """Release the placement group deploy_disagg reserved (the role
+        deployments themselves are torn down by serve.shutdown)."""
+        if self._pg is not None:
+            from ..sched.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001 — already removed / head gone
+                pass
+            self._pg = None
+
+
+# --------------------------------------------------------------------------
+# deployment entry point
+# --------------------------------------------------------------------------
+
+
+def _role_placement(cfg: DisaggConfig):
+    """One STRICT_SPREAD placement group covering every replica of both
+    roles: each bundle lands on a distinct host, and replicas acquire
+    bundles (bundle_index=-1) as they spawn — so prefill and decode
+    replicas are pairwise host-disjoint. When the cluster has fewer
+    hosts than replicas (single-host CPU runs) the group is infeasible
+    and we fall back to DEFAULT placement — no strategy at all, so the
+    replicas stay in-process and KV handoff rides the local store."""
+    from ..core.task_spec import PlacementGroupSchedulingStrategy
+    from ..sched.placement_group import PlacementGroupError, placement_group
+
+    total = cfg.prefill_replicas + cfg.decode_replicas
+    if cfg.strict_spread:
+        try:
+            pg = placement_group([{"CPU": 1.0}] * total,
+                                 strategy="STRICT_SPREAD")
+            if pg.ready(timeout=30.0):
+                return PlacementGroupSchedulingStrategy(pg.id, -1), pg
+            logger.info("STRICT_SPREAD group never materialized; "
+                        "falling back to default placement")
+        except PlacementGroupError as e:
+            logger.info("STRICT_SPREAD infeasible (%s); "
+                        "falling back to default placement", e)
+    return None, None
+
+
+def deploy_disagg(model_name: str = "tiny-llama", disagg: Any = None,
+                  name: str = "llm",
+                  engine_config: Optional[Dict[str, Any]] = None,
+                  **llm_kwargs) -> DisaggCoordinator:
+    """Deploy a disaggregated LLM app: `{name}-prefill` and
+    `{name}-decode` LLMServer deployments (role-aware), host-disjoint
+    via STRICT_SPREAD when the cluster allows, plus a coordinator bound
+    to both. Extra kwargs flow to every LLMServer replica."""
+    from . import api as serve_api
+    from .llm import LLMServer
+
+    cfg = DisaggConfig.parse(disagg or {})
+    strategy, pg = _role_placement(cfg)
+    actor_opts = (
+        {"ray_actor_options": {"scheduling_strategy": strategy}}
+        if strategy is not None else {})
+    for role, n in (("prefill", cfg.prefill_replicas),
+                    ("decode", cfg.decode_replicas)):
+        dep = LLMServer.options(
+            name=f"{name}-{role}",
+            num_replicas=n,
+            **actor_opts,
+        )
+        app = dep.bind(model_name=model_name, engine_config=engine_config,
+                       role=role, **llm_kwargs)
+        serve_api.run(app, name=f"{name}-{role}")
+    co = DisaggCoordinator.from_deployments(
+        f"{name}-prefill", f"{name}-decode", cfg)
+    co._pg = pg
+    return co

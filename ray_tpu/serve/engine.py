@@ -237,6 +237,13 @@ class Request:
     # next scheduling point and its pages free — wherever it currently is
     cancelled: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # disaggregated serving: prefill-only requests run the normal prefill
+    # path but never take a decode slot — at install time their KV is
+    # gathered into a host blob (export_kv_pages) and the request finishes
+    # with finish_reason="prefill_done". Pages are only held for the
+    # prompt, not prompt+max_tokens.
+    prefill_only: bool = False
+    _kv_export: Optional[Dict[str, Any]] = None
 
     def _emit(self, tok: Optional[int]) -> None:
         if self.stream_q is not None:
@@ -786,6 +793,153 @@ class InferenceEngine:
             self.k_pages, self.v_pages, k, v, page_arr, n_full, ps
         )
 
+    def _export_blob(self, req: Request, pages: List[int], cache,
+                     T: int) -> Dict[str, Any]:
+        """Gather a prefill_only request's KV into a token-contiguous host
+        blob [L, T, KVH, hd] in the page-pool dtype (decode thread only —
+        the chunked path reads the donated page pools). Both export paths
+        apply the same elementwise dtype cast the colocated scatter path
+        does, so import → decode continues token-exactly."""
+        dtype = self.k_pages.dtype
+        if cache is not None:
+            # bucketed prefill: the row cache IS the KV; no scatter needed
+            k = np.asarray(cache["k"][:, 0, :T].astype(dtype))
+            v = np.asarray(cache["v"][:, 0, :T].astype(dtype))
+        else:
+            # chunked prefill wrote pages directly: gather and trim
+            page_arr = jnp.asarray(pages, jnp.int32)
+            k, v = _gather_pages_jit(self.k_pages, self.v_pages, page_arr)
+            k = np.asarray(k[:, :T])
+            v = np.asarray(v[:, :T])
+        return {
+            "k": k,
+            "v": v,
+            "true_len": T,
+            "first_token": int(req.output[-1]),
+            "layers": int(k.shape[0]),
+            "kv_heads": int(k.shape[2]),
+            "head_dim": int(k.shape[3]),
+            "dtype": str(dtype),
+        }
+
+    def export_kv_pages(self, req: Request,
+                        timeout_s: float = 600.0) -> Dict[str, Any]:
+        """Block until a prefill_only request finishes and return its KV
+        blob (see _export_blob). The blob is engine-agnostic: it can be
+        imported into a pool with a different page_size/max_pages."""
+        if not req.done.wait(timeout_s):
+            self.cancel(req.request_id)
+            raise TimeoutError(f"request {req.request_id} timed out")
+        if req.error:
+            raise ValueError(req.error)
+        blob, req._kv_export = req._kv_export, None
+        if blob is None:
+            raise ValueError(
+                f"request {req.request_id} has no KV export (prefill_only="
+                f"{req.prefill_only}, finish_reason={req.finish_reason!r})")
+        return blob
+
+    def import_kv_pages(self, req: Request, blob: Dict[str, Any],
+                        timeout_s: float = 60.0) -> Request:
+        """Admit `req` straight into the decode phase from an exported KV
+        blob (disaggregated serving: prefill ran on another engine). The
+        blob is re-paginated for THIS engine's page_size/max_pages; the
+        request then behaves exactly as if prefilled here (stops, stream
+        hold-back, prefix registration, speculation all apply).
+
+        Failures surface on the request (req.error + done set), matching
+        add_request's contract. Pages are allocated inline with a bounded
+        retry instead of parking in _waiting: revival re-queues to the
+        PREFILL thread, which would prefill the prompt a second time and
+        append a duplicate first token."""
+        try:
+            req.stop = _normalize_stops(req.stop)
+        except ValueError as e:
+            self._finish_request(req, error=str(e))
+            return req
+        try:
+            k, v = blob["k"], blob["v"]
+            T = int(blob["true_len"])
+            first = int(blob["first_token"])
+        except (KeyError, TypeError) as e:
+            self._finish_request(req, error=f"malformed kv blob: {e!r}")
+            return req
+        L, KVH, hd = self.cfg.n_layers, self.cfg.kv_heads, self.cfg.hdim
+        if tuple(k.shape) != (L, T, KVH, hd) or tuple(v.shape) != k.shape:
+            self._finish_request(req, error=(
+                f"kv blob shape {tuple(k.shape)} does not match model "
+                f"[layers={L}, true_len={T}, kv_heads={KVH}, head_dim={hd}]"))
+            return req
+        if len(req.prompt) != T:
+            self._finish_request(req, error=(
+                f"kv blob covers {T} tokens but the prompt has "
+                f"{len(req.prompt)}"))
+            return req
+        total = T + req.max_tokens
+        if total > self.ecfg.max_seq_len:
+            self._finish_request(req, error=(
+                f"prompt+max_tokens {T}+{req.max_tokens} exceeds "
+                f"max_seq_len {self.ecfg.max_seq_len}"))
+            return req
+        n_pages = -(-total // self.ecfg.page_size)
+        if n_pages > self.ecfg.max_pages - 1:
+            self._finish_request(req, error=(
+                f"request needs {n_pages} pages but the pool only has "
+                f"{self.ecfg.max_pages - 1}; raise EngineConfig.max_pages"))
+            return req
+        if self.prefix is not None:
+            req._page_hashes = self.prefix.page_hashes(
+                req.prompt, T // self.ecfg.page_size)
+        with self._req_lock:
+            self._requests[req.request_id] = req
+        deadline = time.monotonic() + timeout_s
+        pages = None
+        while True:
+            with self._alloc_lock:
+                if req.cancelled.is_set():
+                    break
+                pages = self._alloc_with_reclaim(n_pages)
+            if pages is not None:
+                break
+            if time.monotonic() >= deadline:
+                self._finish_request(req, error=(
+                    f"no pages free for KV import within {timeout_s}s"))
+                return req
+            time.sleep(0.005)
+        if req.cancelled.is_set():
+            if pages:
+                self._free_pages_and_revive(pages)
+            self._finish_request(req, "cancelled")
+            return req
+        ps = self.ecfg.page_size
+        Tpad = -(-T // ps) * ps
+        if Tpad != T:  # re-paginate: pad to THIS pool's page boundary
+            pad = ((0, 0), (0, Tpad - T), (0, 0), (0, 0))
+            k = np.pad(k, pad)
+            v = np.pad(v, pad)
+        dtype = self.k_pages.dtype
+        cache = {
+            "k": jnp.asarray(k, dtype)[:, None],  # [L, 1, Tpad, KVH, hd]
+            "v": jnp.asarray(v, dtype)[:, None],
+        }
+        # Seed the first token exactly as the prefill emitters do: it was
+        # sampled (and TTFT-observed) on the prefill engine, so here it
+        # only enters output/stream bookkeeping.
+        if not req.output:
+            req.output.append(first)
+            eos = self.ecfg.eos_token_id
+            if eos is not None and first == eos:
+                pass  # eos is control
+            elif req.stop:
+                req._held.append(first)  # hold-back from token 1
+            else:
+                req._emit(first)
+        with self._ready_lock:
+            self._ready.append((req, pages, cache, T))
+        self._work.set()
+        self._ensure_loop()
+        return req
+
     # ------------------------------------------------------------- requests
 
     def add_request(self, req: Request) -> None:
@@ -794,7 +948,9 @@ class InferenceEngine:
         except ValueError as e:
             self._finish_request(req, error=str(e))
             return
-        total = len(req.prompt) + req.max_tokens
+        # prefill_only requests never decode here: they only ever hold
+        # pages for the prompt, so capacity checks exclude max_tokens
+        total = len(req.prompt) + (0 if req.prefill_only else req.max_tokens)
         if total > self.ecfg.max_seq_len:
             req.error = (
                 f"prompt+max_tokens {len(req.prompt)}+{req.max_tokens} exceeds "
@@ -984,7 +1140,7 @@ class InferenceEngine:
         cached_len = tokens served by the prefix cache (chunk-aligned).
         Or None (deferred to _waiting / errored)."""
         T = len(req.prompt)
-        total = T + req.max_tokens
+        total = T + (0 if req.prefill_only else req.max_tokens)
         n_pages = -(-total // self.ecfg.page_size)
         C = self.ecfg.prefill_chunk
         hashes: List[bytes] = []
@@ -1143,12 +1299,44 @@ class InferenceEngine:
         while True:
             free_slots = [s for s in self.slots if s.request is None]
             with self._ready_lock:
-                if not self._ready or not free_slots:
+                if not self._ready:
                     return installed
-                req, pages, cache, T = self._ready.pop(0)
+                if free_slots:
+                    idx = 0
+                else:
+                    # prefill-only requests never take a slot: export them
+                    # even while the decode batch is full
+                    idx = next((j for j, it in enumerate(self._ready)
+                                if it[0].prefill_only), None)
+                    if idx is None:
+                        return installed
+                req, pages, cache, T = self._ready.pop(idx)
             if req.cancelled.is_set():  # cancelled between prefill/install
                 self._free_pages_and_revive(pages)
                 self._finish_request(req, "cancelled")
+                installed = True
+                continue
+            if req.prefill_only:
+                try:
+                    blob = self._export_blob(req, pages, cache, T)
+                except Exception as e:  # noqa: BLE001 — fail this request
+                    logger.warning("kv export failed for %s", req.request_id,
+                                   exc_info=True)
+                    self._free_pages_and_revive(pages)
+                    self._fail_request(req, f"kv export failed: {e!r}")
+                    installed = True
+                    continue
+                if self.prefix is not None:
+                    # the prefill fleet still benefits from prefix hits:
+                    # land the KV in pages and offer them to the cache
+                    if cache is not None:
+                        self._scatter_prefill(cache, pages, T)
+                    hashes = getattr(req, "_page_hashes", None)
+                    with self._alloc_lock:
+                        self.prefix.register(req.prompt, pages, hashes=hashes)
+                req._kv_export = blob
+                self._free_pages_and_revive(pages)
+                self._finish_request(req, "prefill_done")
                 installed = True
                 continue
             if cache is not None:  # chunked prefills wrote pages directly
@@ -1571,6 +1759,18 @@ class InferenceEngine:
     def stop(self):
         self._stop.set()
         self._work.set()  # wake the decode thread so it observes _stop
+
+
+@jax.jit
+def _gather_pages_jit(k_pages, v_pages, page_arr):
+    """pages[:, :, page_arr] -> token-contiguous [L, n*ps, KVH, hd].
+    NOT donating: the pools stay live for the decode loop. Compiles per
+    distinct page count — fine for the (host-bound) migration path."""
+    L, KVH, _P, ps, hd = k_pages.shape
+    n = page_arr.shape[0]
+    k = k_pages[:, :, page_arr].transpose(0, 2, 3, 1, 4).reshape(L, n * ps, KVH, hd)
+    v = v_pages[:, :, page_arr].transpose(0, 2, 3, 1, 4).reshape(L, n * ps, KVH, hd)
+    return k, v
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6), donate_argnums=(0, 1))

@@ -31,7 +31,15 @@ class LLMServer:
     dict form) — shorthand for engine_config["speculation"]; the two must
     not both be set. draft_params_fn loads the draft model's weights for
     mode="draft" (default: random init of the named draft config).
+
+    role: "colocated" (default — the classic one-replica-does-both path),
+    or "prefill"/"decode" for disaggregated serving (serve/disagg.py):
+    prefill replicas run prompt-only passes and export KV, decode
+    replicas import KV and stream tokens. The engine is identical either
+    way; the role only gates which request methods make sense here.
     """
+
+    ROLES = ("colocated", "prefill", "decode")
 
     def __init__(
         self,
@@ -42,7 +50,13 @@ class LLMServer:
         tensor_parallel: int = 1,
         speculation: Any = None,
         draft_params_fn=None,
+        role: str = "colocated",
     ):
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role must be one of {self.ROLES}, got {role!r}")
+        self.role = role
+        self._kv_inbox = None  # decode role: created on first kv_ingest
         if params_fn is not None:
             params, cfg = params_fn()
         else:
@@ -105,8 +119,42 @@ class LLMServer:
             request_id=request.get("request_id"),
         )
 
+    # ---------------------------------------------------------- disagg
+    # Thin delegations to serve/disagg.py replica helpers; the
+    # coordinator addresses these directly on the replica actor (not via
+    # a DeploymentHandle) so channel KV lands where the decode runs.
+
+    def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        from .disagg import replica_prefill
+
+        return replica_prefill(self.engine, request)
+
+    def decode_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        from .disagg import replica_decode
+
+        return replica_decode(self.engine, request, self._kv_inbox)
+
+    def decode_stream(self, request: Dict[str, Any]):
+        from .disagg import replica_decode_stream
+
+        return replica_decode_stream(self.engine, request, self._kv_inbox)
+
+    def kv_ingest(self, _request: Any = None):
+        """Lazily create this replica's KV inbox and return its
+        DistChannel handle (picklable: prefill replicas put into it)."""
+        from .disagg import KvInbox
+
+        if self._kv_inbox is None:
+            self._kv_inbox = KvInbox()
+        return self._kv_inbox.channel
+
+    def cancel(self, request: Dict[str, Any]) -> bool:
+        return self.engine.cancel(request["request_id"])
+
     def stats(self, _request: Any = None) -> Dict[str, Any]:
-        return self.engine.stats()
+        out = self.engine.stats()
+        out["role"] = self.role
+        return out
 
     def check_health(self) -> None:
         pass

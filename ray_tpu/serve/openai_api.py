@@ -87,9 +87,22 @@ class OpenAIServer:
         tensor_parallel: int = 1,
         speculation: Any = None,
         draft_params_fn=None,
+        disagg: Any = None,
+        disagg_deployments: Optional[List[str]] = None,
     ):
         self.model_name = model_name
         self.tokenizer = _make_tokenizer(tokenizer)
+        if disagg_deployments is not None:
+            # coordinator mode (build_openai_app(disagg=...)): no local
+            # engine — requests prefill/decode on the role deployments
+            from .disagg import DisaggCoordinator
+
+            prefill_name, decode_name = disagg_deployments
+            self._coordinator = DisaggCoordinator.from_deployments(
+                prefill_name, decode_name, disagg)
+            self.engine = None
+            return
+        self._coordinator = None
         if params_fn is not None:
             params, cfg = params_fn()
         else:
@@ -145,6 +158,15 @@ class OpenAIServer:
             stops.append([int(tid)])
         return stops or None
 
+    def _generate(self, ids, max_tokens, temperature, top_p, stop):
+        if self._coordinator is not None:
+            return self._coordinator.generate(
+                ids, max_tokens=max_tokens, temperature=temperature,
+                top_p=top_p, stop=stop)
+        return self.engine.generate(ids, max_tokens=max_tokens,
+                                    temperature=temperature, top_p=top_p,
+                                    stop=stop)
+
     def completions(self, body: Dict[str, Any]):
         prompt = body.get("prompt", "")
         ids = (
@@ -162,9 +184,7 @@ class OpenAIServer:
                 rid, "text_completion", ids, max_tokens, temperature, top_p,
                 stop,
             )
-        out = self.engine.generate(ids, max_tokens=max_tokens,
-                                   temperature=temperature, top_p=top_p,
-                                   stop=stop)
+        out = self._generate(ids, max_tokens, temperature, top_p, stop)
         text = self.tokenizer.decode(out["token_ids"])
         return {
             "id": rid,
@@ -193,9 +213,7 @@ class OpenAIServer:
         if body.get("stream"):
             return self._stream_sse(rid, "chat.completion", ids, max_tokens,
                                     temperature, top_p, stop)
-        out = self.engine.generate(ids, max_tokens=max_tokens,
-                                   temperature=temperature, top_p=top_p,
-                                   stop=stop)
+        out = self._generate(ids, max_tokens, temperature, top_p, stop)
         text = self.tokenizer.decode(out["token_ids"])
         return {
             "id": rid,
@@ -225,6 +243,8 @@ class OpenAIServer:
         }
 
     def stats(self, _body: Any = None):
+        if self._coordinator is not None:
+            return self._coordinator.stats()
         return self.engine.stats()
 
     def check_health(self) -> None:
@@ -238,27 +258,37 @@ class OpenAIServer:
         a server-sent event (in-process runtime: generators cross the
         handle live)."""
         tokenizer, model = self.tokenizer, self.model_name
-        engine = self.engine
+        engine, coordinator = self.engine, self._coordinator
 
         def gen():
             # admission happens on FIRST PULL, inside the generator: a
             # client that disconnects before consuming anything never
             # admits a request at all (a never-started generator's
             # finally cannot run, so nothing may need cancelling either)
-            req, stream = engine.open_stream(
-                ids, max_tokens=max_tokens, temperature=temperature,
-                top_p=top_p, stop=stop,
-            )
+            if coordinator is not None:
+                ds = coordinator.open_stream(
+                    ids, max_tokens=max_tokens, temperature=temperature,
+                    top_p=top_p, stop=stop,
+                )
+                stream = ds.tokens()
+                finish, cancel = (lambda: ds.finish_reason), ds.cancel
+            else:
+                req, stream = engine.open_stream(
+                    ids, max_tokens=max_tokens, temperature=temperature,
+                    top_p=top_p, stop=stop,
+                )
+                finish = lambda: req.finish_reason  # noqa: E731
+                cancel = lambda: engine.cancel(req.request_id)  # noqa: E731
             try:
-                yield from body(req, stream)
+                yield from body(stream, finish)
             finally:
                 # consumer gone (GeneratorExit on client disconnect) or
                 # exhausted — cancel is a no-op on a finished request, and
                 # frees the slot/pages of an abandoned one (reference:
                 # serve's disconnect-driven cancellation)
-                engine.cancel(req.request_id)
+                cancel()
 
-        def body(req, stream):
+        def body(stream, finish):
             created = int(time.time())
             for tok in stream:
                 piece = tokenizer.decode([tok])
@@ -276,10 +306,10 @@ class OpenAIServer:
             # terminal chunk carries the real finish_reason (OpenAI wire)
             if obj == "chat.completion":
                 last = {"delta": {}, "index": 0,
-                        "finish_reason": req.finish_reason or "length"}
+                        "finish_reason": finish() or "length"}
             else:
                 last = {"text": "", "index": 0,
-                        "finish_reason": req.finish_reason or "length"}
+                        "finish_reason": finish() or "length"}
             yield {
                 "id": rid,
                 "object": obj + ".chunk",
@@ -291,7 +321,29 @@ class OpenAIServer:
         return gen()
 
 
-def build_openai_app(**kwargs):
+def build_openai_app(disagg: Any = None, disagg_app_name: str = "llm",
+                     **kwargs):
     """-> bound OpenAIServer deployment; serve.run(app, name='v1') exposes
-    POST /v1/completions, /v1/chat_completions, /v1/models."""
-    return OpenAIServer.bind(**kwargs)
+    POST /v1/completions, /v1/chat_completions, /v1/models.
+
+    With `disagg={...}` (DisaggConfig shape), the builder first deploys
+    role-aware `{disagg_app_name}-prefill` / `{disagg_app_name}-decode`
+    LLMServer apps (engine-bearing kwargs flow to them) and binds the
+    OpenAIServer in coordinator mode: routes prefill on one role, stream
+    tokens from the other, with KV migrating over the object plane."""
+    if disagg is None:
+        return OpenAIServer.bind(**kwargs)
+    from .config import DisaggConfig
+    from .disagg import deploy_disagg
+
+    cfg = DisaggConfig.parse(disagg)
+    tok = _make_tokenizer(kwargs.pop("tokenizer", "byte"))
+    model_name = kwargs.pop("model_name", "tiny-llama")
+    engine_config = dict(kwargs.pop("engine_config", None) or {})
+    engine_config.setdefault("eos_token_id", tok.eos_token_id)
+    deploy_disagg(model_name=model_name, disagg=cfg, name=disagg_app_name,
+                  engine_config=engine_config, **kwargs)
+    return OpenAIServer.bind(
+        model_name=model_name, tokenizer=tok, disagg=cfg,
+        disagg_deployments=[f"{disagg_app_name}-prefill",
+                            f"{disagg_app_name}-decode"])

@@ -11,9 +11,29 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from .. import api
+
+
+def pow2_choice(n: int, load_fn: Callable[[int], int]) -> int:
+    """Power-of-two-choices over n slots: sample two, take the shorter
+    queue. Shared by Pow2Router.assign and the disagg coordinator's
+    role-level replica pick."""
+    if n <= 0:
+        raise ValueError("pow2_choice needs at least one slot")
+    if n == 1:
+        return 0
+    a, b = random.sample(range(n), 2)
+    return a if load_fn(a) <= load_fn(b) else b
+
+
+def _replica_key(replica: Any) -> Any:
+    """Stable identity for a replica across update_replicas calls.
+    ActorHandles are re-created per controller sync, so object identity
+    (and list position) go stale — the actor id does not."""
+    key = getattr(replica, "_actor_id", None)
+    return key if key is not None else id(replica)
 
 
 class Pow2Router:
@@ -29,10 +49,30 @@ class Pow2Router:
         with self._lock:
             if version <= self._version:
                 return
+            # Re-key the in-flight refs by replica identity: a version bump
+            # that resizes the fleet must neither credit a surviving
+            # replica's queue to whoever inherited its index nor zero it —
+            # both skew the pow-2 comparison until the refs drain.
+            old_inflight = {
+                _replica_key(r): self._inflight.get(i, [])
+                for i, r in enumerate(self._replicas)
+            }
+            old_keys = {i: _replica_key(r)
+                        for i, r in enumerate(self._replicas)}
             self._replicas = list(replicas)
-            self._inflight = {i: [] for i in range(len(replicas))}
+            new_index = {_replica_key(r): i for i, r in enumerate(replicas)}
+            self._inflight = {
+                i: old_inflight.get(_replica_key(r), [])
+                for i, r in enumerate(replicas)
+            }
             self._version = version
-            self._model_affinity: Dict[str, int] = {}
+            # Affinity follows the resident replica; the pointer drops only
+            # when that replica disappears on the version bump.
+            self._model_affinity = {
+                model: new_index[old_keys[idx]]
+                for model, idx in self._model_affinity.items()
+                if idx in old_keys and old_keys[idx] in new_index
+            }
 
     def _load(self, idx: int) -> int:
         refs = self._inflight.get(idx, [])
@@ -61,11 +101,7 @@ class Pow2Router:
                     if self._load(cand) <= self._load(probe) + 2:
                         idx = cand
             if idx is None:
-                if n == 1:
-                    idx = 0
-                else:
-                    a, b = random.sample(range(n), 2)
-                    idx = a if self._load(a) <= self._load(b) else b
+                idx = pow2_choice(n, self._load)
             if multiplexed_model_id:
                 # Record affinity only for a first placement: a load-check
                 # diversion must not abandon the replica that actually has

@@ -27,7 +27,7 @@ import importlib
 from typing import Any, Dict, List, Optional
 
 from ..core.logging import get_logger
-from .config import AutoscalingConfig, SpeculationConfig
+from .config import AutoscalingConfig, DisaggConfig, SpeculationConfig
 from .deployment import Application, Deployment
 
 logger = get_logger("serve.schema")
@@ -46,6 +46,19 @@ def _validate_speculation(kwargs: Dict[str, Any], app_name) -> None:
             SpeculationConfig.parse(holder["speculation"])
         except (ValueError, TypeError) as e:
             raise ValueError(f"app {app_name!r}: {e}") from None
+
+
+def _validate_disagg(kwargs: Dict[str, Any], app_name) -> None:
+    """LLM app kwargs may carry a disaggregated-serving config under
+    `disagg:` (prefill_replicas / decode_replicas / kv_transfer). Validate
+    at parse time so a typo'd knob fails at `serve deploy` with the app
+    named, not at replica startup."""
+    if kwargs.get("disagg") is None:
+        return
+    try:
+        DisaggConfig.parse(kwargs["disagg"])
+    except (ValueError, TypeError) as e:
+        raise ValueError(f"app {app_name!r}: {e}") from None
 
 
 @dataclasses.dataclass
@@ -96,6 +109,8 @@ class ServeConfigSchema:
                 deps.append(DeploymentSchema(**d))
             _validate_speculation(dict(app.get("kwargs", {})),
                                   app.get("name", "?"))
+            _validate_disagg(dict(app.get("kwargs", {})),
+                             app.get("name", "?"))
             apps.append(ApplicationSchema(
                 name=app["name"],
                 import_path=app["import_path"],
