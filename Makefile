@@ -38,7 +38,7 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos tsan shm bench-data bench-object \
-	bench-serve
+	bench-serve bench-trace
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -57,6 +57,12 @@ bench-object:
 # into BENCH_SUMMARY.json
 bench-serve:
 	env RAY_TPU_BENCH_SUITE=serve python bench.py
+
+# observability-overhead loop: the same disagg serve burst with tracing
+# off (sample rate 0) vs fully on (1.0) — untraced/traced req/s and the
+# overhead %% row, merged into BENCH_SUMMARY.json
+bench-trace:
+	env RAY_TPU_BENCH_SUITE=trace python bench.py
 
 shm:
 	$(MAKE) -C ray_tpu/core/_shm

@@ -258,6 +258,109 @@ def _bench_serve_disagg(cfg, mname: str, rng, n_req: int, prompt_len: int,
           "serve_kv_migration_anchor", lower_is_better=True)
 
 
+def bench_trace(model: str) -> None:
+    """Observability-overhead gate: the SAME disagg serve burst with
+    tracing fully off (trace_sample_rate=0, the default zero-overhead
+    path) and fully on (rate=1.0: every request opens a root span and
+    every pipeline leg — admit, queue wait, prefill, KV export/migration/
+    import, decode — records). Rounds strictly alternate off/on so box
+    drift hits both sides, and each rate reports its MEDIAN round (the
+    per-round spread on a shared CPU box is several %%, far above the
+    true span cost — medians keep one outlier round from minting a
+    bogus headline). The overhead row is the acceptance criterion:
+    <5%% req/s cost at full sampling."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+    from ray_tpu.util import tracing
+
+    cfg = get_config(model)
+    # clamped to the model so the suite also runs on tiny test configs
+    msl = min(256, cfg.max_seq_len)
+    prompt_len = min(64, msl // 2)
+    max_tokens = min(32, msl - prompt_len - 8)
+    n_req = 16
+
+    def make_engine():
+        ecfg = EngineConfig(max_batch_size=16, max_seq_len=msl,
+                            prefill_batch_size=8, busy_span=4,
+                            prefill_buckets=(prompt_len,))
+        e = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                            ecfg)
+        e.warmup(buckets=[prompt_len])
+        return e
+
+    pe, de = make_engine(), make_engine()
+    co = DisaggCoordinator([EngineWorker(pe, "prefill0")],
+                           [EngineWorker(de, "decode0")],
+                           {"small_blob_bytes": 0})
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+
+    class _Entry:
+        """Serve-entry shim: per-request head sampling exactly as the
+        OpenAI surface does it (maybe_begin + activate + finish)."""
+
+        def generate(self, prompt, max_tokens):
+            root = tracing.maybe_begin("request:bench")
+            try:
+                with tracing.activate(root):
+                    return co.generate(prompt, max_tokens=max_tokens)
+            finally:
+                if root is not None:
+                    root.finish()
+
+    entry = _Entry()
+    co.generate(prompts[0], max_tokens=4)  # warm export/import programs
+
+    def run(rate: str) -> float:
+        os.environ["RAY_TPU_TRACE_SAMPLE_RATE"] = rate
+        try:
+            _, wall = _serve_burst(entry, prompts, max_tokens)
+        finally:
+            os.environ.pop("RAY_TPU_TRACE_SAMPLE_RATE", None)
+        return n_req / wall
+
+    run("0")  # one throwaway round: steady-state both sides
+    rounds = 5
+    spans_before = len(tracing.get_spans())
+    samples = {"0": [], "1.0": []}
+    for _ in range(rounds):  # strictly alternating
+        for rate in ("0", "1.0"):
+            samples[rate].append(run(rate))
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    rps_off, rps_on = median(samples["0"]), median(samples["1.0"])
+    traced_spans = len(tracing.get_spans()) - spans_before
+    pe.stop()
+    de.stop()
+    tracing.clear()
+    if traced_spans <= 0:
+        raise RuntimeError("traced rounds recorded no spans — the rate=1.0 "
+                           "path is not actually tracing")
+    overhead_pct = 100.0 * (rps_off - rps_on) / max(rps_off, 1e-9)
+    mname = model.replace("-", "_")
+    print(
+        f"# trace: model={model} n_req={n_req} prompt={prompt_len} "
+        f"max_tokens={max_tokens} rps_off={rps_off:.2f} rps_on={rps_on:.2f} "
+        f"spans={traced_spans}",
+        file=sys.stderr,
+    )
+    _emit(f"serve_untraced_req_per_s_{mname}", rps_off, "req/s",
+          "serve_trace_off_anchor")
+    _emit(f"serve_traced_req_per_s_{mname}", rps_on, "req/s",
+          "serve_trace_on_anchor")
+    _emit("tracing_overhead_pct", overhead_pct, "%",
+          "tracing_overhead_anchor", lower_is_better=True)
+
+
 def _bench_serve_spec(cfg, mname: str, rng, n_req: int) -> None:
     """Speculative-decoding serve pass (opt-in via RAY_TPU_BENCH_SPEC=1:
     the default serve rows stay anchor-comparable). Draft-mode
@@ -751,6 +854,11 @@ def main() -> None:
     # tolerates residue far better (1.5% -> ~2-6% worst case).
     if "serve" in wanted:
         bench_serve(model)
+    if "trace" in wanted:
+        # observability overhead: traced-vs-untraced disagg serve burst.
+        # Runs early for the same reason serve does — req/s is latency-
+        # sensitive and the throughput suites poison it.
+        bench_trace(model)
     if "grpo" in wanted:
         # rollout generate pays per-TOKEN dispatches — as latency-bound
         # as serve TTFT, and equally poisoned by the HBM churn the train/

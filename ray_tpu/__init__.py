@@ -41,8 +41,15 @@ __version__ = "0.1.0"
 def timeline(path: str) -> int:
     """Export the task-event timeline as chrome-trace JSON (open in
     Perfetto / chrome://tracing). Returns the number of events written.
-    Reference analogue: ``ray timeline``. See ray_tpu.util.timeline for
-    app spans (`span`) and device traces (`trace_jax`)."""
-    from .util import timeline as _tl
 
+    On the head this is the MERGED cluster view: worker runtimes flush
+    their timeline events and trace spans with heartbeat telemetry, so
+    the export carries per-node lanes ('<node>/<pid>') plus a trace lane
+    per source process. Reference analogue: ``ray timeline``. See
+    ray_tpu.util.timeline for app spans (`span`) and device traces
+    (`trace_jax`)."""
+    from .util import timeline as _tl
+    from .util import tracing as _tr
+
+    _tr.export_to_timeline()
     return _tl.export(path)

@@ -95,7 +95,14 @@ def _child_main(req_q, resp_q, log_dir: str = "") -> None:
 
     send_lock = threading.Lock()
 
+    # spans recorded in this child ride back on call replies (there is no
+    # heartbeat loop here): one cursor shared by the serve threads
+    tele_lock = threading.Lock()
+    tele_cursor = [0]
+
     def serve_loop():
+        from ..util import tracing
+
         while True:
             item = req_q.get()
             if item is None or item[0] == "stop":
@@ -104,9 +111,24 @@ def _child_main(req_q, resp_q, log_dir: str = "") -> None:
                 return
             _, tag, method, call_payload = item
             try:
-                args, kwargs = pickle.loads(call_payload)
-                out = getattr(instance, method)(*args, **kwargs)
-                body = cloudpickle.dumps((True, out))
+                loaded = pickle.loads(call_payload)
+                args, kwargs = loaded[0], loaded[1]
+                trace_ctx = loaded[2] if len(loaded) > 2 else None
+                if trace_ctx is not None:
+                    with tracing.start_span(
+                            f"actor_exec:{method}", context=trace_ctx):
+                        out = getattr(instance, method)(*args, **kwargs)
+                else:
+                    out = getattr(instance, method)(*args, **kwargs)
+                # ship anything newly buffered: the execute span above,
+                # but also roots the method opened itself (sampled serve
+                # requests). The untraced path stays lock-free.
+                spans = []
+                if tracing._total != tele_cursor[0]:
+                    with tele_lock:
+                        tele_cursor[0], spans = tracing.drain_since(
+                            tele_cursor[0])
+                body = cloudpickle.dumps((True, out, spans))
             except BaseException as e:  # noqa: BLE001 — user methods raise anything
                 try:
                     body = cloudpickle.dumps((False, e))
@@ -240,10 +262,14 @@ class ActorProcess:
              timeout: Optional[float] = None) -> Any:
         if self._dead.is_set():
             raise ActorProcessCrash("actor process is dead")
+        from ..util import tracing
         from .process_pool import _cloudpickle_dumps
 
         try:
-            payload = _cloudpickle_dumps((tuple(args), dict(kwargs or {})))
+            # the caller's span context (the agent-side execute span) rides
+            # along so the child's actor_exec span joins the same trace
+            payload = _cloudpickle_dumps(
+                (tuple(args), dict(kwargs or {}), tracing.current_context()))
         except Exception as e:
             raise ActorNotSerializableError(
                 f"args of {method}() can't cross to the actor process: {e!r}"
@@ -271,7 +297,15 @@ class ActorProcess:
                 f"actor process died executing {method}() "
                 f"(exitcode {self._proc.exitcode})"
             )
-        ok, value = cloudpickle.loads(body)
+        loaded = cloudpickle.loads(body)
+        ok, value = loaded[0], loaded[1]
+        if len(loaded) > 2 and loaded[2]:
+            from ..util import tracing
+
+            # child-process spans land in this (agent) process's buffer,
+            # keeping their origin pid; worker-host federation then ships
+            # them on to the head like any local span
+            tracing.ingest(loaded[2])
         if not ok:
             raise value
         return value

@@ -273,15 +273,19 @@ class DistChannel:
         return None
 
     def put(self, value: Any, timeout: Optional[float] = None) -> None:
+        from ..util import tracing
+
         t = _PUT_TIMEOUT_S if timeout is None else timeout
-        q = self._local()
-        if q is not None:
-            q.put(value, timeout=t)
-            return
-        # _Writer.put self-heals a stale socket (one reconnect + replay),
-        # so no fresh-writer fallback is needed here
-        _writer_for(self.owner_addr, self.chan_id).put(
-            self.chan_id, value, self.maxsize, t)
+        with tracing.span_if_traced(
+                "channel_send", {"channel": self.chan_id[:8]}):
+            q = self._local()
+            if q is not None:
+                q.put(value, timeout=t)
+                return
+            # _Writer.put self-heals a stale socket (one reconnect +
+            # replay), so no fresh-writer fallback is needed here
+            _writer_for(self.owner_addr, self.chan_id).put(
+                self.chan_id, value, self.maxsize, t)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         q = self._local()

@@ -131,6 +131,19 @@ declare("log_to_driver", True, "Tail worker logs back to the driver process.")
 declare("metrics_export_port", 0, "Prometheus port; 0 = disabled.")
 declare("event_log_dir", "", "Structured event-log directory; empty = session dir.")
 declare("task_events_max_buffer", 10_000, "Ring-buffer size for task events.")
+declare(
+    "trace_sample_rate", 0.0,
+    "Fraction of serve requests that open a root trace span at the API "
+    "entry point (util/tracing.py). 0 disables sampling entirely (the "
+    "zero-overhead default); requests arriving under an already-active "
+    "span are always traced regardless of this rate.",
+)
+declare(
+    "telemetry_report_period_s", 5.0,
+    "How often worker runtimes flush metrics snapshots, trace spans, and "
+    "timeline events to the head (piggybacked on the heartbeat loop, so "
+    "the effective period is at least one health_check_period_ms).",
+)
 
 declare(
     "control_plane_rpc_host", "127.0.0.1",

@@ -113,6 +113,10 @@ class ControlPlane:
         self._jobs: Dict[JobID, Dict[str, Any]] = {}
         self._kv: Dict[str, bytes] = {}
         self._placement_groups: Dict[PlacementGroupID, Any] = {}
+        # node_id hex -> latest telemetry report (metrics snapshot + role
+        # + flush cursors) from that worker process; spans/timeline events
+        # are ingested straight into the head's own buffers on arrival.
+        self._telemetry: Dict[str, Dict[str, Any]] = {}
         self._dead = False
 
     # -- node table ---------------------------------------------------------
@@ -168,6 +172,47 @@ class ControlPlane:
             if resources_available is not None:
                 info.resources_available = dict(resources_available)
             return True
+
+    # -- federated telemetry ------------------------------------------------
+    def report_telemetry(
+        self,
+        node_id_hex: str,
+        role: str = "worker",
+        metrics: Optional[List[Dict[str, Any]]] = None,
+        spans: Optional[List[Dict[str, Any]]] = None,
+        events: Optional[List[Dict[str, Any]]] = None,
+        event_cursor: int = 0,
+    ) -> bool:
+        """Worker-process telemetry flush (piggybacked on the heartbeat
+        loop, see cross_host.WorkerRuntime). Metrics replace the node's
+        previous snapshot; spans merge into the head trace buffer
+        (deduped by span_id, so transparent RPC retries are safe);
+        timeline events append into the head ring under a per-node lane,
+        guarded by `event_cursor` so a retried flush can't double-append."""
+        from ..util import timeline, tracing
+
+        with self._lock:
+            prev = self._telemetry.get(node_id_hex) or {}
+            seen_events = int(prev.get("event_cursor", 0))
+            rec = {
+                "role": role,
+                "metrics": metrics if metrics is not None
+                else prev.get("metrics", []),
+                "event_cursor": max(seen_events, int(event_cursor)),
+                "reported_at": time.time(),
+            }
+            self._telemetry[node_id_hex] = rec
+        if spans:
+            tracing.ingest(spans)
+        if events and event_cursor > seen_events:
+            timeline.ingest(events, lane=node_id_hex[:8])
+        return True
+
+    def telemetry_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """node_id hex -> latest {role, metrics, reported_at} (for the
+        dashboard's merged /metrics)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._telemetry.items()}
 
     def alive_nodes(self) -> List[NodeInfo]:
         with self._lock:

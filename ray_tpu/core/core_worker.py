@@ -637,8 +637,19 @@ class Runtime:
         if len(uniques) == 1 or config.get_concurrency <= 1:
             results = [self._get_one(ref, deadline) for ref in uniques]
         else:
+            # pool threads don't inherit this thread's trace context —
+            # re-activate it around each pull so object_pull spans still
+            # parent under the caller's span (None ctx: activate no-ops)
+            from ..util import tracing
+
+            ctx = tracing.current_context()
+
+            def _traced_get_one(ref):
+                with tracing.activate(ctx):
+                    return self._get_one(ref, deadline)
+
             pool = self._get_executor()
-            futures = [pool.submit(self._get_one, ref, deadline)
+            futures = [pool.submit(_traced_get_one, ref)
                        for ref in uniques]
             results, first_error = [], None
             for f in futures:

@@ -1092,6 +1092,11 @@ class WorkerRuntime:
         import os as _os
 
         _os.environ["RAY_TPU_HEAD_ADDRESS"] = address
+        # telemetry flush cursors (advanced only after a successful report,
+        # so a failed flush retries the same tail next beat)
+        self._telemetry_span_cursor = 0
+        self._telemetry_event_cursor = 0
+        self._last_telemetry = 0.0
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="worker-heartbeat"
         )
@@ -1182,7 +1187,41 @@ class WorkerRuntime:
                 # still be wanted
                 logger.warning("head does not know this node; re-registering")
                 self._rejoin()
+            elif alive:
+                self._maybe_report_telemetry()
             self._stopped.wait(period)
+
+    def _maybe_report_telemetry(self) -> None:
+        """Flush this process's metrics snapshot, trace spans, and
+        timeline events to the head, at most every
+        config.telemetry_report_period_s (piggybacked on the heartbeat so
+        a partition pauses telemetry along with liveness). Lossy-tolerant:
+        cursors only advance on a confirmed report, and failures wait for
+        the next beat rather than retrying inline."""
+        now = time.monotonic()
+        if now - self._last_telemetry < float(config.telemetry_report_period_s):
+            return
+        from ..util import timeline, tracing
+        from .metrics import registry as metrics_registry
+
+        span_cur, spans = tracing.drain_since(self._telemetry_span_cursor)
+        event_cur, events = timeline.drain_since(self._telemetry_event_cursor)
+        try:
+            self.control_plane.report_telemetry(
+                self.node_id.hex(),
+                role="worker",
+                metrics=metrics_registry.snapshot(),
+                spans=spans,
+                events=events,
+                event_cursor=event_cur,
+                _deadline_s=5.0,
+            )
+        except (ControlPlaneUnavailable, WireError, OSError, RuntimeError) as e:
+            logger.debug("telemetry flush failed (%s); retrying next beat", e)
+            return
+        self._telemetry_span_cursor = span_cur
+        self._telemetry_event_cursor = event_cur
+        self._last_telemetry = now
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the worker shuts down (head death or stop request)."""

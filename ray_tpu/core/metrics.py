@@ -12,9 +12,12 @@ from __future__ import annotations
 import bisect
 import contextlib
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry"]
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "MICRO_BUCKETS", "render_merged",
+]
 
 TagMap = Tuple[Tuple[str, str], ...]
 
@@ -33,6 +36,12 @@ class _Metric:
         (registry_ or registry).register(self)
 
     def samples(self) -> Iterable[Tuple[str, TagMap, float]]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Zero accumulated values while staying registered — the
+        between-tests reset (`registry.fresh()`) that, unlike `clear()`,
+        does not orphan module-level metric objects."""
         raise NotImplementedError
 
 
@@ -57,6 +66,10 @@ class Counter(_Metric):
     def samples(self):
         with self._lock:
             return [(self.name, k, v) for k, v in self._values.items()]
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
 
 
 class Gauge(_Metric):
@@ -93,8 +106,20 @@ class Gauge(_Metric):
         with self._lock:
             return [(self.name, k, v) for k, v in self._values.items()]
 
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 300)
+
+# For sub-millisecond distributions (KV-cache migration, object pulls):
+# the defaults bottom out at 1ms, which flattens a 2.9ms-mean migration
+# and a sub-ms pull into two buckets.
+MICRO_BUCKETS = (
+    1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1, 5, 30,
+)
 
 
 class Histogram(_Metric):
@@ -140,6 +165,12 @@ class Histogram(_Metric):
                 out.append((f"{self.name}_count", key, float(self._totals[key])))
         return out
 
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+
 
 class MetricsRegistry:
     def __init__(self) -> None:
@@ -157,9 +188,44 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def unregister(self, name: str) -> bool:
+        """Drop one metric by name so a fresh object may re-register it.
+        Returns whether it was present."""
+        with self._lock:
+            return self._metrics.pop(name, None) is not None
+
     def clear(self) -> None:
+        """Forget every metric. NOTE: module-level metric objects created
+        at import time keep pointing at this registry but are no longer
+        in it — their samples silently stop being exported, and creating
+        a same-named replacement raises. Tests that want a clean slate
+        should call `fresh()` instead."""
         with self._lock:
             self._metrics.clear()
+
+    def fresh(self) -> None:
+        """Zero every registered metric's accumulated values while
+        keeping registrations intact — the safe between-tests reset."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset()
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """A plain-data dump of every metric family (wire-friendly: only
+        dicts/lists/tuples/scalars) for telemetry shipping to the head."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = []
+        for m in sorted(metrics, key=lambda m: m.name):
+            out.append({
+                "name": m.name,
+                "kind": m.kind,
+                "description": m.description,
+                "samples": [(sname, list(tags), float(value))
+                            for sname, tags, value in m.samples()],
+            })
+        return out
 
     def render_prometheus(self) -> str:
         lines: List[str] = []
@@ -170,12 +236,59 @@ class MetricsRegistry:
                 lines.append(f"# HELP {m.name} {m.description}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for name, tags, value in m.samples():
-                if tags:
-                    tag_str = ",".join(f'{k}="{v}"' for k, v in tags)
-                    lines.append(f"{name}{{{tag_str}}} {value}")
-                else:
-                    lines.append(f"{name} {value}")
+                lines.append(_sample_line(name, tags, value))
         return "\n".join(lines) + "\n"
+
+
+def _sample_line(name: str, tags, value: float) -> str:
+    if tags:
+        tag_str = ",".join(f'{k}="{v}"' for k, v in tags)
+        return f"{name}{{{tag_str}}} {value}"
+    return f"{name} {value}"
+
+
+def render_merged(local: MetricsRegistry,
+                  remote_snapshots: Dict[str, Dict[str, Any]]) -> str:
+    """Prometheus text for the whole cluster: the local (head) registry
+    plus per-node `registry.snapshot()` payloads shipped via telemetry
+    (`remote_snapshots`: node_id -> {"role": ..., "metrics": [...]}).
+    Remote samples gain `node_id`/`role` tags; each family gets one
+    HELP/TYPE header even when several processes export it."""
+    families: Dict[str, Dict[str, Any]] = {}
+
+    def _add_family(name: str, kind: str, desc: str):
+        fam = families.get(name)
+        if fam is None:
+            fam = families[name] = {"kind": kind, "desc": desc, "lines": []}
+        return fam
+
+    with local._lock:
+        local_metrics = list(local._metrics.values())
+    for m in local_metrics:
+        fam = _add_family(m.name, m.kind, m.description)
+        for sname, tags, value in m.samples():
+            fam["lines"].append(_sample_line(sname, tags, value))
+
+    for node_id, snap in sorted(remote_snapshots.items()):
+        extra = (("node_id", node_id[:12]),)
+        role = snap.get("role")
+        if role:
+            extra += (("role", role),)
+        for fam_snap in snap.get("metrics", []):
+            fam = _add_family(fam_snap["name"], fam_snap["kind"],
+                              fam_snap.get("description", ""))
+            for sname, tags, value in fam_snap["samples"]:
+                merged = tuple(sorted(list(map(tuple, tags)) + list(extra)))
+                fam["lines"].append(_sample_line(sname, merged, value))
+
+    lines: List[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        if fam["desc"]:
+            lines.append(f"# HELP {name} {fam['desc']}")
+        lines.append(f"# TYPE {name} {fam['kind']}")
+        lines.extend(fam["lines"])
+    return "\n".join(lines) + "\n"
 
 
 registry = MetricsRegistry()

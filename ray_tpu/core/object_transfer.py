@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .config import config
 from .ids import ObjectID
 from .logging import get_logger
-from .metrics import Counter, Gauge, Histogram
+from .metrics import MICRO_BUCKETS, Counter, Gauge, Histogram
 from .object_store import SealedBytes
 from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
 
@@ -75,6 +75,7 @@ _pulled_bytes = Counter(
 _pull_seconds = Histogram(
     "object_pull_seconds",
     "Wall seconds per completed remote pull, tagged by data path.",
+    buckets=MICRO_BUCKETS,
 )
 _pull_bytes = Counter(
     "object_pull_bytes", "Bytes that crossed the network on remote pulls."
@@ -925,10 +926,21 @@ def pull_from_any(control_plane, object_id,
     can register the new location in its directory; both steps are
     best-effort and never fail the get (objects are immutable once sealed,
     so a cached replica can never go stale)."""
+    from ..util import tracing
+
     client = client or _shared_client()
-    errors = []
     want_raw = cache_store is not None
     holders = _ranked_holders(control_plane)
+    with tracing.span_if_traced("object_pull",
+                                {"object_id": object_id.hex()[:16],
+                                 "holders": len(holders)}):
+        return _pull_from_holders(client, object_id, want_raw, holders,
+                                  cache_store, on_cached)
+
+
+def _pull_from_holders(client, object_id, want_raw, holders,
+                       cache_store, on_cached) -> Any:
+    errors = []
     for pos, address in enumerate(holders):
         peers = holders[pos + 1:] + holders[:pos]
         # two attempts per holder, but ONLY for transport-class failures:

@@ -95,7 +95,32 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         _panel("Transfer chunks (rate)",
                "rate(object_transfer_chunks_pulled[1m])", 1, 0),
     ])
-    return {"core": core, "serve": serve, "data": data}
+    disagg = _dashboard("raytpu-disagg", "ray_tpu / disagg serving", [
+        _panel("KV migration p50/p95",
+               "histogram_quantile(0.5, "
+               "rate(serve_kv_migration_seconds_bucket[5m]))",
+               0, 0, unit="s", legend="p50 {{transport}}"),
+        _panel("KV migration throughput (B/s)",
+               "rate(serve_kv_migration_bytes[1m])", 1, 0, unit="Bps",
+               legend="{{transport}}"),
+        _panel("Queue depth by role", "serve_disagg_queue_depth", 2, 8,
+               legend="{{role}} {{node_id}}"),
+        _panel("In-flight by role", "serve_disagg_inflight", 3, 8,
+               legend="{{role}} {{node_id}}"),
+        _panel("Object pulls p95 (KV path rides this)",
+               "histogram_quantile(0.95, rate(object_pull_seconds_bucket[5m]))",
+               4, 16, unit="s", legend="p95 {{path}}"),
+        _panel("TTFT p95 per node",
+               "histogram_quantile(0.95, rate(serve_ttft_seconds_bucket[5m]))",
+               5, 16, unit="s", legend="{{node_id}}"),
+    ])
+    disagg["panels"][0]["targets"].append({
+        "expr": "histogram_quantile(0.95, "
+                "rate(serve_kv_migration_seconds_bucket[5m]))",
+        "legendFormat": "p95 {{transport}}",
+        "refId": "B",
+    })
+    return {"core": core, "serve": serve, "data": data, "disagg": disagg}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:
@@ -131,6 +156,57 @@ def write_grafana_dashboards(directory: str) -> List[str]:
 # ---------------------------------------------------------------------------
 
 _dash_server = None
+
+
+def _render_metrics() -> str:
+    """Cluster-wide Prometheus text: the head registry merged with the
+    per-node snapshots workers federate via heartbeat telemetry (each
+    remote series tagged node_id/role). Falls back to local-only when no
+    runtime is up or no worker has reported."""
+    from .core import core_worker
+    from .core.metrics import render_merged
+
+    snaps: Dict[str, Any] = {}
+    if core_worker.runtime_initialized():
+        try:
+            cp = core_worker.get_runtime().control_plane
+            snaps = cp.telemetry_snapshots()
+        except Exception:  # noqa: BLE001 — /metrics must always render
+            snaps = {}
+    if not snaps:
+        return metrics_registry.render_prometheus()
+    return render_merged(metrics_registry, snaps)
+
+
+def _trace_payload(trace_id: str) -> Dict[str, Any]:
+    """Phase breakdown for /api/v0/traces/<trace_id>. Accepts the raw
+    trace id or an OpenAI X-Request-Id ('cmpl-<id>'/'chatcmpl-<id>' —
+    the id embeds the trace id)."""
+    from .util import tracing
+
+    tid = trace_id.split("-")[-1]
+    tree = tracing.get_trace(tid)
+    if not tree:
+        raise KeyError(trace_id)
+    phases: Dict[str, Dict[str, float]] = {}
+    pids = set()
+
+    def _walk(nodes):
+        for s in nodes:
+            pids.add(s.get("pid"))
+            dur_ms = ((s.get("end_us") or s["start_us"]) - s["start_us"]) / 1e3
+            agg = phases.setdefault(s["name"], {"count": 0, "total_ms": 0.0})
+            agg["count"] += 1
+            agg["total_ms"] += dur_ms
+            _walk(s.get("children", ()))
+
+    _walk(tree)
+    return {
+        "trace_id": tree[0]["trace_id"],
+        "processes": sorted(str(p) for p in pids),
+        "phases": phases,
+        "spans": tree,
+    }
 
 
 def _state_payload(what: str) -> Any:
@@ -228,9 +304,14 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                     )
                 if self.path == "/metrics":
                     return self._send(
-                        200, metrics_registry.render_prometheus().encode(),
+                        200, _render_metrics().encode(),
                         "text/plain; version=0.0.4",
                     )
+                # trace lookup must outrank the generic /api/v0/<what>
+                # state route
+                if self.path.startswith("/api/v0/traces/"):
+                    tid = self.path[len("/api/v0/traces/"):].strip("/")
+                    return self._json(200, _trace_payload(tid))
                 # job REST surface (reference: dashboard job module,
                 # `dashboard/modules/job/job_head.py` HTTP routes)
                 if self.path.startswith("/api/jobs/"):

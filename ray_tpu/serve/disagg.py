@@ -39,7 +39,8 @@ from typing import Any, Dict, List, Optional
 
 from .. import api
 from ..core.logging import get_logger
-from ..core.metrics import Counter, Gauge, Histogram
+from ..core.metrics import MICRO_BUCKETS, Counter, Gauge, Histogram
+from ..util import tracing
 from .config import DisaggConfig
 from .engine import InferenceEngine, Request
 from .router import _replica_key, pow2_choice
@@ -49,6 +50,7 @@ logger = get_logger("serve.disagg")
 _m_migration_s = Histogram(
     "serve_kv_migration_seconds",
     "KV blob fetch + import time on the decode side, tagged transport",
+    buckets=MICRO_BUCKETS,
 )
 _m_migration_b = Counter(
     "serve_kv_migration_bytes",
@@ -137,19 +139,25 @@ def replica_prefill(engine: InferenceEngine,
     default, DistChannel when kv_transfer=="channel" or the blob is at
     or under small_blob_bytes and a destination channel was provided."""
     opts = _norm_request(request)
-    req = Request(prefill_only=True, **opts)
-    engine.add_request(req)
-    blob = engine.export_kv_pages(
-        req, timeout_s=float(request.get("timeout_s", 600.0)))
-    nbytes = int(blob["k"].nbytes) + int(blob["v"].nbytes)
-    kv_dest = request.get("kv_dest")
-    kv_transfer = request.get("kv_transfer", "object")
-    small = int(request.get("small_blob_bytes", 0))
-    if kv_dest is not None and (kv_transfer == "channel" or nbytes <= small):
-        kv_dest.put((req.request_id, blob))
-        handoff = {"kind": "channel", "bytes": nbytes}
-    else:
-        handoff = {"kind": "object", "ref": api.put(blob), "bytes": nbytes}
+    with tracing.span_if_traced(
+            "prefill", {"request_id": opts["request_id"]},
+            context=request.get("trace_ctx")):
+        req = Request(prefill_only=True, **opts)
+        engine.add_request(req)
+        blob = engine.export_kv_pages(
+            req, timeout_s=float(request.get("timeout_s", 600.0)))
+        nbytes = int(blob["k"].nbytes) + int(blob["v"].nbytes)
+        kv_dest = request.get("kv_dest")
+        kv_transfer = request.get("kv_transfer", "object")
+        small = int(request.get("small_blob_bytes", 0))
+        with tracing.span_if_traced("kv_export", {"bytes": nbytes}):
+            if kv_dest is not None and (
+                    kv_transfer == "channel" or nbytes <= small):
+                kv_dest.put((req.request_id, blob))
+                handoff = {"kind": "channel", "bytes": nbytes}
+            else:
+                handoff = {"kind": "object", "ref": api.put(blob),
+                           "bytes": nbytes}
     return {
         "request_id": req.request_id,
         "first_token": int(blob["first_token"]),
@@ -180,10 +188,15 @@ def _import_request(engine: InferenceEngine, request: Dict[str, Any],
 
     handoff = request["kv"]
     t0 = time.monotonic()
-    blob = _fetch_blob(request, inbox)
+    with tracing.span_if_traced(
+            "kv_migration",
+            {"transport": handoff["kind"],
+             "bytes": int(handoff.get("bytes", 0))}):
+        blob = _fetch_blob(request, inbox)
     opts = _norm_request(request)
     req = Request(stream_q=_queue.Queue() if stream else None, **opts)
-    engine.import_kv_pages(req, blob)
+    with tracing.span_if_traced("kv_import"):
+        engine.import_kv_pages(req, blob)
     elapsed = time.monotonic() - t0
     tags = {"transport": handoff["kind"]}
     _m_migration_s.observe(elapsed, tags=tags)
@@ -194,11 +207,14 @@ def _import_request(engine: InferenceEngine, request: Dict[str, Any],
 
 def replica_decode(engine: InferenceEngine, request: Dict[str, Any],
                    inbox: Optional[KvInbox] = None) -> Dict[str, Any]:
-    req = _import_request(engine, request, inbox)
-    timeout = float(request.get("timeout_s", 600.0))
-    if not req.done.wait(timeout):
-        engine.cancel(req.request_id)
-        raise TimeoutError(f"decode for {req.request_id} timed out")
+    with tracing.span_if_traced(
+            "decode", {"request_id": request.get("request_id", "")},
+            context=request.get("trace_ctx")):
+        req = _import_request(engine, request, inbox)
+        timeout = float(request.get("timeout_s", 600.0))
+        if not req.done.wait(timeout):
+            engine.cancel(req.request_id)
+            raise TimeoutError(f"decode for {req.request_id} timed out")
     if req.error:
         raise ValueError(req.error)
     return {
@@ -217,22 +233,39 @@ def replica_decode_stream(engine: InferenceEngine, request: Dict[str, Any],
     included), then ONE trailing dict with finish_reason/error — the
     coordinator strips it (generators cross actor handles live in the
     in-process runtime, so this rides the same path `stream` does)."""
-    req = _import_request(engine, request, inbox, stream=True)
+    ctx = request.get("trace_ctx")
+    span = None
+    if ctx is not None or tracing.current_span() is not None:
+        # manual span: decode covers import through stream exhaustion, so
+        # it must outlive this call and finish when the generator does
+        span = tracing.Span(
+            "decode", attrs={"request_id": request.get("request_id", ""),
+                             "stream": True},
+            **({"trace_id": ctx["trace_id"], "parent_id": ctx["span_id"]}
+               if ctx is not None else
+               {"trace_id": tracing.current_span().trace_id,
+                "parent_id": tracing.current_span().span_id}))
+    with tracing.activate(span):
+        req = _import_request(engine, request, inbox, stream=True)
     timeout = float(request.get("timeout_s", 600.0))
 
     def gen():
-        while True:
-            tok = req.stream_q.get(timeout=timeout)
-            if tok is None:
-                break
-            yield tok
-        yield {
-            "finish_reason": req.finish_reason,
-            "error": req.error,
-            "migration_s": req._migration_s,
-            "migration_bytes": int(request["kv"].get("bytes", 0)),
-            "kv_transport": request["kv"]["kind"],
-        }
+        try:
+            while True:
+                tok = req.stream_q.get(timeout=timeout)
+                if tok is None:
+                    break
+                yield tok
+            yield {
+                "finish_reason": req.finish_reason,
+                "error": req.error,
+                "migration_s": req._migration_s,
+                "migration_bytes": int(request["kv"].get("bytes", 0)),
+                "kv_transport": request["kv"]["kind"],
+            }
+        finally:
+            if span is not None:
+                span.finish()
 
     return gen()
 
@@ -473,18 +506,19 @@ class DisaggCoordinator:
     def _pick(self, role: str, deadline: float):
         _m_queue_depth.add(1, tags={"role": role})
         try:
-            while True:
-                self._sync()
-                with self._lock:
-                    workers = list(self._workers[role])
-                if workers:
-                    idx = pow2_choice(
-                        len(workers), lambda i: workers[i].load())
-                    return workers[idx]
-                if time.monotonic() > deadline:
-                    raise RuntimeError(f"no {role} replicas available")
-                time.sleep(0.1)
-                self._sync(force=True)
+            with tracing.span_if_traced("disagg.queue_wait", {"role": role}):
+                while True:
+                    self._sync()
+                    with self._lock:
+                        workers = list(self._workers[role])
+                    if workers:
+                        idx = pow2_choice(
+                            len(workers), lambda i: workers[i].load())
+                        return workers[idx]
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"no {role} replicas available")
+                    time.sleep(0.1)
+                    self._sync(force=True)
         finally:
             _m_queue_depth.add(-1, tags={"role": role})
 
@@ -501,6 +535,8 @@ class DisaggCoordinator:
             "timeout_s": float(timeout_s),
             "kv_transfer": self.cfg.kv_transfer,
             "small_blob_bytes": self.cfg.small_blob_bytes,
+            # None when untraced: replicas skip all span work on that path
+            "trace_ctx": tracing.current_context(),
         }
 
     def _run_prefill(self, base: Dict[str, Any], deadline: float,
@@ -520,17 +556,18 @@ class DisaggCoordinator:
                  top_k: int = 0, stop: Optional[List[List[int]]] = None,
                  request_id: Optional[str] = None,
                  timeout_s: float = 600.0) -> Dict[str, Any]:
-        base = self._base_request(prompt, max_tokens, temperature, top_p,
-                                  top_k, stop, request_id, timeout_s)
-        t0 = time.monotonic()
-        deadline = t0 + timeout_s
-        try:
-            dworker = self._pick("decode", deadline)
-            pres = self._run_prefill(base, deadline, dworker)
-            with _m_inflight.track(tags={"role": "decode"}):
-                dres = dworker.decode_request({**base, "kv": pres["kv"]})
-        finally:
-            self._live.pop(base["request_id"], None)
+        with tracing.span_if_traced("disagg.admit", {"kind": "generate"}):
+            base = self._base_request(prompt, max_tokens, temperature, top_p,
+                                      top_k, stop, request_id, timeout_s)
+            t0 = time.monotonic()
+            deadline = t0 + timeout_s
+            try:
+                dworker = self._pick("decode", deadline)
+                pres = self._run_prefill(base, deadline, dworker)
+                with _m_inflight.track(tags={"role": "decode"}):
+                    dres = dworker.decode_request({**base, "kv": pres["kv"]})
+            finally:
+                self._live.pop(base["request_id"], None)
         return {
             "request_id": base["request_id"],
             "token_ids": dres["token_ids"],
@@ -552,16 +589,17 @@ class DisaggCoordinator:
         """Prefill synchronously (TTFT is paid here), then return a
         stream over the decode replica's tokens — the seeded first token
         arrives as the stream's first item."""
-        base = self._base_request(prompt, max_tokens, temperature, top_p,
-                                  top_k, stop, request_id, timeout_s)
-        deadline = time.monotonic() + timeout_s
-        dworker = self._pick("decode", deadline)
-        try:
-            pres = self._run_prefill(base, deadline, dworker)
-            raw = dworker.decode_stream({**base, "kv": pres["kv"]})
-        except BaseException:
-            self._live.pop(base["request_id"], None)
-            raise
+        with tracing.span_if_traced("disagg.admit", {"kind": "stream"}):
+            base = self._base_request(prompt, max_tokens, temperature, top_p,
+                                      top_k, stop, request_id, timeout_s)
+            deadline = time.monotonic() + timeout_s
+            dworker = self._pick("decode", deadline)
+            try:
+                pres = self._run_prefill(base, deadline, dworker)
+                raw = dworker.decode_stream({**base, "kv": pres["kv"]})
+            except BaseException:
+                self._live.pop(base["request_id"], None)
+                raise
 
         def finishing():
             try:

@@ -1648,6 +1648,8 @@ class InferenceEngine:
     ) -> Dict[str, Any]:
         import uuid
 
+        from ..util import tracing
+
         req = Request(
             request_id=request_id or uuid.uuid4().hex,
             prompt=list(prompt),
@@ -1657,12 +1659,14 @@ class InferenceEngine:
             top_k=top_k,
             stop=stop,
         )
-        self.add_request(req)
-        if not req.done.wait(timeout_s):
-            # the caller is gone: cancel so the slot/pages free instead of
-            # decoding to max_tokens for nobody
-            self.cancel(req.request_id)
-            raise TimeoutError(f"request {req.request_id} timed out")
+        with tracing.span_if_traced("engine.generate",
+                                    {"request_id": req.request_id}):
+            self.add_request(req)
+            if not req.done.wait(timeout_s):
+                # the caller is gone: cancel so the slot/pages free instead
+                # of decoding to max_tokens for nobody
+                self.cancel(req.request_id)
+                raise TimeoutError(f"request {req.request_id} timed out")
         if req.error:
             raise ValueError(req.error)
         return {

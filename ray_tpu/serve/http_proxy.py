@@ -54,11 +54,15 @@ class HTTPProxy:
             def log_message(self, fmt, *args):  # quiet
                 logger.debug("http: " + fmt, *args)
 
-            def _send(self, code: int, payload: Any):
+            def _send(self, code: int, payload: Any,
+                      request_id: Optional[str] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if request_id:
+                    # doubles as the trace id: /api/v0/traces/<this>
+                    self.send_header("X-Request-Id", str(request_id))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -89,18 +93,24 @@ class HTTPProxy:
                 try:
                     result = handle.remote(payload).result(timeout=300.0)
                     if _is_stream(result):
-                        return self._send_sse(result)
-                    return self._send(200, {"result": _jsonable(result)})
+                        return self._send_sse(
+                            result, getattr(result, "request_id", None))
+                    rid = (result.get("id")
+                           if isinstance(result, dict) else None)
+                    return self._send(200, {"result": _jsonable(result)},
+                                      request_id=rid)
                 except Exception as e:
                     logger.warning("request failed", exc_info=True)
                     return self._send(500, {"error": str(e)})
 
-            def _send_sse(self, chunks):
+            def _send_sse(self, chunks, request_id: Optional[str] = None):
                 """Server-sent events: one `data:` line per chunk, then
                 [DONE] (the OpenAI streaming wire format)."""
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                if request_id:
+                    self.send_header("X-Request-Id", str(request_id))
                 self.end_headers()
                 try:
                     try:

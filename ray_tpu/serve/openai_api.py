@@ -24,8 +24,28 @@ from typing import Any, Dict, Iterable, List, Optional
 import jax
 
 from ..models import get_config, init_params
+from ..util import tracing
 from .deployment import deployment
 from .engine import EngineConfig, InferenceEngine
+
+
+class SSEStream:
+    """Iterator wrapper for streaming responses that carries the request
+    id alongside the chunks, so the HTTP proxy can emit an X-Request-Id
+    header (which doubles as the trace id) before the first event."""
+
+    def __init__(self, request_id: str, gen):
+        self.request_id = request_id
+        self._gen = gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        self._gen.close()
 
 
 class ByteTokenizer:
@@ -178,13 +198,23 @@ class OpenAIServer:
         temperature = float(body.get("temperature", 0.0))
         top_p = float(body.get("top_p", 1.0))
         stop = self._stop_ids(body)
-        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        root = tracing.maybe_begin("request:completions")
+        # the trace id IS the request id when sampled, so the response's
+        # X-Request-Id can be looked up at /api/v0/traces/<id>
+        rid = (f"cmpl-{root.trace_id}" if root is not None
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
         if body.get("stream"):
-            return self._stream_sse(
+            return SSEStream(rid, self._stream_sse(
                 rid, "text_completion", ids, max_tokens, temperature, top_p,
-                stop,
-            )
-        out = self._generate(ids, max_tokens, temperature, top_p, stop)
+                stop, root=root,
+            ))
+        try:
+            with tracing.activate(root):
+                out = self._generate(ids, max_tokens, temperature, top_p,
+                                     stop)
+        finally:
+            if root is not None:
+                root.finish()
         text = self.tokenizer.decode(out["token_ids"])
         return {
             "id": rid,
@@ -209,11 +239,20 @@ class OpenAIServer:
         temperature = float(body.get("temperature", 0.0))
         top_p = float(body.get("top_p", 1.0))
         stop = self._stop_ids(body)
-        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        root = tracing.maybe_begin("request:chat_completions")
+        rid = (f"chatcmpl-{root.trace_id}" if root is not None
+               else f"chatcmpl-{uuid.uuid4().hex[:24]}")
         if body.get("stream"):
-            return self._stream_sse(rid, "chat.completion", ids, max_tokens,
-                                    temperature, top_p, stop)
-        out = self._generate(ids, max_tokens, temperature, top_p, stop)
+            return SSEStream(rid, self._stream_sse(
+                rid, "chat.completion", ids, max_tokens, temperature, top_p,
+                stop, root=root))
+        try:
+            with tracing.activate(root):
+                out = self._generate(ids, max_tokens, temperature, top_p,
+                                     stop)
+        finally:
+            if root is not None:
+                root.finish()
         text = self.tokenizer.decode(out["token_ids"])
         return {
             "id": rid,
@@ -253,10 +292,12 @@ class OpenAIServer:
     # ------------------------------------------------------------ helpers
 
     def _stream_sse(self, rid, obj, ids, max_tokens, temperature, top_p=1.0,
-                    stop=None):
+                    stop=None, root=None):
         """Generator of OpenAI stream chunks; the HTTP proxy emits each as
         a server-sent event (in-process runtime: generators cross the
-        handle live)."""
+        handle live). `root` is the sampled request span — admission runs
+        under it, and it finishes with the stream (covering every decode
+        step through stream teardown)."""
         tokenizer, model = self.tokenizer, self.model_name
         engine, coordinator = self.engine, self._coordinator
 
@@ -265,20 +306,21 @@ class OpenAIServer:
             # client that disconnects before consuming anything never
             # admits a request at all (a never-started generator's
             # finally cannot run, so nothing may need cancelling either)
-            if coordinator is not None:
-                ds = coordinator.open_stream(
-                    ids, max_tokens=max_tokens, temperature=temperature,
-                    top_p=top_p, stop=stop,
-                )
-                stream = ds.tokens()
-                finish, cancel = (lambda: ds.finish_reason), ds.cancel
-            else:
-                req, stream = engine.open_stream(
-                    ids, max_tokens=max_tokens, temperature=temperature,
-                    top_p=top_p, stop=stop,
-                )
-                finish = lambda: req.finish_reason  # noqa: E731
-                cancel = lambda: engine.cancel(req.request_id)  # noqa: E731
+            with tracing.activate(root):
+                if coordinator is not None:
+                    ds = coordinator.open_stream(
+                        ids, max_tokens=max_tokens, temperature=temperature,
+                        top_p=top_p, stop=stop,
+                    )
+                    stream = ds.tokens()
+                    finish, cancel = (lambda: ds.finish_reason), ds.cancel
+                else:
+                    req, stream = engine.open_stream(
+                        ids, max_tokens=max_tokens, temperature=temperature,
+                        top_p=top_p, stop=stop,
+                    )
+                    finish = lambda: req.finish_reason  # noqa: E731
+                    cancel = lambda: engine.cancel(req.request_id)  # noqa: E731
             try:
                 yield from body(stream, finish)
             finally:
@@ -287,6 +329,8 @@ class OpenAIServer:
                 # frees the slot/pages of an abandoned one (reference:
                 # serve's disconnect-driven cancellation)
                 cancel()
+                if root is not None:
+                    root.finish()
 
         def body(stream, finish):
             created = int(time.time())

@@ -21,12 +21,13 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.config import config
 
 _lock = threading.Lock()
 _events: "deque[Dict[str, Any]]" = deque(maxlen=10_000)
+_total = 0  # events ever recorded (monotone; the ring may have dropped some)
 _t0_us = time.time() * 1e6 - time.perf_counter() * 1e6
 
 
@@ -67,8 +68,38 @@ def record(
         ev["dur"] = dur_us
     if args:
         ev["args"] = args
+    global _total
     with _lock:
         _events.append(ev)
+        _total += 1
+
+
+def drain_since(cursor: int) -> Tuple[int, List[Dict[str, Any]]]:
+    """Events recorded after `cursor` (a value this function previously
+    returned; start at 0) plus the new cursor. Read-only: the caller owns
+    the cursor, so a failed telemetry flush retries with the old one."""
+    with _lock:
+        dropped = _total - len(_events)
+        start = max(0, cursor - dropped)
+        return _total, [dict(ev) for ev in list(_events)[start:]]
+
+
+def ingest(events: List[Dict[str, Any]], lane: str) -> int:
+    """Merge events flushed from another process into this buffer (head
+    side of telemetry federation). Each event's pid becomes
+    '<lane>/<orig pid>' so the merged chrome-trace shows one process
+    group per source node. Returns the number added."""
+    if not events:
+        return 0
+    configure()
+    global _total
+    with _lock:
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = f"{lane}/{ev.get('pid', '?')}"
+            _events.append(ev)
+            _total += 1
+    return len(events)
 
 
 @contextlib.contextmanager

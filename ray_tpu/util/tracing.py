@@ -5,11 +5,17 @@ reference wraps task submission and worker execution in OpenTelemetry
 spans so one request's causality chain is visible across processes. Same
 shape here without the OTel dependency (zero-egress image): W3C-style
 ids, a thread-local current span, automatic context injection at
-`.remote()` and extraction around user-function execution
-(`node_agent._invoke`), spans buffered per process and exportable as
-chrome-trace events alongside the timeline (`util/timeline.py`), so one
-`ray-tpu timeline` capture shows both profiling spans AND request
-causality.
+`.remote()` (api.RemoteFunction / core_worker.submit_actor_task) and
+extraction around user-function execution
+(`node_agent._call_user_function`, `actor_process._child_main`) and
+around each disaggregated-serving leg (`serve/disagg.py`). Spans buffer
+per process; worker processes flush them to the head with their
+heartbeat telemetry (`cross_host.WorkerRuntime`, ingested by
+`control_plane.report_telemetry`), so `get_trace()` at the head sees one
+connected tree spanning every process a request touched. They are also
+exportable as chrome-trace events alongside the timeline
+(`util/timeline.py`), so one `ray-tpu timeline` capture shows both
+profiling spans AND request causality.
 
 Usage:
 
@@ -18,24 +24,28 @@ Usage:
     with tracing.start_span("handle_request", {"route": "/chat"}):
         ref = my_task.remote(x)       # ctx injected automatically
         ray_tpu.get(ref)
-    spans = tracing.get_spans()       # incl. the task's execute span
+    tree = tracing.get_trace(...)     # incl. the task's execute span
                                       # (same trace_id, parented here)
 
 Propagation is on only while a span is active — zero overhead otherwise
-(the spec field stays None)."""
+(the spec field stays None). Serve entry points additionally open root
+spans for a `config.trace_sample_rate` fraction of requests (default 0:
+off, the zero-overhead fast path)."""
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 import uuid
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _local = threading.local()
 _lock = threading.Lock()
 _spans: List[Dict[str, Any]] = []
+_total = 0  # spans ever buffered (monotone; _spans may have been trimmed)
 _MAX_SPANS = 10_000
 
 
@@ -63,6 +73,8 @@ class Span:
         return {"trace_id": self.trace_id, "span_id": self.span_id}
 
     def finish(self) -> None:
+        if self.end_us is not None:
+            return  # idempotent: stream teardown paths may race
         self.end_us = _now_us()
         rec = {
             "trace_id": self.trace_id, "span_id": self.span_id,
@@ -70,10 +82,27 @@ class Span:
             "attrs": self.attrs, "start_us": self.start_us,
             "end_us": self.end_us, "pid": os.getpid(),
         }
+        global _total
         with _lock:
             _spans.append(rec)
+            _total += 1
             if len(_spans) > _MAX_SPANS:
                 del _spans[: len(_spans) - _MAX_SPANS]
+
+
+class _RemoteParent:
+    """A remote span context installed as this thread's parent without
+    recording a span (see `activate`): just enough surface for
+    `start_span` / `current_context` to chain under it."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def context(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
 
 
 def current_span() -> Optional[Span]:
@@ -87,12 +116,42 @@ def current_context() -> Optional[Dict[str, str]]:
     return span.context() if span is not None else None
 
 
+def should_sample() -> bool:
+    """Head-based sampling decision for a NEW request root
+    (config.trace_sample_rate). The rate-0 default short-circuits before
+    touching the RNG — the provably-zero-overhead path."""
+    from ..core.config import config
+
+    rate = float(config.trace_sample_rate)
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    return random.random() < rate
+
+
+def maybe_begin(name: str, attrs: Optional[Dict[str, Any]] = None
+                ) -> Optional[Span]:
+    """Request-entry hook for serve surfaces: returns an OPEN span (not
+    thread-current, not auto-finished — the caller owns `finish()`, via
+    `activate()` for the synchronous part and a finally for streams)
+    when this thread is already traced or the sampler fires; None on the
+    untraced fast path."""
+    parent = current_span()
+    if parent is not None:
+        return Span(name, trace_id=parent.trace_id,
+                    parent_id=parent.span_id, attrs=attrs)
+    if should_sample():
+        return Span(name, attrs=attrs)
+    return None
+
+
 @contextmanager
 def start_span(name: str, attrs: Optional[Dict[str, Any]] = None,
                context: Optional[Dict[str, str]] = None):
     """Open a span. `context` parents it under a REMOTE span (extracted
-    from an incoming TaskSpec); otherwise it nests under this thread's
-    current span (or starts a fresh trace)."""
+    from an incoming TaskSpec or serve request dict); otherwise it nests
+    under this thread's current span (or starts a fresh trace)."""
     parent = current_span()
     if context is not None:
         span = Span(name, trace_id=context["trace_id"],
@@ -111,6 +170,42 @@ def start_span(name: str, attrs: Optional[Dict[str, Any]] = None,
         _local.span = prev
 
 
+@contextmanager
+def span_if_traced(name: str, attrs: Optional[Dict[str, Any]] = None,
+                   context: Optional[Dict[str, str]] = None):
+    """`start_span`, but only when a trace is already active — an
+    explicit remote `context` or a thread-current span. The untraced
+    path yields None without touching the buffer or the RNG, so hot
+    paths (object pulls, channel sends, disagg legs) can instrument
+    unconditionally at zero cost."""
+    if context is None and getattr(_local, "span", None) is None:
+        yield None
+        return
+    with start_span(name, attrs, context=context) as s:
+        yield s
+
+
+@contextmanager
+def activate(span_or_ctx):
+    """Make an already-open span (or a bare remote context dict) current
+    on this thread WITHOUT finishing it on exit — re-entry for request
+    work that resumes on other threads (stream generators, get() pool
+    workers). Accepts None as a no-op so callers can write
+    `with tracing.activate(maybe_begin(...)):` unconditionally."""
+    if span_or_ctx is None:
+        yield None
+        return
+    if isinstance(span_or_ctx, dict):
+        span_or_ctx = _RemoteParent(span_or_ctx["trace_id"],
+                                    span_or_ctx["span_id"])
+    prev = current_span()
+    _local.span = span_or_ctx
+    try:
+        yield span_or_ctx
+    finally:
+        _local.span = prev
+
+
 def get_spans(trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
     with _lock:
         out = list(_spans)
@@ -119,15 +214,80 @@ def get_spans(trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+def get_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """The trace as a TREE: root span records (those whose parent is
+    absent from the buffer) each carrying a recursively-nested
+    `children` list; every level sorted by start time. `trace_id` may be
+    a unique prefix (the OpenAI `X-Request-Id` embeds the full id, but
+    dashboards may hold a truncation)."""
+    with _lock:
+        recs = [dict(s) for s in _spans
+                if s["trace_id"] == trace_id
+                or s["trace_id"].startswith(trace_id)]
+    by_id = {s["span_id"]: s for s in recs}
+    roots: List[Dict[str, Any]] = []
+    for s in recs:
+        s.setdefault("children", [])
+    for s in recs:
+        parent = by_id.get(s["parent_id"]) if s["parent_id"] else None
+        if parent is not None and parent is not s:
+            parent["children"].append(s)
+        else:
+            roots.append(s)
+
+    def _sort(nodes: List[Dict[str, Any]]) -> None:
+        nodes.sort(key=lambda n: n["start_us"])
+        for n in nodes:
+            _sort(n["children"])
+
+    _sort(roots)
+    return roots
+
+
+def drain_since(cursor: int) -> Tuple[int, List[Dict[str, Any]]]:
+    """Span records buffered after `cursor` (a value this function
+    previously returned; start at 0) plus the new cursor. Read-only —
+    the caller owns the cursor, so a failed flush can simply retry with
+    the old one (ingest() dedupes by span_id)."""
+    with _lock:
+        dropped = _total - len(_spans)
+        start = max(0, cursor - dropped)
+        return _total, list(_spans[start:])
+
+
+def ingest(records: List[Dict[str, Any]]) -> int:
+    """Merge span records flushed from another process into this
+    buffer (head side of telemetry federation). Deduped by span_id so a
+    retried flush is harmless. Returns the number actually added."""
+    if not records:
+        return 0
+    global _total
+    added = 0
+    with _lock:
+        seen = {s["span_id"] for s in _spans}
+        for rec in records:
+            sid = rec.get("span_id")
+            if sid is None or sid in seen:
+                continue
+            seen.add(sid)
+            _spans.append(dict(rec))
+            _total += 1
+            added += 1
+        if len(_spans) > _MAX_SPANS:
+            del _spans[: len(_spans) - _MAX_SPANS]
+    return added
+
+
 def clear() -> None:
     with _lock:
         _spans.clear()
 
 
 def export_to_timeline() -> int:
-    """Mirror buffered spans into the chrome-trace timeline (pid lane
-    'trace', tid = trace id prefix) so `ray-tpu timeline` renders request
-    causality next to task/profiling spans."""
+    """Mirror buffered spans into the chrome-trace timeline (one lane
+    per SOURCE process: pid 'trace/<ospid>', tid = trace id prefix) so
+    `ray-tpu timeline` renders request causality next to task/profiling
+    spans — federated spans land in their origin process's lane."""
     from . import timeline
 
     n = 0
@@ -135,7 +295,7 @@ def export_to_timeline() -> int:
         timeline.record(
             s["name"], "X", cat="trace", ts_us=s["start_us"],
             dur_us=(s["end_us"] or s["start_us"]) - s["start_us"],
-            pid="trace", tid=s["trace_id"][:8],
+            pid=f"trace/{s['pid']}", tid=s["trace_id"][:8],
             args={"span": s["span_id"], "parent": s["parent_id"],
                   **{k: v for k, v in s["attrs"].items()
                      if isinstance(v, (int, float, str))}},

@@ -103,6 +103,61 @@ class TestMetrics:
         with pytest.raises(ValueError):
             c.inc(-1)
 
+    def test_fresh_keeps_module_level_metrics_alive(self):
+        # clear() orphans import-time metric objects (they keep writing,
+        # nothing exports them, and re-creating the name raises). fresh()
+        # is the between-tests reset that avoids all three failure modes.
+        reg = MetricsRegistry()
+        c = Counter("mod_count", "a module-level counter", registry_=reg)
+        c.inc(7)
+        reg.fresh()
+        assert c.get() == 0
+        c.inc(2)
+        assert "mod_count 2.0" in reg.render_prometheus()
+        assert reg.get("mod_count") is c  # still registered
+
+    def test_clear_orphans_and_unregister_recovers(self):
+        reg = MetricsRegistry()
+        c = Counter("orphan", registry_=reg)
+        reg.clear()
+        c.inc(5)  # writes go nowhere: no longer exported
+        assert "orphan" not in reg.render_prometheus()
+        # same name re-registers fine after clear(); but with the object
+        # still around, a second clear-less replacement needs unregister()
+        c2 = Counter("orphan", registry_=reg)
+        with pytest.raises(ValueError):
+            Counter("orphan", registry_=reg)
+        assert reg.unregister("orphan") is True
+        assert reg.unregister("orphan") is False
+        c3 = Counter("orphan", registry_=reg)
+        c3.inc(1)
+        assert reg.get("orphan") is c3 and c2.get() == 0
+
+    def test_histogram_bucket_override(self):
+        reg = MetricsRegistry()
+        from ray_tpu.core.metrics import MICRO_BUCKETS
+        h = Histogram("fast_op_seconds", buckets=MICRO_BUCKETS, registry_=reg)
+        h.observe(3e-6)
+        h.observe(4e-4)
+        text = reg.render_prometheus()
+        # µs-resolution boundaries actually appear in the exposition
+        assert 'le="5e-06"' in text and 'le="0.0005"' in text
+
+    def test_snapshot_and_render_merged(self):
+        head = MetricsRegistry()
+        Counter("shared_total", "d", registry_=head).inc(1)
+        worker = MetricsRegistry()
+        Counter("shared_total", "d", registry_=worker).inc(4, {"k": "v"})
+        Counter("worker_only_total", registry_=worker).inc(2)
+        from ray_tpu.core.metrics import render_merged
+        merged = render_merged(
+            head, {"abcdef0123456789": {"role": "worker",
+                                        "metrics": worker.snapshot()}})
+        assert merged.count("# TYPE shared_total counter") == 1
+        assert 'node_id="abcdef012345"' in merged
+        assert 'role="worker"' in merged
+        assert "worker_only_total" in merged
+
 
 class TestObjectStore:
     def _oid(self):

@@ -63,10 +63,116 @@ class TestHTTP:
             _get(dash, "/api/v0/nope")
         assert ei.value.code == 404
 
+    def test_trace_route_phase_breakdown(self, dash):
+        import urllib.error
+
+        from ray_tpu.util import tracing
+
+        tracing.clear()
+        with tracing.start_span("req") as root:
+            with tracing.start_span("phase_a"):
+                pass
+            with tracing.start_span("phase_a"):
+                pass
+        status, body = _get(dash, f"/api/v0/traces/{root.trace_id}")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["trace_id"] == root.trace_id
+        assert payload["phases"]["phase_a"]["count"] == 2
+        assert payload["phases"]["req"]["total_ms"] >= 0
+        assert payload["spans"][0]["name"] == "req"
+        # the wire form (X-Request-Id) resolves to the same trace
+        _, body2 = _get(dash, f"/api/v0/traces/cmpl-{root.trace_id}")
+        assert json.loads(body2)["trace_id"] == root.trace_id
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(dash, "/api/v0/traces/00000000deadbeef")
+        assert ei.value.code == 404
+
+
+class TestMetricsFederation:
+    """Worker registries piggyback snapshots on heartbeat telemetry; the
+    head's /metrics merges them tagged with node_id/role."""
+
+    def test_worker_counter_reaches_head_metrics(self):
+        import subprocess
+        import sys
+        import textwrap
+        import time
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["RAY_TPU_WORKER_PROCESSES"] = "0"
+        env.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+        env["RAY_TPU_TELEMETRY_REPORT_PERIOD_S"] = "0.2"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+        ray_tpu.shutdown()
+        rt = ray_tpu.init(
+            num_cpus=1, num_tpus=0,
+            system_config={"control_plane_rpc_port": 0,
+                           "worker_processes": 0},
+        )
+        code = textwrap.dedent(f"""
+            import ray_tpu
+            w = ray_tpu.init(address={rt._cp_server.address!r}, num_cpus=4,
+                             num_tpus=0, resources={{"magic": 1.0}})
+            w.wait(timeout=300)
+        """)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        port = None
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if len(rt.control_plane.alive_nodes()) >= 2:
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("worker never joined")
+
+            @ray_tpu.remote(resources={"magic": 1})
+            def bump():
+                from ray_tpu.core.metrics import Counter, registry
+
+                c = registry.get("dash_fed_total")
+                if c is None:
+                    c = Counter("dash_fed_total", "worker-only counter")
+                c.inc(3)
+                return True
+
+            assert ray_tpu.get(bump.remote(), timeout=60) is True
+            port = start_dashboard(port=0)
+            body = b""
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                _, body = _get(port, "/metrics")
+                if b"dash_fed_total" in body:
+                    break
+                time.sleep(0.25)
+            text = body.decode()
+            assert "dash_fed_total" in text, "worker metric never federated"
+            line = next(ln for ln in text.splitlines()
+                        if ln.startswith("dash_fed_total{"))
+            assert 'node_id="' in line and 'role="worker"' in line
+            assert line.endswith(" 3.0")
+            # the head never incremented it: only the tagged series exists
+            assert "\ndash_fed_total " not in text
+        finally:
+            if port is not None:
+                stop_dashboard()
+            ray_tpu.shutdown()
+            if proc.poll() is None:
+                proc.kill()
+
 
 class TestGrafana:
     def test_dashboards_reference_real_metrics(self):
         import ray_tpu.core.object_transfer  # noqa: F401 — registers metrics
+        import ray_tpu.serve.disagg  # noqa: F401 — registers disagg metrics
         import ray_tpu.serve.engine  # noqa: F401 — registers serve metrics
         from ray_tpu.core.metrics import registry
 
@@ -83,7 +189,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 3
+        assert len(jsons) == 4  # core, data, serve, disagg
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p

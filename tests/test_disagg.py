@@ -209,6 +209,39 @@ class TestDisaggCoordinator:
         assert ds.finish_reason == "length"
         assert ds.migration_bytes > 0
 
+    def test_one_request_one_connected_trace(self, pair):
+        """Tracing e2e: a single traced request through the disagg pipeline
+        yields ONE trace — admit, queue-wait, prefill, KV export, the
+        migration fetch, KV import, and decode all share the trace id and
+        chain into a single connected tree under the client span."""
+        from ray_tpu.util import tracing
+
+        cfg, co, _ = pair
+        prompt = _mixed_prompts(cfg, (9,))[0]
+        tracing.clear()
+        with tracing.start_span("client") as root:
+            out = co.generate(prompt, max_tokens=6)
+        assert out["token_ids"]
+        spans = tracing.get_spans(root.trace_id)
+        names = {s["name"] for s in spans}
+        assert {"disagg.admit", "disagg.queue_wait", "prefill", "kv_export",
+                "kv_migration", "kv_import", "decode"} <= names
+        # connected: every span's parent is also in the trace
+        by_id = {s["span_id"]: s for s in spans}
+        for s in spans:
+            if s["span_id"] != root.span_id:
+                assert s["parent_id"] in by_id, s["name"]
+        tree = tracing.get_trace(root.trace_id)
+        assert len(tree) == 1 and tree[0]["name"] == "client"
+
+    def test_untraced_request_records_nothing(self, pair):
+        from ray_tpu.util import tracing
+
+        cfg, co, _ = pair
+        before = len(tracing.get_spans())
+        co.generate(_mixed_prompts(cfg, (7,))[0], max_tokens=4)
+        assert len(tracing.get_spans()) == before  # zero-overhead path
+
 
 # --------------------------------------------------------------------------
 # serve deployment path (role replicas + coordinator-from-controller)
@@ -285,6 +318,7 @@ class TestDisaggCrossHost:
             env["JAX_PLATFORMS"] = "cpu"
             env["RAY_TPU_WORKER_PROCESSES"] = "0"
             env.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+            env["RAY_TPU_TELEMETRY_REPORT_PERIOD_S"] = "0.5"
             env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
             return env
 
@@ -346,6 +380,50 @@ class TestDisaggCrossHost:
                 assert out["kv_transport"] == "object"
         finally:
             ref.stop()
+            co.close()
+
+    def test_cross_host_trace_spans_multiple_processes(self, tiny,
+                                                       disagg_cluster):
+        """One traced request, prefill on host A / decode on host B: after
+        telemetry federation the HEAD's buffer holds prefill, migration,
+        and decode spans from at least two distinct pids, all under the
+        client's trace id."""
+        import time as _time
+
+        from ray_tpu.serve.disagg import deploy_disagg
+        from ray_tpu.util import tracing
+
+        cfg, params = tiny
+        ecfg = dict(max_batch_size=4, page_size=8, max_pages=64,
+                    max_seq_len=96, prefill_buckets=(16, 32))
+        co = deploy_disagg(
+            "tiny-llama",
+            {"prefill_replicas": 1, "decode_replicas": 1,
+             "small_blob_bytes": 0},
+            engine_config=ecfg,
+        )
+        try:
+            prompt = _mixed_prompts(cfg, (11,), seed=9)[0]
+            tracing.clear()
+            with tracing.start_span("xhost-client") as root:
+                out = co.generate(prompt, max_tokens=4, timeout_s=300.0)
+            assert out["token_ids"]
+            needed = {"prefill", "kv_migration", "decode"}
+            deadline = _time.monotonic() + 60
+            spans = []
+            while _time.monotonic() < deadline:
+                spans = tracing.get_spans(root.trace_id)
+                if needed <= {s["name"] for s in spans}:
+                    break
+                _time.sleep(0.5)
+            names = {s["name"] for s in spans}
+            assert needed <= names, f"federated spans missing: {names}"
+            role_pids = {s["name"]: s["pid"] for s in spans
+                         if s["name"] in ("prefill", "decode")}
+            # STRICT_SPREAD put the roles on different hosts => processes
+            assert role_pids["prefill"] != role_pids["decode"]
+            assert len({s["pid"] for s in spans}) >= 2
+        finally:
             co.close()
 
 

@@ -565,6 +565,46 @@ class TestOpenAI:
         out = _post(port, "/v1/models", {})
         assert out["result"]["data"][0]["id"] == "tiny-llama"
 
+    def test_request_id_header_doubles_as_trace_id(self, serve_session,
+                                                   monkeypatch):
+        """With trace_sample_rate=1.0 every request opens a root span; the
+        X-Request-Id response header embeds the trace id, so the id on the
+        wire resolves straight to the span tree (the /api/v0/traces/<id>
+        contract)."""
+        from ray_tpu.util import tracing
+
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE_RATE", "1.0")
+        port = self._run_app()
+        tracing.clear()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            rid = r.headers["X-Request-Id"]
+            body = json.loads(r.read())
+        assert rid and rid.startswith("cmpl-")
+        assert body["result"]["id"] == rid
+        tid = rid.split("-")[-1]
+        assert len(tid) == 32  # a full trace id, not a random suffix
+        deadline = time.monotonic() + 30
+        tree = []
+        while time.monotonic() < deadline:
+            tree = tracing.get_trace(tid)
+            if tree:
+                break
+            time.sleep(0.2)
+        assert tree and tree[0]["name"] == "request:completions"
+
+    def test_untraced_request_has_plain_id(self, serve_session):
+        port = self._run_app()
+        out = _post(port, "/v1/completions", {"prompt": "hi",
+                                              "max_tokens": 2})
+        rid = out["result"]["id"]
+        assert rid.startswith("cmpl-")
+        assert len(rid.split("-")[-1]) == 24  # random, shorter than a trace
+
     def test_streaming_sse(self, serve_session):
         port = self._run_app()
         req = urllib.request.Request(
