@@ -24,7 +24,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_sched.py tests/test_dag.py tests/test_collectives.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
 	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py \
-	tests/test_tracing.py
+	tests/test_tracing.py tests/test_health.py
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
@@ -37,8 +37,8 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all chaos tsan shm bench-data bench-object \
-	bench-serve bench-trace
+.PHONY: check check-slow check-all chaos health tsan shm status bench-data \
+	bench-object bench-serve bench-trace bench-health
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -64,6 +64,17 @@ bench-serve:
 bench-trace:
 	env RAY_TPU_BENCH_SUITE=trace python bench.py
 
+# SLO-digest overhead loop: decode burst with digests off vs on
+# (slo_digest_overhead_pct, acceptance <= 2%) plus the digest-update
+# micro-cost, merged into BENCH_SUMMARY.json
+bench-health:
+	env RAY_TPU_BENCH_SUITE=health python bench.py
+
+# cluster health at a glance (alerts, SLO digests, node liveness) from
+# the in-process health plane; DASH=host:port reads a running head
+status:
+	python -c "import ray_tpu; ray_tpu.status(address='$(DASH)')"
+
 shm:
 	$(MAKE) -C ray_tpu/core/_shm
 
@@ -86,6 +97,13 @@ check-slow:
 chaos:
 	@echo "== chaos tier =="
 	$(PYTEST) -m chaos tests/
+
+# health-plane tier (digests, alert rules, quarantine, postmortems) for
+# iterating on SLO/health work; the fast subset also runs inside check
+# via CORE_TESTS
+health:
+	@echo "== health tier =="
+	$(PYTEST) -m health tests/
 
 check-all: check check-slow
 

@@ -361,6 +361,88 @@ def bench_trace(model: str) -> None:
           "tracing_overhead_anchor", lower_is_better=True)
 
 
+def bench_health(model: str) -> None:
+    """SLO-digest overhead gate: the SAME colocated serve burst with the
+    streaming latency digests off vs on. The digests sit inline on the
+    engine's hot paths (TTFT on first commit, count-weighted TBT once
+    per decode step, e2e on finish) — this row proves the bucket-index
+    math stays under the 2%% tokens/s acceptance line. Rounds strictly
+    alternate off/on with medians, same discipline as bench_trace; the
+    toggle flips the engine's resolved `_slo_on` flag directly so both
+    sides run the identical compiled programs. Also emits the raw
+    single-observe micro-cost (ns) so a regression in the digest itself
+    is visible even when burst noise masks it."""
+    import timeit
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+    from ray_tpu.util import slo
+
+    cfg = get_config(model)
+    msl = min(512, cfg.max_seq_len)
+    prompt_len = min(128, msl // 2)
+    max_tokens = min(64, msl - prompt_len - 8)
+    n_req = 16
+    ecfg = EngineConfig(max_batch_size=16, max_seq_len=msl,
+                        prefill_batch_size=8, busy_span=4,
+                        prefill_buckets=(prompt_len,))
+    engine = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                             ecfg)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+    engine.warmup(buckets=[prompt_len])
+    engine.generate(prompts[0], max_tokens=4)
+
+    def run(on: bool) -> float:
+        engine._slo_on = on
+        results, wall = _serve_burst(engine, prompts, max_tokens)
+        return sum(len(r["token_ids"]) for r in results) / wall
+
+    run(False)  # throwaway: steady-state
+    rounds = 5
+    samples = {False: [], True: []}
+    for _ in range(rounds):  # strictly alternating
+        for on in (False, True):
+            samples[on].append(run(on))
+    on_count = sum(d.count for d in engine._slo.values())
+    engine.stop()
+    if on_count <= 0:
+        raise RuntimeError("digests-on rounds recorded no samples — the "
+                           "engine's SLO path is not actually observing")
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    tps_off, tps_on = median(samples[False]), median(samples[True])
+    overhead_pct = 100.0 * (tps_off - tps_on) / max(tps_off, 1e-9)
+
+    # micro-cost of one observe (bucket index + slice rotate, no lock)
+    d = slo.Digest("bench", window_s=60.0)
+    n_obs = 200_000
+    obs_ns = timeit.timeit(lambda: d.add(0.0123), number=n_obs) / n_obs * 1e9
+
+    mname = model.replace("-", "_")
+    print(
+        f"# health: model={model} n_req={n_req} prompt={prompt_len} "
+        f"max_tokens={max_tokens} tok/s off={tps_off:.1f} on={tps_on:.1f} "
+        f"digest_samples={on_count} observe={obs_ns:.0f}ns",
+        file=sys.stderr,
+    )
+    _emit(f"serve_digests_off_tok_per_s_{mname}", tps_off, "tokens/s",
+          "serve_digest_off_anchor")
+    _emit(f"serve_digests_on_tok_per_s_{mname}", tps_on, "tokens/s",
+          "serve_digest_on_anchor")
+    _emit("slo_digest_overhead_pct", overhead_pct, "%",
+          "slo_digest_overhead_anchor", lower_is_better=True)
+    _emit("slo_digest_observe_ns", obs_ns, "ns",
+          "slo_digest_observe_anchor", lower_is_better=True)
+
+
 def _bench_serve_spec(cfg, mname: str, rng, n_req: int) -> None:
     """Speculative-decoding serve pass (opt-in via RAY_TPU_BENCH_SPEC=1:
     the default serve rows stay anchor-comparable). Draft-mode
@@ -859,6 +941,10 @@ def main() -> None:
         # Runs early for the same reason serve does — req/s is latency-
         # sensitive and the throughput suites poison it.
         bench_trace(model)
+    if "health" in wanted:
+        # SLO-digest overhead: digests-on vs -off serve burst. Latency-
+        # sensitive like trace — runs before the throughput suites.
+        bench_health(model)
     if "grpo" in wanted:
         # rollout generate pays per-TOKEN dispatches — as latency-bound
         # as serve TTFT, and equally poisoned by the HBM churn the train/

@@ -53,3 +53,65 @@ def timeline(path: str) -> int:
 
     _tr.export_to_timeline()
     return _tl.export(path)
+
+
+def status(address: str = "", as_dict: bool = False):
+    """Cluster health at a glance, rendered from the health plane's
+    /api/v0/health payload: node liveness, firing alerts, SLO digest
+    quantiles, and health scores.
+
+    In-process by default (the head's own HealthPlane, created lazily and
+    evaluated once so a fresh session still shows data); pass
+    ``address="host:port"`` of a running dashboard to read a remote head
+    over HTTP. ``as_dict=True`` returns the raw payload instead of text.
+    CLI equivalents: ``ray-tpu status`` / ``make status``."""
+    if address:
+        import json as _json
+        from urllib.request import urlopen
+
+        url = address if "://" in address else f"http://{address}"
+        with urlopen(f"{url.rstrip('/')}/api/v0/health", timeout=5) as r:
+            payload = _json.loads(r.read().decode())
+    else:
+        from .core.health import get_health_plane
+
+        plane = get_health_plane(create=True)
+        plane.evaluate()
+        payload = plane.payload()
+    if as_dict:
+        return payload
+    lines = ["== ray_tpu health =="]
+    nodes = payload.get("nodes", [])
+    alive = sum(1 for n in nodes if n.get("state") == "ALIVE")
+    lines.append(f"nodes: {alive}/{len(nodes)} alive")
+    for n in nodes:
+        lines.append(
+            f"  {n.get('node_id', '?')} {n.get('state', '?'):5s} "
+            f"role={n.get('role') or '-':8s} "
+            f"heartbeat_age={n.get('heartbeat_age_s', 0):.1f}s")
+    alerts = payload.get("alerts", [])
+    lines.append(f"alerts firing: {len(alerts)}")
+    for a in alerts:
+        lines.append(
+            f"  [{a.get('severity', '?'):8s}] {a.get('rule', '?')} "
+            f"{a.get('labels', {})} value={a.get('value')}")
+    digests = payload.get("digests", {})
+    if digests:
+        lines.append("latency digests (windowed):")
+
+        def _ms(v):
+            return f"{v * 1e3:.1f}ms" if v is not None else "-"
+
+        for label in sorted(digests):
+            d = digests[label]
+            lines.append(f"  {label}: p50={_ms(d.get('p50'))} "
+                         f"p95={_ms(d.get('p95'))} n={d.get('count', 0)}")
+    scores = payload.get("scores", {})
+    degraded = {k: v for k, v in scores.items() if v < 1.0}
+    if degraded:
+        lines.append("degraded:")
+        for k in sorted(degraded):
+            lines.append(f"  {k}: score={degraded[k]:.2f}")
+    text = "\n".join(lines)
+    print(text)
+    return payload if as_dict else None

@@ -303,8 +303,12 @@ class TPUVMNodeProvider(NodeProvider):
 class Autoscaler:
     """Reconciles pending resource demand against provisioned capacity.
 
-    Demand source: the scheduler's infeasible/pending queue (the reference
-    reads the same from GCS resource load).
+    Demand sources: the scheduler's infeasible/pending queue (the
+    reference reads the same from GCS resource load), plus — when a
+    health plane is attached — the demand hints carried by firing alert
+    rules (core/health.py `Rule.demand`): e.g. a sustained
+    `serve_disagg_queue_depth{role=decode}` breach can ask for another
+    decode-capable node before the pending queue ever backs up.
     """
 
     def __init__(
@@ -314,10 +318,12 @@ class Autoscaler:
         runtime=None,
         idle_timeout_s: float = 60.0,
         update_interval_s: float = 1.0,
+        health_plane=None,
     ):
         from . import api
 
         self.runtime = runtime or api._auto_init()
+        self.health_plane = health_plane
         self.runtime.autoscaling_enabled = True
         self.node_types = {t.name: t for t in node_types}
         self.provider = provider
@@ -336,7 +342,14 @@ class Autoscaler:
     # -- demand → decisions --------------------------------------------------
 
     def pending_demand(self) -> List[Dict[str, float]]:
-        return self.runtime.pending_resource_demand()
+        demands = list(self.runtime.pending_resource_demand())
+        if self.health_plane is not None:
+            try:
+                demands.extend(self.health_plane.pending_demand())
+            except Exception:  # noqa: BLE001 — health hints are advisory
+                logger.warning("health-plane demand read failed",
+                               exc_info=True)
+        return demands
 
     def _fits(self, demand: Dict[str, float], resources: Dict[str, float]) -> bool:
         return all(resources.get(k, 0.0) >= v for k, v in demand.items())

@@ -63,6 +63,14 @@ def _child_main(req_q, resp_q, log_dir: str = "") -> None:
             sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
         except OSError:
             pass
+    try:
+        # flight recorder: mirror this child's recent spans/logs/events to
+        # disk so a SIGKILL still leaves a postmortem (util/flight_recorder)
+        from ..util import flight_recorder
+
+        flight_recorder.attach(log_dir, "actor")
+    except Exception:  # noqa: BLE001 — observability must not block startup
+        pass
 
     kind, payload = req_q.get()
     if kind != "init":
@@ -212,6 +220,7 @@ class ActorProcess:
             except _q.Empty:
                 deadline -= 0.1
                 if not self._proc.is_alive():
+                    self._note_crash("actor process died during init")
                     raise ActorProcessCrash(
                         f"actor process died during init "
                         f"(exitcode {self._proc.exitcode})"
@@ -227,6 +236,10 @@ class ActorProcess:
                 item = self._resp_q.get(timeout=0.1)
             except _q.Empty:
                 if not self._proc.is_alive():
+                    # _dead set means terminate() beat us here: planned
+                    # teardown, not a crash — no postmortem
+                    if not self._dead.is_set():
+                        self._note_crash("actor process died")
                     self._fail_all_waiters()
                     return
                 continue
@@ -239,6 +252,20 @@ class ActorProcess:
                 event, box = waiter
                 box.append(body)
                 event.set()
+
+    def _note_crash(self, cause: str) -> None:
+        """Reap an UNEXPECTED child death into a postmortem artifact (the
+        child's flight mirror + stdout tail; see util/flight_recorder).
+        terminate() never calls this — normal teardown is not a crash.
+        write_postmortem dedups by pid, so racing detection sites are safe."""
+        try:
+            from ..util import flight_recorder
+
+            flight_recorder.write_postmortem(
+                self._proc.pid, cause, exitcode=self._proc.exitcode,
+                stdout_hint="actor")
+        except Exception:  # noqa: BLE001 — reaping must not mask the crash
+            pass
 
     def _fail_all_waiters(self) -> None:
         self._dead.set()
@@ -293,6 +320,7 @@ class ActorProcess:
             raise TimeoutError(f"actor call {method}() timed out")
         body = box[0]
         if body is None:
+            self._note_crash(f"actor process died executing {method}()")
             raise ActorProcessCrash(
                 f"actor process died executing {method}() "
                 f"(exitcode {self._proc.exitcode})"

@@ -144,6 +144,71 @@ declare(
     "timeline events to the head (piggybacked on the heartbeat loop, so "
     "the effective period is at least one health_check_period_ms).",
 )
+declare(
+    "telemetry_max_bytes", 1_000_000,
+    "Byte budget for one heartbeat telemetry flush (spans + timeline "
+    "events + metrics snapshot, pickled size). Overflow drops OLDEST "
+    "spans/events first and counts them in telemetry_dropped_total{kind} "
+    "so a span burst cannot bloat heartbeats. 0 = unlimited.",
+)
+declare(
+    "telemetry_stale_factor", 3.0,
+    "A node's federated telemetry snapshot is dropped from the merged "
+    "dashboard/health view once it is older than this many "
+    "telemetry_report_period_s (and purged outright on mark_node_dead), "
+    "so killed nodes stop haunting /metrics.",
+)
+
+# SLO / health plane (core/health.py, util/slo.py)
+declare(
+    "slo_digests", True,
+    "Update streaming latency digests (util/slo.py: TTFT, time-between-"
+    "tokens, e2e, KV-migration) inline in the serve hot paths and ship "
+    "them with heartbeat telemetry. Off = zero digest work.",
+)
+declare(
+    "slo_digest_window_s", 60.0,
+    "Sliding window the per-process latency digests answer quantile "
+    "queries over (rotated in slo._SLICES sub-windows).",
+)
+declare(
+    "slo_ttft_ms", 0.0,
+    "p95-TTFT service-level objective in ms. >0 arms the default "
+    "health-plane rule `p95(serve_ttft_seconds) > slo for 2 periods`; "
+    "0 leaves TTFT alerting to user-supplied rules.",
+)
+declare(
+    "health_eval_period_s", 2.0,
+    "How often the head health plane (core/health.py) evaluates its "
+    "alert rules against digests, federated metrics, and heartbeats.",
+)
+declare(
+    "health_queue_depth_max", 64,
+    "Default alert threshold for serve_disagg_queue_depth (sustained "
+    "two evaluation periods).",
+)
+declare(
+    "health_memory_fraction_max", 0.92,
+    "Default alert threshold for host_memory_used_fraction (sustained "
+    "two evaluation periods).",
+)
+declare(
+    "health_quarantine_s", 5.0,
+    "How long health-aware routing (core/health.py ReplicaHealth) "
+    "quarantines a degraded replica before sending one probe request.",
+)
+declare(
+    "flight_recorder_entries", 256,
+    "Per-process flight-recorder ring size (recent spans + log lines + "
+    "events, util/flight_recorder.py) flushed into a postmortem artifact "
+    "when a crashed worker is reaped.",
+)
+declare(
+    "flight_recorder_bytes", 262_144,
+    "Size cap for a worker's on-disk flight-recorder mirror file; the "
+    "mirror is rewritten from the in-memory ring when it grows past "
+    "this, so a chatty worker cannot fill the session dir.",
+)
 
 declare(
     "control_plane_rpc_host", "127.0.0.1",

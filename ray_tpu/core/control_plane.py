@@ -15,6 +15,7 @@ from __future__ import annotations
 import enum
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -117,6 +118,8 @@ class ControlPlane:
         # + flush cursors) from that worker process; spans/timeline events
         # are ingested straight into the head's own buffers on arrival.
         self._telemetry: Dict[str, Dict[str, Any]] = {}
+        # federated crash postmortems (bounded; see util/flight_recorder)
+        self._postmortems: deque = deque(maxlen=50)
         self._dead = False
 
     # -- node table ---------------------------------------------------------
@@ -153,6 +156,9 @@ class ControlPlane:
             for prefix in ("object_transfer/", "object_transfer_load/",
                            "node_service/", "channel_service/"):
                 self._kv.pop(prefix + hexid, None)
+            # and its last telemetry snapshot: a dead node's metrics and
+            # digests must not haunt the merged dashboard/health view
+            self._telemetry.pop(hexid, None)
         _nodes_gauge.add(-1, {"state": "ALIVE"})
         _nodes_gauge.add(1, {"state": "DEAD"})
         logger.warning("node %s marked DEAD: %s", node_id, reason)
@@ -182,13 +188,17 @@ class ControlPlane:
         spans: Optional[List[Dict[str, Any]]] = None,
         events: Optional[List[Dict[str, Any]]] = None,
         event_cursor: int = 0,
+        digests: Optional[List[Dict[str, Any]]] = None,
+        postmortems: Optional[List[Dict[str, Any]]] = None,
     ) -> bool:
         """Worker-process telemetry flush (piggybacked on the heartbeat
-        loop, see cross_host.WorkerRuntime). Metrics replace the node's
-        previous snapshot; spans merge into the head trace buffer
-        (deduped by span_id, so transparent RPC retries are safe);
-        timeline events append into the head ring under a per-node lane,
-        guarded by `event_cursor` so a retried flush can't double-append."""
+        loop, see cross_host.WorkerRuntime). Metrics and SLO digests
+        replace the node's previous snapshot; spans merge into the head
+        trace buffer (deduped by span_id, so transparent RPC retries are
+        safe); timeline events append into the head ring under a
+        per-node lane, guarded by `event_cursor` so a retried flush
+        can't double-append; crash postmortem artifacts append to the
+        head's bounded postmortem store (/api/v0/postmortems)."""
         from ..util import timeline, tracing
 
         with self._lock:
@@ -198,10 +208,21 @@ class ControlPlane:
                 "role": role,
                 "metrics": metrics if metrics is not None
                 else prev.get("metrics", []),
+                "digests": digests if digests is not None
+                else prev.get("digests", []),
                 "event_cursor": max(seen_events, int(event_cursor)),
                 "reported_at": time.time(),
             }
             self._telemetry[node_id_hex] = rec
+            if postmortems:
+                # dedup on (pid, written_at): a flush retried after a
+                # requeue may carry artifacts the head already has
+                seen = {(p.get("pid"), p.get("written_at"))
+                        for p in self._postmortems}
+                for p in postmortems:
+                    if (p.get("pid"), p.get("written_at")) not in seen:
+                        self._postmortems.append(
+                            dict(p, node_id=node_id_hex[:12]))
         if spans:
             tracing.ingest(spans)
         if events and event_cursor > seen_events:
@@ -209,10 +230,30 @@ class ControlPlane:
         return True
 
     def telemetry_snapshots(self) -> Dict[str, Dict[str, Any]]:
-        """node_id hex -> latest {role, metrics, reported_at} (for the
-        dashboard's merged /metrics)."""
+        """node_id hex -> latest {role, metrics, digests, reported_at}
+        (for the dashboard's merged /metrics and the health plane).
+        Snapshots older than telemetry_stale_factor report periods are
+        dropped — a node that stopped flushing (killed, partitioned)
+        must not haunt the merged view with its last readings."""
+        from .config import config
+
+        try:
+            horizon = time.time() - (
+                float(config.telemetry_stale_factor)
+                * float(config.telemetry_report_period_s))
+        except Exception:
+            horizon = 0.0
         with self._lock:
+            stale = [k for k, v in self._telemetry.items()
+                     if v.get("reported_at", 0.0) < horizon]
+            for k in stale:
+                del self._telemetry[k]
             return {k: dict(v) for k, v in self._telemetry.items()}
+
+    def postmortems(self) -> List[Dict[str, Any]]:
+        """Federated crash postmortems (newest last, bounded)."""
+        with self._lock:
+            return [dict(p) for p in self._postmortems]
 
     def alive_nodes(self) -> List[NodeInfo]:
         with self._lock:

@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .config import config
 from .control_plane import NodeInfo
+from .metrics import Counter as _MetricCounter
 from .ids import ActorID, NodeID, ObjectID
 from .logging import get_logger
 from .node_agent import NodeAgent, TaskResult, WorkerCrashedError
@@ -56,6 +57,43 @@ from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
 logger = get_logger("cross_host")
 
 NODE_SERVICE_PREFIX = "node_service/"  # KV: node_id hex -> dispatch address
+
+_m_tele_dropped = _MetricCounter(
+    "telemetry_dropped_total",
+    "Telemetry items dropped by the heartbeat byte budget "
+    "(config.telemetry_max_bytes), by kind.")
+
+
+def _cap_telemetry(metrics: List[Any], spans: List[Any], events: List[Any],
+                   budget: int) -> Tuple[List[Any], List[Any]]:
+    """Fit (spans, events) under `budget` bytes alongside the metrics
+    snapshot, dropping OLDEST first (both lists are append-ordered). The
+    metrics/digest snapshot always ships — it is replace-not-append on
+    the head, so it is naturally bounded; spans/events are the burst
+    risk. Cursors still advance past dropped items: the budget is a
+    deliberate loss, not a retry."""
+    if budget <= 0 or (not spans and not events):
+        return spans, events
+    used = len(_dumps(metrics))
+    kept: List[List[Any]] = []
+    for kind, items in (("spans", spans), ("events", events)):
+        remaining = max(0, budget - used)
+        sizes = [len(_dumps(it)) for it in items]
+        keep_from = len(items)
+        acc = 0
+        for i in range(len(items) - 1, -1, -1):  # newest backwards
+            if acc + sizes[i] > remaining:
+                break
+            acc += sizes[i]
+            keep_from = i
+        used += acc
+        dropped = keep_from
+        if dropped:
+            _m_tele_dropped.inc(dropped, tags={"kind": kind})
+            logger.debug("telemetry budget dropped %d oldest %s",
+                         dropped, kind)
+        kept.append(items[keep_from:])
+    return kept[0], kept[1]
 
 
 def _dumps(obj: Any) -> bytes:
@@ -1192,32 +1230,43 @@ class WorkerRuntime:
             self._stopped.wait(period)
 
     def _maybe_report_telemetry(self) -> None:
-        """Flush this process's metrics snapshot, trace spans, and
-        timeline events to the head, at most every
-        config.telemetry_report_period_s (piggybacked on the heartbeat so
-        a partition pauses telemetry along with liveness). Lossy-tolerant:
-        cursors only advance on a confirmed report, and failures wait for
-        the next beat rather than retrying inline."""
+        """Flush this process's metrics snapshot, SLO digests, trace
+        spans, timeline events, and any fresh crash postmortems to the
+        head, at most every config.telemetry_report_period_s (piggybacked
+        on the heartbeat so a partition pauses telemetry along with
+        liveness). Lossy-tolerant: cursors only advance on a confirmed
+        report, and failures wait for the next beat rather than retrying
+        inline. The whole payload is capped at config.telemetry_max_bytes
+        (oldest spans/events dropped first, counted in
+        telemetry_dropped_total{kind}) so a span burst cannot bloat a
+        heartbeat into a megabyte RPC."""
         now = time.monotonic()
         if now - self._last_telemetry < float(config.telemetry_report_period_s):
             return
-        from ..util import timeline, tracing
+        from ..util import flight_recorder, slo, timeline, tracing
         from .metrics import registry as metrics_registry
 
         span_cur, spans = tracing.drain_since(self._telemetry_span_cursor)
         event_cur, events = timeline.drain_since(self._telemetry_event_cursor)
+        metrics = metrics_registry.snapshot()
+        spans, events = _cap_telemetry(
+            metrics, spans, events, int(config.telemetry_max_bytes))
+        postmortems = flight_recorder.drain_postmortems()
         try:
             self.control_plane.report_telemetry(
                 self.node_id.hex(),
                 role="worker",
-                metrics=metrics_registry.snapshot(),
+                metrics=metrics,
                 spans=spans,
                 events=events,
                 event_cursor=event_cur,
+                digests=slo.snapshot(),
+                postmortems=postmortems,
                 _deadline_s=5.0,
             )
         except (ControlPlaneUnavailable, WireError, OSError, RuntimeError) as e:
             logger.debug("telemetry flush failed (%s); retrying next beat", e)
+            flight_recorder.requeue_postmortems(postmortems)
             return
         self._telemetry_span_cursor = span_cur
         self._telemetry_event_cursor = event_cur

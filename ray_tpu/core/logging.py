@@ -5,6 +5,12 @@ directory convention (upstream ray `src/ray/util/logging.h :: RayLog`,
 `/tmp/ray/session_latest/logs/`): each process logs to stderr and to a
 per-process file under the session log dir, with component and worker context
 prefixed so a tail-aggregator can attribute lines.
+
+Log↔trace correlation: a record emitted while a trace span is active on
+the emitting thread carries ` trace_id=<id>` in its prefix, so log_monitor
+output and crash postmortems join to `/api/v0/traces/<id>` by grep. Every
+formatted line also feeds the per-process flight recorder ring
+(util/flight_recorder) — the "recent log lines" half of a postmortem.
 """
 
 from __future__ import annotations
@@ -16,7 +22,7 @@ import time
 from typing import Optional
 
 _SESSION_DIR: Optional[str] = None
-_FMT = "[%(asctime)s %(levelname).1s %(process)d %(name)s] %(message)s"
+_FMT = "[%(asctime)s %(levelname).1s %(process)d %(name)s%(trace_ctx)s] %(message)s"
 
 
 def session_dir() -> str:
@@ -42,12 +48,44 @@ def log_dir() -> str:
     return os.path.join(session_dir(), "logs")
 
 
+class _TraceContextFilter(logging.Filter):
+    """Stamps `record.trace_ctx` from the thread's active span (lazy
+    tracing import: logging is imported everywhere, tracing must stay
+    optional at this layer)."""
+
+    _current_span = None  # resolved once, cached on the class
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        fn = _TraceContextFilter._current_span
+        if fn is None:
+            try:
+                from ..util.tracing import current_span as fn
+            except Exception:
+                fn = lambda: None  # noqa: E731
+            _TraceContextFilter._current_span = fn
+        span = fn()
+        record.trace_ctx = f" trace_id={span.trace_id}" if span is not None else ""
+        return True
+
+
+class _FlightHandler(logging.Handler):
+    """Mirrors every formatted line into the flight-recorder ring."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            from ..util import flight_recorder
+            flight_recorder.on_log(self.format(record))
+        except Exception:
+            pass  # crash forensics must never break logging
+
+
 def get_logger(component: str, to_file: bool = True) -> logging.Logger:
     logger = logging.getLogger(f"ray_tpu.{component}")
     if getattr(logger, "_ray_tpu_configured", False):
         return logger
     logger.setLevel(os.environ.get("RAY_TPU_LOG_LEVEL", "INFO").upper())
     formatter = logging.Formatter(_FMT)
+    logger.addFilter(_TraceContextFilter())
     stream = logging.StreamHandler(sys.stderr)
     stream.setFormatter(formatter)
     logger.addHandler(stream)
@@ -59,6 +97,9 @@ def get_logger(component: str, to_file: bool = True) -> logging.Logger:
             logger.addHandler(fh)
         except OSError:
             pass
+    flight = _FlightHandler()
+    flight.setFormatter(formatter)
+    logger.addHandler(flight)
     logger.propagate = False
     logger._ray_tpu_configured = True  # type: ignore[attr-defined]
     return logger

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 
 from .config import config, declare
 from .logging import get_logger
-from .metrics import Counter
+from .metrics import Counter, Gauge
 
 logger = get_logger("memory_monitor")
 
@@ -36,6 +36,11 @@ declare("memory_monitor_interval_ms", 1000,
 _m_killed = Counter(
     "memory_monitor_tasks_killed",
     "Pool tasks killed by the memory monitor under host memory pressure.",
+)
+_m_used_fraction = Gauge(
+    "host_memory_used_fraction",
+    "Host (or cgroup) memory-used fraction, sampled by the memory "
+    "monitor — the health plane's memory_pressure rule reads this.",
 )
 
 
@@ -119,8 +124,24 @@ class MemoryMonitor:
                 logger.warning("memory probe failed; monitor disabled",
                                exc_info=True)
                 return
+            _m_used_fraction.set(used)
             if used < self.threshold:
                 continue
+            # announce the pressure kill as a health alert + flight-recorder
+            # event BEFORE pulling the trigger: the postmortem and the alert
+            # stream should both show why the worker died
+            try:
+                from ..util import flight_recorder
+                flight_recorder.record("memory_pressure", used=used,
+                                       threshold=self.threshold)
+                from .health import get_health_plane
+                plane = get_health_plane(create=False)
+                if plane is not None:
+                    plane.inject(
+                        "memory_pressure", {"source": "memory_monitor"},
+                        used, severity="critical")
+            except Exception:  # noqa: BLE001 — alerting must not block the kill
+                pass
             pid = self._kill_fn()
             if pid is not None:
                 _m_killed.inc()

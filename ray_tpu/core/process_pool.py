@@ -277,6 +277,14 @@ def _worker_main(store_name: str, req_q, resp_q, log_dir: str = "") -> None:
             sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
         except OSError:
             pass  # stdio capture is best-effort
+    try:
+        # flight recorder: mirror recent spans/logs/events to disk so a
+        # SIGKILL (chaos, memory monitor) still leaves a postmortem
+        from ..util import flight_recorder
+
+        flight_recorder.attach(log_dir, "worker")
+    except Exception:  # noqa: BLE001 — observability must not block startup
+        pass
     store = ShmObjectStore(store_name, create=False)
     while True:
         item = req_q.get()
@@ -511,6 +519,18 @@ class ProcessPool:
             _cleanup_buffers(self.store, buffer_ids)
             if resp is None:
                 code = worker.proc.exitcode
+                if not self._closed.is_set():
+                    # reap the crash into a postmortem artifact (flight
+                    # mirror + stdout tail); pool teardown is not a crash
+                    try:
+                        from ..util import flight_recorder
+
+                        flight_recorder.write_postmortem(
+                            worker.proc.pid,
+                            "worker process died while running task",
+                            exitcode=code, stdout_hint="worker")
+                    except Exception:  # noqa: BLE001 — must not mask the crash
+                        pass
                 worker = None  # respawn lazily for the next task
                 complete(
                     False,

@@ -52,7 +52,7 @@ _reconnects_total = Counter(
 _ALLOWED_METHODS: Set[str] = {
     "register_node", "mark_node_dead", "heartbeat", "alive_nodes",
     "get_node", "all_nodes",
-    "report_telemetry", "telemetry_snapshots",
+    "report_telemetry", "telemetry_snapshots", "postmortems",
     "register_actor", "update_actor", "get_actor", "get_named_actor",
     "list_actors",
     "register_job", "finish_job", "list_jobs",
@@ -76,7 +76,7 @@ _IDEMPOTENT_METHODS: Set[str] = {
     "heartbeat", "alive_nodes", "get_node", "all_nodes",
     # telemetry: metrics replace the prior snapshot, spans dedupe by id,
     # timeline events are cursor-guarded — a resend is absorbed
-    "report_telemetry", "telemetry_snapshots",
+    "report_telemetry", "telemetry_snapshots", "postmortems",
     "get_actor", "get_named_actor", "list_actors", "list_jobs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "dir_add_location", "dir_remove_location", "dir_locations",

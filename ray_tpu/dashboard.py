@@ -120,7 +120,30 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "p95 {{transport}}",
         "refId": "B",
     })
-    return {"core": core, "serve": serve, "data": data, "disagg": disagg}
+    health = _dashboard("raytpu-health", "ray_tpu / health & SLOs", [
+        _panel("Alerts firing by severity", "health_alerts_firing", 0, 0,
+               legend="{{severity}}"),
+        _panel("SLO quantiles per role (digests)",
+               'slo_quantile_seconds{q="p95"}', 1, 0, unit="s",
+               legend="p95 {{metric}} {{role}}"),
+        _panel("Host memory used fraction", "host_memory_used_fraction",
+               2, 8, unit="percentunit", legend="{{node_id}}"),
+        _panel("Telemetry drops (rate)",
+               "rate(telemetry_dropped_total[5m])", 3, 8,
+               legend="{{kind}}"),
+        _panel("Memory-monitor kills (rate)",
+               "rate(memory_monitor_tasks_killed[5m])", 4, 16),
+        _panel("Control-plane reconnects (rate)",
+               "rate(control_plane_reconnects_total[5m])", 5, 16,
+               legend="{{role}}"),
+    ])
+    health["panels"][1]["targets"].append({
+        "expr": 'slo_quantile_seconds{q="p50"}',
+        "legendFormat": "p50 {{metric}} {{role}}",
+        "refId": "B",
+    })
+    return {"core": core, "serve": serve, "data": data, "disagg": disagg,
+            "health": health}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:
@@ -206,6 +229,32 @@ def _trace_payload(trace_id: str) -> Dict[str, Any]:
         "processes": sorted(str(p) for p in pids),
         "phases": phases,
         "spans": tree,
+    }
+
+
+def _health_plane():
+    from .core.health import get_health_plane
+
+    return get_health_plane(create=True)
+
+
+def _postmortems_payload() -> Dict[str, Any]:
+    """Crash postmortems: the head's federated store (shipped by worker
+    runtimes over telemetry) plus artifacts reaped in THIS process (the
+    head's own pool/actor workers don't travel over telemetry)."""
+    from .core import core_worker
+    from .util import flight_recorder
+
+    federated: List[Dict[str, Any]] = []
+    rt = core_worker._global_runtime
+    if rt is not None:
+        try:
+            federated = rt.control_plane.postmortems()
+        except Exception:  # noqa: BLE001 — route must render partially
+            pass
+    return {
+        "federated": federated,
+        "local_paths": flight_recorder.list_postmortems(),
     }
 
 
@@ -312,6 +361,16 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                 if self.path.startswith("/api/v0/traces/"):
                     tid = self.path[len("/api/v0/traces/"):].strip("/")
                     return self._json(200, _trace_payload(tid))
+                # health-plane surfaces (core/health.py) — like traces,
+                # these must precede the generic state route
+                if self.path.rstrip("/") == "/api/v0/health":
+                    return self._json(200, _health_plane().payload())
+                if self.path.rstrip("/") == "/api/v0/alerts":
+                    plane = _health_plane()
+                    return self._json(200, {"active": plane.active(),
+                                            "history": plane.history()})
+                if self.path.rstrip("/") == "/api/v0/postmortems":
+                    return self._json(200, _postmortems_payload())
                 # job REST surface (reference: dashboard job module,
                 # `dashboard/modules/job/job_head.py` HTTP routes)
                 if self.path.startswith("/api/jobs/"):

@@ -391,6 +391,13 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_health(args) -> int:
+    import ray_tpu
+
+    ray_tpu.status(address=args.address or "")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -432,6 +439,13 @@ def main(argv=None) -> int:
                      "join serves dispatch/transfer on it; default "
                      "RAY_TPU_NODE_HOST or 127.0.0.1)")
     pst.set_defaults(fn=cmd_start)
+
+    ph = sub.add_parser("health", help="health plane: alerts, SLO digests, "
+                        "node liveness (renders /api/v0/health)")
+    ph.add_argument("--address", default="",
+                    help="dashboard host:port of a running head (default: "
+                    "in-process health plane)")
+    ph.set_defaults(fn=cmd_health)
 
     pmem = sub.add_parser("memory", help="object-plane sizes and totals")
     pmem.add_argument("--limit", type=int, default=100)

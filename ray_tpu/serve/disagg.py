@@ -38,9 +38,10 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from .. import api
+from ..core.health import ReplicaHealth
 from ..core.logging import get_logger
 from ..core.metrics import MICRO_BUCKETS, Counter, Gauge, Histogram
-from ..util import tracing
+from ..util import slo, tracing
 from .config import DisaggConfig
 from .engine import InferenceEngine, Request
 from .router import _replica_key, pow2_choice
@@ -201,6 +202,8 @@ def _import_request(engine: InferenceEngine, request: Dict[str, Any],
     tags = {"transport": handoff["kind"]}
     _m_migration_s.observe(elapsed, tags=tags)
     _m_migration_b.inc(int(handoff.get("bytes", 0)), tags=tags)
+    if getattr(engine, "_slo_on", False):
+        slo.observe("serve_kv_migration_seconds", elapsed, tags=tags)
     req._migration_s = elapsed
     return req
 
@@ -458,6 +461,26 @@ class DisaggCoordinator:
         self._last_sync = 0.0
         self._sync_period = 1.0
         self._pg = None  # placement group owned by deploy_disagg
+        # Health-aware routing (core/health.py): transport errors and
+        # degraded latency quarantine a replica out of _pick long before
+        # the control plane's heartbeat timeout marks its node DEAD; a
+        # probe request un-quarantines it on recovery. Head-plane alerts
+        # naming a replica (labels["replica"]) quarantine it too.
+        self.health = ReplicaHealth()
+        from ..core.health import get_health_plane
+        plane = get_health_plane(create=False)
+        if plane is not None:
+            plane.subscribe(self._on_alert)
+
+    def _on_alert(self, alert: Dict[str, Any]) -> None:
+        rep = (alert.get("labels") or {}).get("replica")
+        if not rep or alert.get("state") != "firing":
+            return
+        with self._lock:
+            keys = [w.key for ws in self._workers.values() for w in ws]
+        for key in keys:
+            if str(key) == rep:
+                self.health.quarantine(key, reason=alert.get("rule", "alert"))
 
     # -------------------------------------------------------------- serve
 
@@ -512,9 +535,13 @@ class DisaggCoordinator:
                     with self._lock:
                         workers = list(self._workers[role])
                     if workers:
+                        elig = self.health.eligible([w.key for w in workers])
+                        cand = [w for w in workers if w.key in elig] or workers
                         idx = pow2_choice(
-                            len(workers), lambda i: workers[i].load())
-                        return workers[idx]
+                            len(cand),
+                            lambda i: cand[i].load()
+                            + self.health.penalty(cand[i].key))
+                        return cand[idx]
                     if time.monotonic() > deadline:
                         raise RuntimeError(f"no {role} replicas available")
                     time.sleep(0.1)
@@ -546,8 +573,16 @@ class DisaggCoordinator:
             kv_dest = dworker.kv_dest()
         pworker = self._pick("prefill", deadline)
         self._live[base["request_id"]] = (pworker, dworker)
-        with _m_inflight.track(tags={"role": "prefill"}):
-            return pworker.prefill_request({**base, "kv_dest": kv_dest})
+        t0 = time.monotonic()
+        try:
+            with _m_inflight.track(tags={"role": "prefill"}):
+                res = pworker.prefill_request({**base, "kv_dest": kv_dest})
+        except BaseException:
+            self.health.record_error(pworker.key)
+            raise
+        self.health.observe(pworker.key, time.monotonic() - t0,
+                            role="prefill")
+        return res
 
     # ---------------------------------------------------------- blocking
 
@@ -564,8 +599,16 @@ class DisaggCoordinator:
             try:
                 dworker = self._pick("decode", deadline)
                 pres = self._run_prefill(base, deadline, dworker)
-                with _m_inflight.track(tags={"role": "decode"}):
-                    dres = dworker.decode_request({**base, "kv": pres["kv"]})
+                td = time.monotonic()
+                try:
+                    with _m_inflight.track(tags={"role": "decode"}):
+                        dres = dworker.decode_request(
+                            {**base, "kv": pres["kv"]})
+                except BaseException:
+                    self.health.record_error(dworker.key)
+                    raise
+                self.health.observe(dworker.key, time.monotonic() - td,
+                                    role="decode")
             finally:
                 self._live.pop(base["request_id"], None)
         return {
@@ -596,14 +639,26 @@ class DisaggCoordinator:
             dworker = self._pick("decode", deadline)
             try:
                 pres = self._run_prefill(base, deadline, dworker)
-                raw = dworker.decode_stream({**base, "kv": pres["kv"]})
+                try:
+                    raw = dworker.decode_stream({**base, "kv": pres["kv"]})
+                except BaseException:
+                    self.health.record_error(dworker.key)
+                    raise
             except BaseException:
                 self._live.pop(base["request_id"], None)
                 raise
 
         def finishing():
+            t0 = time.monotonic()
             try:
                 yield from raw
+            except BaseException as e:
+                if not isinstance(e, GeneratorExit):
+                    self.health.record_error(dworker.key)
+                raise
+            else:
+                self.health.observe(dworker.key, time.monotonic() - t0,
+                                    role="decode")
             finally:
                 self._live.pop(base["request_id"], None)
 
@@ -636,6 +691,7 @@ class DisaggCoordinator:
                 "decode_inflight": sum(
                     w.load() for w in self._workers["decode"]),
                 "kv_transfer": self.cfg.kv_transfer,
+                "health": self.health.snapshot(),
                 "kv_migrations": _m_migration_s.count(
                     tags={"transport": "object"}) + _m_migration_s.count(
                     tags={"transport": "channel"}),

@@ -44,6 +44,7 @@ import numpy as np
 
 from ..core.logging import get_logger
 from ..core.metrics import Counter, Gauge, Histogram
+from ..util import slo
 from ..models import ModelConfig
 from ..models.transformer import (
     _dense_ffn,
@@ -468,6 +469,15 @@ class InferenceEngine:
         # prefill batches currently executing (read by the decode thread's
         # adaptive-span decision; int writes are GIL-atomic)
         self._prefill_inflight = 0
+        # SLO latency digests (util/slo.py, shipped with heartbeat
+        # telemetry). The serving layer stamps slo_role after construction
+        # (llm.LLMServer: colocated/prefill/decode), so digest handles
+        # resolve lazily on first observation; the enable switch resolves
+        # once here — the bench health suite gates the hot-path cost.
+        self.slo_role = "engine"
+        self._slo_on = slo.enabled()
+        self._slo: Dict[str, slo.Digest] = {}
+        self._last_commit_t = 0.0
         self._decode = self._build_decode()
         self._prefill_cache: Dict[int, Any] = {}
         self._chunk_fn = self._build_chunk_prefill()
@@ -1020,6 +1030,9 @@ class InferenceEngine:
             req.finish_reason = reason
             _m_requests.inc(tags={"finish_reason": reason})
         req.finished_at = time.monotonic()
+        if self._slo_on and error is None and reason != "cancelled":
+            self._slo_digest("serve_e2e_seconds").add(
+                req.finished_at - req.submitted_at)
         self._forget(req)
         for tok in req._held:  # flush the stream hold-back (post-strip)
             req._emit(tok)
@@ -1277,6 +1290,9 @@ class InferenceEngine:
                 first = firsts[i]
                 req.first_token_at = now
                 _m_ttft.observe(now - req.submitted_at)
+                if self._slo_on:
+                    self._slo_digest("serve_ttft_seconds").add(
+                        now - req.submitted_at)
                 _m_tokens.inc()
                 req.output.append(int(first))
                 if eos is not None and int(first) == eos:
@@ -1400,6 +1416,8 @@ class InferenceEngine:
         now = time.monotonic()
         req.first_token_at = now
         _m_ttft.observe(now - req.submitted_at)
+        if self._slo_on:
+            self._slo_digest("serve_ttft_seconds").add(now - req.submitted_at)
         _m_tokens.inc()
         req.output.append(int(first))
         eos = self.ecfg.eos_token_id
@@ -1587,12 +1605,30 @@ class InferenceEngine:
                                              "mode": "spec"})
         self._note_tokens_per_step(n_tokens, n_active)
 
+    def _slo_digest(self, name: str) -> "slo.Digest":
+        d = self._slo.get(name)
+        if d is None:
+            d = slo.digest(name, {"role": self.slo_role})
+            self._slo[name] = d
+        return d
+
     def _note_tokens_per_step(self, committed: int, participations: int
                               ) -> None:
         self._tps_committed += committed
         self._tps_steps += participations
         if self._tps_steps:
             _m_tokens_per_step.set(self._tps_committed / self._tps_steps)
+        if committed and self._slo_on:
+            # time-between-tokens, count-weighted once per decode step (a
+            # per-token observe would pay the digest 32x per span for the
+            # same quantile information)
+            now = time.monotonic()
+            last = self._last_commit_t
+            # a gap bound keeps idle time between bursts out of the sketch
+            if last and now - last < 10.0:
+                self._slo_digest("serve_tbt_seconds").add(
+                    (now - last) / committed, n=committed)
+            self._last_commit_t = now
 
     def _maybe_finish(self, slot: _Slot, last_tok: int) -> None:
         req = slot.request

@@ -88,6 +88,9 @@ class LLMServer:
                         if draft_params_fn is not None else None)
         self.engine = InferenceEngine(params, cfg, ecfg, mesh=mesh,
                                       draft_params=draft_params)
+        # SLO digests group by serving role (colocated/prefill/decode):
+        # the head answers "p95 TTFT per role" from the merged sketches
+        self.engine.slo_role = role
         # compile every decode-span program at replica init: the
         # adaptive policy's busy_span would otherwise jit mid-traffic,
         # stalling the whole active batch exactly under prefill

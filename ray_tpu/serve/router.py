@@ -38,12 +38,21 @@ def _replica_key(replica: Any) -> Any:
 
 class Pow2Router:
     def __init__(self, deployment_name: str):
+        from ..core.health import ReplicaHealth
+
         self.deployment_name = deployment_name
         self._replicas: List[Any] = []  # ActorHandles
         self._inflight: Dict[int, List[Any]] = {}  # replica idx -> refs
         self._lock = threading.Lock()
         self._version = -1
         self._model_affinity: Dict[str, int] = {}  # model id -> replica idx
+        # Health-aware weighting (core/health.py): callers feed observed
+        # outcomes via note_result(); degraded replicas carry a load
+        # penalty in the pow-2 comparison and quarantined ones drop out
+        # of the candidate set until their probe window opens — the
+        # router stops selecting a broken replica before the control
+        # plane's heartbeat timeout marks its node DEAD.
+        self.health = ReplicaHealth()
 
     def update_replicas(self, replicas: List[Any], version: int) -> None:
         with self._lock:
@@ -79,7 +88,15 @@ class Pow2Router:
         if refs:
             done, pending = api.wait(refs, num_returns=len(refs), timeout=0)
             self._inflight[idx] = pending
-        return len(self._inflight.get(idx, []))
+        return (len(self._inflight.get(idx, []))
+                + self.health.penalty(_replica_key(self._replicas[idx])))
+
+    def note_result(self, replica: Any, latency_s: float = None,
+                    ok: bool = True) -> None:
+        """Feed an observed request outcome back into replica health
+        (called by whoever consumes the assigned ref — e.g. the serve
+        handle layer or tests injecting latency)."""
+        self.health.observe(_replica_key(replica), latency_s, ok=ok)
 
     def assign(self, method: str, args: tuple, kwargs: dict,
                multiplexed_model_id: str = ""):
@@ -101,7 +118,14 @@ class Pow2Router:
                     if self._load(cand) <= self._load(probe) + 2:
                         idx = cand
             if idx is None:
-                idx = pow2_choice(n, self._load)
+                elig = self.health.eligible(
+                    [_replica_key(r) for r in self._replicas])
+                cand = [i for i in range(n)
+                        if _replica_key(self._replicas[i]) in elig]
+                if not cand:
+                    cand = list(range(n))
+                j = pow2_choice(len(cand), lambda i: self._load(cand[i]))
+                idx = cand[j]
             if multiplexed_model_id:
                 # Record affinity only for a first placement: a load-check
                 # diversion must not abandon the replica that actually has

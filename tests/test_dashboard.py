@@ -171,6 +171,8 @@ class TestMetricsFederation:
 
 class TestGrafana:
     def test_dashboards_reference_real_metrics(self):
+        import ray_tpu.core.cross_host  # noqa: F401 — registers metrics
+        import ray_tpu.core.memory_monitor  # noqa: F401 — registers metrics
         import ray_tpu.core.object_transfer  # noqa: F401 — registers metrics
         import ray_tpu.serve.disagg  # noqa: F401 — registers disagg metrics
         import ray_tpu.serve.engine  # noqa: F401 — registers serve metrics
@@ -189,7 +191,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 4  # core, data, serve, disagg
+        assert len(jsons) == 5  # core, data, serve, disagg, health
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p
