@@ -31,14 +31,15 @@ LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_serve_cross_host.py tests/test_disagg.py \
 	tests/test_dashboard.py \
 	tests/test_integrations.py tests/test_platform.py \
-	tests/test_microbenchmark.py
+	tests/test_microbenchmark.py tests/test_pipeline_trainer.py
 
 MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all chaos health tsan shm status bench-data \
-	bench-object bench-serve bench-trace bench-health
+.PHONY: check check-slow check-all chaos health pipeline tsan shm status \
+	bench-data bench-object bench-serve bench-trace bench-health \
+	bench-pipeline
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -69,6 +70,11 @@ bench-trace:
 # micro-cost, merged into BENCH_SUMMARY.json
 bench-health:
 	env RAY_TPU_BENCH_SUITE=health python bench.py
+
+# pipeline-trainer iteration loop: 1-stage vs 2-stage tiny LM tokens/s
+# plus the 2-stage bubble fraction, merged into BENCH_SUMMARY.json
+bench-pipeline:
+	env RAY_TPU_BENCH_SUITE=pipeline python bench.py
 
 # cluster health at a glance (alerts, SLO digests, node liveness) from
 # the in-process health plane; DASH=host:port reads a running head
@@ -104,6 +110,13 @@ chaos:
 health:
 	@echo "== health tier =="
 	$(PYTEST) -m health tests/
+
+# MPMD pipeline-parallel trainer tier (stage gangs, 1F1B parity, ZeRO-1,
+# channel backpressure) for iterating on pipeline work; the fast subset
+# also runs inside check via LIB_TESTS
+pipeline:
+	@echo "== pipeline tier =="
+	$(PYTEST) -m pipeline tests/
 
 check-all: check check-slow
 

@@ -18,8 +18,9 @@ for train, "serve_anchor"/"data_anchor" for the rest); missing anchor -> 1.0.
 
 Env knobs: RAY_TPU_BENCH_MODEL, RAY_TPU_BENCH_BATCH, RAY_TPU_BENCH_SEQ,
 RAY_TPU_BENCH_STEPS, RAY_TPU_BENCH_SCAN (0 disables the scanned metric),
-RAY_TPU_BENCH_SUITE (comma list of train,train2b,serve,data; default all;
-train2b is the pinned ~2B stepping-stone run, anchored separately).
+RAY_TPU_BENCH_SUITE (comma list of train,train2b,pipeline,serve,data;
+default all; train2b is the pinned ~2B stepping-stone run, anchored
+separately; pipeline is the MPMD stage-gang trainer, tiny model pinned).
 
 vs_baseline for train divides by "bench_anchor" (llama-600m) or the
 per-model "bench_anchor_<model>" key (e.g. bench_anchor_llama_2b).
@@ -94,7 +95,7 @@ def _write_summary() -> None:
     doc = {
         "meta": {
             "suite": os.environ.get(
-                "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data,images,moe,grpo"),
+                "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo"),
             "model": os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m"),
             "backend": jax.default_backend(),
             "spec_bench": os.environ.get("RAY_TPU_BENCH_SPEC", "0"),
@@ -887,6 +888,71 @@ def bench_moe() -> None:
           "moe_overhead_anchor", lower_is_better=True)
 
 
+def bench_pipeline() -> None:
+    """MPMD pipeline-parallel trainer: tokens/s for the same tiny LM run
+    as one gang vs two stage gangs streaming activations over
+    DistChannels, plus the 2-stage bubble fraction (the idle share the
+    1F1B schedule failed to hide). Every knob pinned — tiny model,
+    in-process stages — so the number tracks scheduling/transport
+    overhead, not model math."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu.models import get_config
+    from ray_tpu.train import LMStageModule, PipelineConfig, PipelineTrainer
+    from ray_tpu.train.config import RunConfig
+
+    cfg = get_config("tiny-llama")
+    batch, seq, steps = 8, 128, 8
+    tmp = tempfile.mkdtemp(prefix="bench_pipeline_")
+    try:
+        results = {}
+        for num_stages in (1, 2):
+            trainer = PipelineTrainer(
+                LMStageModule(cfg, num_stages),
+                pipeline=PipelineConfig(
+                    num_stages=num_stages, num_microbatches=4,
+                    stages_in_process=True),
+                optimizer_kwargs=dict(
+                    learning_rate=1e-3, warmup_steps=0, total_steps=1000),
+                run_config=RunConfig(
+                    name=f"pipe{num_stages}", storage_path=tmp),
+                seed=0,
+            )
+            result = trainer.fit(steps, global_batch=batch, seq_len=seq)
+            if result.error is not None:
+                raise RuntimeError(
+                    f"pipeline bench ({num_stages}-stage) failed: "
+                    f"{result.error!r}")
+            # step 0 pays jit compiles on every stage — median of the rest
+            times = [m["step_seconds"] for m in result.metrics_history[1:]]
+            bubbles = [m["bubble_fraction"]
+                       for m in result.metrics_history[1:]]
+            results[num_stages] = (
+                batch * seq / float(np.median(times)),
+                float(np.mean(bubbles)),
+            )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    tps1, _ = results[1]
+    tps2, bubble2 = results[2]
+    print(
+        f"# pipeline: model=tiny-llama batch={batch} seq={seq} "
+        f"steps={steps} microbatches=4 1stage={tps1:.0f}tok/s "
+        f"2stage={tps2:.0f}tok/s bubble={bubble2:.2%}",
+        file=sys.stderr,
+    )
+    _emit("train_pipeline_tokens_per_sec_1stage", tps1, "tokens/s",
+          "pipeline_anchor_1stage")
+    _emit("train_pipeline_tokens_per_sec_2stage", tps2, "tokens/s",
+          "pipeline_anchor_2stage")
+    _emit("train_pipeline_bubble_fraction_2stage", bubble2, "ratio",
+          "pipeline_bubble_anchor", lower_is_better=True)
+
+
 def bench_grpo() -> None:
     """RLHF gate (BASELINE.md workload #5): GRPO rollout->update pipeline
     samples/s on the flagship model (group_size completions sampled
@@ -926,7 +992,7 @@ def bench_grpo() -> None:
 
 def main() -> None:
     suite = os.environ.get(
-        "RAY_TPU_BENCH_SUITE", "train,train2b,serve,data,images,moe,grpo")
+        "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo")
     wanted = {s.strip() for s in suite.split(",") if s.strip()}
     model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
     # Ordering is deliberate: serve FIRST — its p50-TTFT criterion is
@@ -960,6 +1026,11 @@ def main() -> None:
         bench_images()
     if "train" in wanted:
         bench_train()
+    if "pipeline" in wanted:
+        # MPMD stage gangs, in-process actors on a tiny pinned model:
+        # CPU-side scheduling/transport cost, indifferent to HBM residue,
+        # so it slots safely into the throughput block
+        bench_pipeline()
     if "train2b" in wanted:
         # scale stepping stone (VERDICT r3 #4): ~2B params, remat on,
         # factored optimizer state — MFU must survive the size jump.

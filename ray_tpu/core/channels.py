@@ -32,6 +32,7 @@ import uuid
 from typing import Any, Dict, Optional, Tuple
 
 from .logging import get_logger
+from .metrics import MICRO_BUCKETS, Counter, Histogram
 from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
 
 logger = get_logger("channels")
@@ -39,6 +40,57 @@ logger = get_logger("channels")
 KV_CHANNEL_PREFIX = "channel_service/"  # node_id hex -> service address
 
 _PUT_TIMEOUT_S = 300.0
+
+# Backpressure observability (pipeline training streams activations and
+# gradients through here at step cadence): bytes pushed per path, how long
+# consumers sit in get(), and how often a put found the queue already at
+# capacity — the "backpressure engaged" signal.
+_send_bytes = Counter(
+    "channel_send_bytes",
+    "Bytes pushed into DistChannels (path=local: same-process enqueue, "
+    "estimated size; path=remote: pickled frame bytes on the wire).",
+)
+_recv_wait = Histogram(
+    "channel_recv_wait_seconds",
+    "Time a consumer spent blocked in DistChannel.get().",
+    buckets=MICRO_BUCKETS,
+)
+_capacity_reached = Counter(
+    "channel_capacity_reached_total",
+    "Puts that found the channel at capacity (local/service: queue full at "
+    "arrival; remote: put refused after the owner-side timeout).",
+)
+
+
+def _approx_nbytes(value: Any) -> int:
+    """Cheap size estimate for the local put fast path, which never
+    serializes: sum nbytes of array/bytes leaves in (nested) tuples,
+    lists, and dicts; other leaves count 0 rather than paying a pickle."""
+    n = getattr(value, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    if isinstance(value, dict):
+        return sum(_approx_nbytes(v) for v in value.values())
+    if isinstance(value, (tuple, list)):
+        return sum(_approx_nbytes(v) for v in value)
+    return 0
+
+
+def channel_stats() -> Dict[str, float]:
+    """This process's channel-metric totals (summed over tag sets) — the
+    cheap assertion surface for tests and bench."""
+    return {
+        "send_bytes": sum(v for _, _, v in _send_bytes.samples()),
+        "recv_count": sum(
+            v for name, _, v in _recv_wait.samples() if name.endswith("_count")
+        ),
+        "recv_wait_seconds": sum(
+            v for name, _, v in _recv_wait.samples() if name.endswith("_sum")
+        ),
+        "capacity_reached": sum(v for _, _, v in _capacity_reached.samples()),
+    }
 
 
 class _Registry:
@@ -77,6 +129,8 @@ class _ServiceHandler(socketserver.BaseRequestHandler):
                 if op == "put":
                     q = server.registry.get_or_create(
                         req["chan"], req.get("maxsize", 8))
+                    if q.full():
+                        _capacity_reached.inc(tags={"path": "service"})
                     try:
                         # blocking put: the delayed ok IS the backpressure
                         # signal to the remote producer (SPSC edges, so
@@ -195,6 +249,7 @@ class _Writer:
         application-level refusal ("channel full") is the backpressure
         signal — it never retries and raises queue.Full."""
         blob = _dumps(value)
+        _send_bytes.inc(len(blob), tags={"path": "remote"})
         frame = {
             "op": "put", "chan": chan_id, "blob": blob,
             "maxsize": maxsize, "timeout": timeout,
@@ -212,6 +267,7 @@ class _Writer:
                 send_msg(self._sock, MSG_REQUEST, frame)
                 _msg_type, resp = recv_msg(self._sock)
         if not resp.get("ok"):
+            _capacity_reached.inc(tags={"path": "remote"})
             raise queue.Full(resp.get("error", "remote channel put failed"))
 
     def close(self) -> None:
@@ -280,7 +336,10 @@ class DistChannel:
                 "channel_send", {"channel": self.chan_id[:8]}):
             q = self._local()
             if q is not None:
+                if q.full():
+                    _capacity_reached.inc(tags={"path": "local"})
                 q.put(value, timeout=t)
+                _send_bytes.inc(_approx_nbytes(value), tags={"path": "local"})
                 return
             # _Writer.put self-heals a stale socket (one reconnect +
             # replay), so no fresh-writer fallback is needed here
@@ -288,13 +347,25 @@ class DistChannel:
                 self.chan_id, value, self.maxsize, t)
 
     def get(self, timeout: Optional[float] = None) -> Any:
+        import time
+
+        from ..util import tracing
+
         q = self._local()
         if q is None:
             raise RuntimeError(
                 "DistChannel.get() outside the owner process (SPSC: the "
                 f"consumer owns {self.chan_id[:8]} at {self.owner_addr})"
             )
-        return q.get(timeout=timeout)
+        with tracing.span_if_traced(
+                "channel_recv", {"channel": self.chan_id[:8]}):
+            t0 = time.perf_counter()
+            try:
+                return q.get(timeout=timeout)
+            finally:
+                # waits are recorded even when the get times out — an
+                # Empty after a full timeout IS the stall being measured
+                _recv_wait.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         """Owner side: drop the registry queue (one-shot result channels

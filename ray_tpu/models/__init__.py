@@ -14,6 +14,7 @@ from .transformer import (  # noqa: F401
     init_kv_cache,
     init_params,
     loss_fn,
+    loss_from_logits,
     param_axes,
     prefill,
 )

@@ -485,8 +485,23 @@ def loss_fn(
     functools.partial(forward_pp, mesh=..., num_microbatches=...))."""
     fwd = forward_fn if forward_fn is not None else forward
     logits, aux = fwd(params, batch["tokens"], cfg)
-    targets = batch["targets"]
-    mask = batch.get("mask")
+    return loss_from_logits(
+        logits, batch["targets"], batch.get("mask"), cfg, aux,
+        z_loss_coef=z_loss_coef,
+    )
+
+
+def loss_from_logits(
+    logits: jax.Array,
+    targets: jax.Array,
+    mask: Optional[jax.Array],
+    cfg: ModelConfig,
+    aux: jax.Array,
+    z_loss_coef: float = 1e-4,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """The loss epilogue given logits [B,T,V] — shared by loss_fn and the
+    MPMD pipeline's last stage (which computes logits from streamed
+    activations rather than a full forward)."""
     if mask is None:
         mask = jnp.ones_like(targets, jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)

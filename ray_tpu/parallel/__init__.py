@@ -11,3 +11,10 @@ from .sharding import (  # noqa: F401
     spec_for,
     tree_shardings,
 )
+from .zero import (  # noqa: F401
+    flatten_tree,
+    group_mean,
+    leaf_sq_norms,
+    partition_leaves,
+    unflatten_like,
+)

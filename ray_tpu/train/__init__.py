@@ -26,6 +26,15 @@ from .config import (  # noqa: F401
     ScalingConfig,
 )
 from .integrations import MLflowLoggerCallback, WandbLoggerCallback  # noqa: F401
+from .pipeline import (  # noqa: F401
+    DEFAULT_STAGE_RULES,
+    LMStageModule,
+    PipelineConfig,
+    PipelineStallError,
+    PipelineTrainer,
+    match_stage_rules,
+    split_stage_params,
+)
 from .result import Result  # noqa: F401
 from .session import (  # noqa: F401
     TrainContext,

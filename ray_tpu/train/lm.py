@@ -29,7 +29,7 @@ def make_optimizer(
     weight_decay: float = 0.1,
     b1: float = 0.9,
     b2: float = 0.95,
-    grad_clip: float = 1.0,
+    grad_clip: Optional[float] = 1.0,
     factored: bool = False,
 ) -> optax.GradientTransformation:
     """factored=True swaps adamw for adafactor (factored second moments,
@@ -42,6 +42,12 @@ def make_optimizer(
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
+    # grad_clip=None/0 drops the clip link entirely. The MPMD pipeline
+    # trainer needs this: global-norm clipping must see the WHOLE model's
+    # norm, but each stage gang only holds its slice — the trainer sums
+    # per-stage sq-norms across gangs and applies the scale itself, so the
+    # in-optimizer (per-stage) clip would double-clip with the wrong norm.
+    clip = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
     if factored:
         # Two adafactor traps, both measured fatal on the LM task:
         # - multiply_by_parameter_scale makes updates proportional to
@@ -52,14 +58,14 @@ def make_optimizer(
         #   weight 10%/step and cancels all learning. Run undecayed (the
         #   T5 recipe also trains adafactor without decay).
         return optax.chain(
-            optax.clip_by_global_norm(grad_clip),
+            *clip,
             optax.adafactor(
                 schedule, weight_decay_rate=None,
                 multiply_by_parameter_scale=False,
             ),
         )
     return optax.chain(
-        optax.clip_by_global_norm(grad_clip),
+        *clip,
         optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
     )
 

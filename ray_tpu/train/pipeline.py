@@ -1,0 +1,1018 @@
+"""MPMD pipeline-parallel training: stage gangs streaming over DistChannels.
+
+Reference: arXiv:2412.14374 (MPMD pipeline parallelism) composed with
+arXiv:2004.13336 (ZeRO-1 optimizer-state sharding). The existing
+`parallel/pipeline.py` is SPMD GPipe *inside one jit program* (stages are
+mesh shards of a single gang); this module is the missing MPMD shape: each
+pipeline stage is its OWN actor gang, separately scheduled (STRICT_SPREAD
+across hosts when the cluster allows), holding only its slice of the
+model, and the stages exchange activation/gradient tensors at microbatch
+granularity through bounded `DistChannel`s — channel capacity IS the
+backpressure that paces a fast producer stage to its consumer.
+
+Topology for `num_stages=S, dp=R`: S x R `StageWorker`s. Worker (si, r)
+streams activations to (si+1, r) and gradients back to (si-1, r) on a
+1F1B schedule (`n_warmup = S-1-si` forwards in flight, then strict
+forward/backward alternation — the steady-state memory profile holds only
+`n_warmup+1` microbatch inputs, and the backward recomputes the stage
+forward under jit rather than stashing residuals). Replicas of one stage
+form a data-parallel group that exchanges gradients over pairwise
+channels: either a full all-reduce, or — with `zero1=True` — a
+reduce-scatter so each replica updates only the param leaves it owns
+(optimizer state sharded R-ways, arXiv:2004.13336) followed by an
+all-gather of the updated leaves. Both paths accumulate in ascending rank
+order, so ZeRO-1 on/off is bit-identical (tested).
+
+Global-norm gradient clipping needs the WHOLE model's norm, which no
+single stage holds: stages run their optimizer unclipped
+(`make_optimizer(grad_clip=None)`), report per-leaf squared norms, and
+the driver folds them — summed in one canonical path order so sharded and
+replicated runs see the identical float — into one `gnorm` that every
+worker applies as optax's clip scale in `apply_update`.
+
+Model partitioning is declarative, mirroring `parallel/sharding.py`'s
+match-rules grammar but over PARAM PATHS -> stage placements:
+
+    DEFAULT_STAGE_RULES = (
+        (r"^layers(/|$)", "split"),   # leading (layer) axis split across stages
+        (r"^(embed|pos_emb)$", "first"),
+        (r"^(final_norm|final_norm_b|lm_head)$", "last"),
+    )
+
+`"split"` slices the stacked-layer leading axis into contiguous blocks;
+`"first"`/`"last"`/an int pin a leaf to one stage. Unmatched params are an
+error — silent replication is how pipeline parity bugs are born.
+
+Fault tolerance mirrors `JaxTrainer.fit`: per-stage checkpoints through
+`train/checkpoint.py` (each worker saves `stage{si}_dp{r}` under one
+checkpoint dir), and on any failure — a dead gang member surfaces as
+`RayActorError`, a severed channel as `PipelineStallError` (every blocked
+recv/put carries a deadline; nothing hangs on a dead peer) — the driver
+tears the gang down and restarts from the latest checkpoint up to
+`FailureConfig.max_failures`, else raises `TrainingFailedError`.
+
+Observability: `train_pipeline_bubble_fraction` (driver gauge),
+`train_stage_step_seconds{stage}` (worker histogram + SLO digest), and a
+traced step yields the full timeline — `pipeline.step` over per-worker
+`pipeline.stage_step` spans with the `channel_send`/`channel_recv` legs
+nested inside.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import queue
+import re
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import api
+from ..core.logging import get_logger
+from ..core.metrics import Gauge, Histogram
+from ..models import ModelConfig, init_params, loss_from_logits
+from ..parallel import zero
+from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
+from .config import RunConfig
+from .result import Result
+from .trainer import TrainingFailedError
+
+logger = get_logger("train.pipeline")
+
+_bubble_gauge = Gauge(
+    "train_pipeline_bubble_fraction",
+    "Fraction of aggregate stage-worker wall time spent NOT computing "
+    "(channel waits + schedule bubbles) in the last pipeline step.",
+)
+_stage_step_hist = Histogram(
+    "train_stage_step_seconds",
+    "Per-stage wall time of one pipeline step (all microbatches).",
+)
+
+
+class PipelineStallError(RuntimeError):
+    """A channel recv/put exceeded its deadline — the peer stage is dead,
+    wedged, or desynced. Raised instead of hanging so the driver's
+    restart-from-checkpoint loop (or fail-fast) always engages."""
+
+
+# ---------------------------------------------------------------------------
+# Declarative stage partitioning
+# ---------------------------------------------------------------------------
+
+DEFAULT_STAGE_RULES: Tuple[Tuple[str, Any], ...] = (
+    (r"^layers(/|$)", "split"),
+    (r"^(embed|pos_emb)$", "first"),
+    (r"^(final_norm|final_norm_b|lm_head)$", "last"),
+)
+
+
+def match_stage_rules(
+    rules: Sequence[Tuple[str, Any]],
+    flat_params: Dict[str, Any],
+    num_stages: int,
+) -> Dict[str, Any]:
+    """First-match-wins over param paths (the `match_partition_rules`
+    idiom of parallel/sharding.py, with placements instead of axis specs).
+    Placements: "split" | "first" | "last" | int stage index."""
+    out: Dict[str, Any] = {}
+    for path in flat_params:
+        for pattern, placement in rules:
+            if re.search(pattern, path):
+                if isinstance(placement, int):
+                    if not 0 <= placement < num_stages:
+                        raise ValueError(
+                            f"rule {pattern!r} pins {path!r} to stage "
+                            f"{placement}, outside 0..{num_stages - 1}"
+                        )
+                elif placement not in ("split", "first", "last"):
+                    raise ValueError(
+                        f"rule {pattern!r}: unknown placement {placement!r}"
+                    )
+                out[path] = placement
+                break
+        else:
+            raise ValueError(
+                f"no stage rule matches param {path!r} — every leaf must "
+                "be placed explicitly (silent replication breaks parity)"
+            )
+    return out
+
+
+def split_stage_params(
+    flat_params: Dict[str, np.ndarray],
+    num_stages: int,
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_STAGE_RULES,
+) -> List[Dict[str, np.ndarray]]:
+    """Full flat param dict -> one flat dict per stage. "split" leaves are
+    sliced into contiguous blocks along their stacked-layer leading axis
+    (stage s gets rows [s*L/S, (s+1)*L/S))."""
+    placements = match_stage_rules(rules, flat_params, num_stages)
+    stages: List[Dict[str, np.ndarray]] = [{} for _ in range(num_stages)]
+    for path, leaf in flat_params.items():
+        placement = placements[path]
+        if placement == "split":
+            n = leaf.shape[0]
+            if n % num_stages:
+                raise ValueError(
+                    f"{path!r}: leading axis {n} not divisible by "
+                    f"{num_stages} stages"
+                )
+            per = n // num_stages
+            for s in range(num_stages):
+                stages[s][path] = leaf[s * per:(s + 1) * per]
+        else:
+            s = (0 if placement == "first"
+                 else num_stages - 1 if placement == "last"
+                 else int(placement))
+            stages[s][path] = leaf
+    return stages
+
+
+def _nest(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Flat {"a/b": leaf} -> nested {"a": {"b": leaf}} (the shape the
+    transformer internals expect). Pure structure — jit-stable."""
+    tree: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        parts = path.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# The per-stage model slice
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LMStageModule:
+    """The transformer, restricted to one pipeline stage's layers: stage 0
+    owns the embedding prologue, the last stage owns the head + loss, and
+    every stage runs its contiguous block of the layer stack. Stage math
+    composes to exactly `models.transformer.forward` (microbatching only
+    reorders the schedule), which is what the parity test asserts."""
+
+    cfg: ModelConfig
+    num_stages: int
+    rules: Tuple[Tuple[str, Any], ...] = DEFAULT_STAGE_RULES
+
+    def __post_init__(self):
+        if self.cfg.tie_embeddings:
+            raise ValueError(
+                "pipeline stages need embed (first stage) and lm_head "
+                "(last stage) as separate params; tie_embeddings would "
+                "place one tensor on two gangs"
+            )
+        if self.cfg.is_moe:
+            raise ValueError("MoE models are not pipeline-partitionable yet")
+        if self.cfg.n_layers % self.num_stages:
+            raise ValueError(
+                f"{self.cfg.n_layers} layers not divisible by "
+                f"{self.num_stages} stages"
+            )
+
+    def init_full(self, seed: int) -> Dict[str, np.ndarray]:
+        """Full model init on the driver, flattened to {path: np array} —
+        the form the stage rules partition."""
+        import jax
+
+        params = init_params(self.cfg, jax.random.PRNGKey(seed))
+        return {p: np.asarray(v) for p, v in zero.flatten_tree(params).items()}
+
+    def partition(self, flat_params: Dict[str, np.ndarray]
+                  ) -> List[Dict[str, np.ndarray]]:
+        return split_stage_params(flat_params, self.num_stages, self.rules)
+
+    # -- stage math (pure functions of (flat_params, inputs); jitted by
+    # the worker) ----------------------------------------------------------
+
+    def _rope(self):
+        from ..ops import rope_frequencies
+
+        if self.cfg.positional == "learned":
+            return None
+        return rope_frequencies(
+            self.cfg.hdim, self.cfg.max_seq_len, self.cfg.rope_theta)
+
+    def forward(self, stage: int, flat_params: Dict[str, Any], x):
+        """Stage trunk: tokens [B,T] -> h [B,T,D] for stage 0, else
+        h -> h through this stage's layer block."""
+        import jax
+
+        from ..models.transformer import _block, _prologue
+
+        cfg = self.cfg
+        params = _nest(flat_params)
+        if stage == 0:
+            x, rope_tables = _prologue(params, x, cfg)
+        else:
+            rope_tables = self._rope()
+
+        def body(carry, lp):
+            y, aux = _block(carry, lp, cfg, rope_tables, None)
+            return y, aux
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _aux = jax.lax.scan(body, x, params["layers"])
+        return x
+
+    def loss(self, stage: int, flat_params: Dict[str, Any], x, targets):
+        """Last-stage epilogue: trunk + lm head + LM loss (the shared
+        loss_from_logits, so metrics match loss_fn exactly)."""
+        import jax.numpy as jnp
+
+        from ..models.transformer import _lm_head
+
+        h = self.forward(stage, flat_params, x)
+        logits = _lm_head(h, _nest(flat_params), self.cfg)
+        return loss_from_logits(
+            logits, targets, None, self.cfg, jnp.zeros((), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """Knobs for the MPMD pipeline.
+
+    num_microbatches must divide each replica's batch (global batch /
+    dp); channel_capacity bounds in-flight microbatches per edge (the
+    backpressure); small_blob_bytes is the PR-5-style split — tensors
+    above it ride the host object plane as ObjectRefs with only the ref
+    crossing the channel. grad_clip is the GLOBAL-norm clip applied from
+    the driver-computed cross-stage norm (None/0 disables). zero1 shards
+    optimizer state across the dp replicas of each stage."""
+
+    num_stages: int = 2
+    num_microbatches: int = 2
+    dp: int = 1
+    zero1: bool = False
+    channel_capacity: int = 4
+    small_blob_bytes: int = 1 << 20
+    grad_clip: Optional[float] = 1.0
+    recv_timeout_s: float = 60.0
+    put_timeout_s: float = 60.0
+    step_timeout_s: float = 180.0
+    checkpoint_every: int = 0
+    placement_strategy: str = "STRICT_SPREAD"
+    stages_in_process: Optional[bool] = None
+    worker_cpus: float = 1.0
+
+
+# ---------------------------------------------------------------------------
+# The stage worker
+# ---------------------------------------------------------------------------
+
+
+class StageWorker:
+    """One gang member: pipeline stage `stage`, data-parallel rank
+    `dp_rank`. Owns its param slice, its (possibly ZeRO-sharded)
+    optimizer state, and the consumer end of its inbound channels.
+
+    Deliberately NOT decorated with @api.remote: the decorator would
+    rebind this module-level name to the ActorClass wrapper, forcing
+    cloudpickle to serialize the class BY VALUE into worker processes —
+    and its methods touch module metrics (lock-bearing, unpicklable).
+    Kept importable by reference instead; `_StageWorkerActor` below is
+    the remote handle the gang schedules."""
+
+    def __init__(self, module: LMStageModule, stage: int, dp_rank: int,
+                 pcfg: PipelineConfig, opt_kwargs: Dict[str, Any]):
+        self.module = module
+        self.stage = stage
+        self.dp_rank = dp_rank
+        self.pcfg = pcfg
+        self.opt_kwargs = dict(opt_kwargs)
+        self.S = module.num_stages
+        self.R = pcfg.dp
+        self.zero1 = bool(pcfg.zero1 and self.R > 1)
+        self.step = 0
+        self.act_in = self.grad_in = self.act_out = self.grad_out = None
+        self.dp_in: Dict[int, Any] = {}
+        self.dp_out: Dict[int, Any] = {}
+        self._pending: Optional[Dict[str, np.ndarray]] = None
+        self._wait_s = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self, stage_params: Dict[str, np.ndarray],
+              resume_dir: Optional[str] = None, step: int = 0) -> int:
+        import jax.numpy as jnp
+
+        from .lm import make_optimizer
+
+        self.params = {p: jnp.asarray(v, jnp.float32)
+                       for p, v in stage_params.items()}
+        # the stage optimizer runs UNCLIPPED — global-norm clipping is
+        # applied cross-stage by the driver (see module docstring)
+        self.opt = make_optimizer(grad_clip=None, **self.opt_kwargs)
+        if self.zero1:
+            self.assignment = zero.partition_leaves(self.params, self.R)
+            self.owned = sorted(
+                p for p, r in self.assignment.items() if r == self.dp_rank)
+            self.opt_state = self.opt.init(
+                {p: self.params[p] for p in self.owned})
+        else:
+            self.assignment = None
+            self.owned = sorted(self.params)
+            self.opt_state = self.opt.init(self.params)
+        self.step = step
+        if resume_dir is not None:
+            self._load(resume_dir)
+        self._build_fns()
+        return os.getpid()
+
+    def _shard_path(self, base_dir: str) -> str:
+        return os.path.join(base_dir, f"stage{self.stage}_dp{self.dp_rank}")
+
+    def save_checkpoint(self, base_dir: str) -> str:
+        path = self._shard_path(base_dir)
+        save_pytree({"params": self.params, "opt": self.opt_state}, path)
+        return path
+
+    def _load(self, base_dir: str) -> None:
+        import jax.numpy as jnp
+
+        target = {"params": self.params, "opt": self.opt_state}
+        restored = load_pytree(self._shard_path(base_dir), target=target)
+        self.params = {p: jnp.asarray(v)
+                       for p, v in restored["params"].items()}
+        self.opt_state = restored["opt"]
+
+    def get_params(self) -> Dict[str, np.ndarray]:
+        return {p: np.asarray(v) for p, v in self.params.items()}
+
+    def _build_fns(self) -> None:
+        """Jitted stage kernels. The backward re-runs the stage forward
+        inside jax.vjp UNDER jit (activation recomputation): only each
+        in-flight microbatch's stage INPUT is stashed, the true 1F1B
+        memory profile."""
+        import jax
+
+        m, si, S = self.module, self.stage, self.S
+        if si == S - 1:
+            if S == 1:
+                self._lossgrad = jax.jit(jax.value_and_grad(
+                    lambda p, tok, tgt: m.loss(0, p, tok, tgt),
+                    has_aux=True))
+            else:
+                self._lossgrad = jax.jit(jax.value_and_grad(
+                    lambda p, h, tgt: m.loss(si, p, h, tgt),
+                    argnums=(0, 1), has_aux=True))
+        else:
+            self._fwd = jax.jit(lambda p, x: m.forward(si, p, x))
+            if si == 0:
+                def bwd(p, tok, g):
+                    _, vjp = jax.vjp(lambda pp: m.forward(0, pp, tok), p)
+                    return vjp(g)[0]
+            else:
+                def bwd(p, h, g):
+                    _, vjp = jax.vjp(
+                        lambda pp, hh: m.forward(si, pp, hh), p, h)
+                    return vjp(g)
+            self._bwd = jax.jit(bwd)
+
+    # -- channel wiring ----------------------------------------------------
+
+    def make_channels(self) -> Dict[str, Any]:
+        """Create the channels THIS worker consumes (consumer-homed SPSC:
+        the owner is always the reader). Returns the handles for the
+        driver to hand to the producing peers."""
+        from ..core import channels
+
+        addr = channels.service_address() or channels.ensure_service()
+        cap = self.pcfg.channel_capacity
+        out: Dict[str, Any] = {"pid": os.getpid()}
+        if self.stage > 0:
+            self.act_in = channels.DistChannel(addr, maxsize=cap)
+            out["act_in"] = self.act_in
+        if self.stage < self.S - 1:
+            self.grad_in = channels.DistChannel(addr, maxsize=cap)
+            out["grad_in"] = self.grad_in
+        if self.R > 1:
+            # one inbox per dp peer keeps every edge SPSC; capacity 2
+            # covers the at-most-one-frame-per-phase protocol with slack
+            self.dp_in = {
+                src: channels.DistChannel(addr, maxsize=2)
+                for src in range(self.R) if src != self.dp_rank
+            }
+            out["dp_in"] = self.dp_in
+        return out
+
+    def connect(self, act_out, grad_out, dp_out: Dict[int, Any]) -> None:
+        self.act_out = act_out
+        self.grad_out = grad_out
+        self.dp_out = dp_out or {}
+
+    # -- transport helpers (deadline-guarded: never hang on a dead peer) --
+
+    def _send(self, chan, frame, what: str) -> float:
+        t0 = time.perf_counter()
+        try:
+            chan.put(frame, timeout=self.pcfg.put_timeout_s)
+        except queue.Full as e:
+            raise PipelineStallError(
+                f"stage {self.stage}/dp{self.dp_rank}: {what} send still "
+                f"blocked after {self.pcfg.put_timeout_s}s — consumer "
+                "stage wedged or dead") from e
+        except OSError as e:
+            raise PipelineStallError(
+                f"stage {self.stage}/dp{self.dp_rank}: {what} consumer "
+                f"unreachable: {e}") from e
+        return time.perf_counter() - t0
+
+    def _recv(self, chan, what: str) -> Tuple[Any, float]:
+        t0 = time.perf_counter()
+        try:
+            frame = chan.get(timeout=self.pcfg.recv_timeout_s)
+        except queue.Empty as e:
+            raise PipelineStallError(
+                f"stage {self.stage}/dp{self.dp_rank}: no {what} within "
+                f"{self.pcfg.recv_timeout_s}s — producer stage wedged or "
+                "dead") from e
+        return frame, time.perf_counter() - t0
+
+    def _send_tensor(self, chan, arr, step: int, what: str) -> None:
+        arr = np.asarray(arr)
+        if arr.nbytes > self.pcfg.small_blob_bytes:
+            # object-plane fallback (the PR-5 small-blob split): large
+            # activations ride the transfer plane; only the ref crosses
+            # the channel. Serialized refs are escape-noted, so the
+            # consumer's deref never races the producer's refcount.
+            frame = ("ref", step, api.put(arr))
+        else:
+            frame = ("arr", step, arr)
+        self._wait_s += self._send(chan, frame, what)
+
+    def _recv_tensor(self, chan, step: int, what: str):
+        frame, waited = self._recv(chan, what)
+        self._wait_s += waited
+        tag, got_step, payload = frame
+        if got_step != step:
+            raise PipelineStallError(
+                f"stage {self.stage}/dp{self.dp_rank}: {what} frame for "
+                f"step {got_step} while running step {step} (desynced "
+                "peer)")
+        if tag == "ref":
+            t0 = time.perf_counter()
+            payload = api.get(payload, timeout=self.pcfg.recv_timeout_s)
+            self._wait_s += time.perf_counter() - t0
+        return payload
+
+    # -- data-parallel gradient exchange ----------------------------------
+
+    def _dp_collect(self, step: int, phase: str, mine: Dict[str, Any],
+                    outbound: Callable[[int], Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+        """Send `outbound(peer)` to every dp peer tagged (phase, step),
+        recv one frame from each, and return all contributions in
+        ASCENDING RANK ORDER (self included) — the canonical order that
+        makes sharded and replicated reductions bit-identical."""
+        for peer in sorted(self.dp_out):
+            self._wait_s += self._send(
+                self.dp_out[peer], (phase, step, outbound(peer)),
+                f"dp {phase}")
+        parts: Dict[int, Dict[str, Any]] = {self.dp_rank: mine}
+        for src in sorted(self.dp_in):
+            frame, waited = self._recv(self.dp_in[src], f"dp {phase}")
+            self._wait_s += waited
+            got_phase, got_step, payload = frame
+            if (got_phase, got_step) != (phase, step):
+                raise PipelineStallError(
+                    f"stage {self.stage}/dp{self.dp_rank}: dp frame "
+                    f"({got_phase}, {got_step}) during ({phase}, {step})")
+            parts[src] = payload
+        return [parts[r] for r in sorted(parts)]
+
+    def _reduce_scatter(self, flat: Dict[str, np.ndarray], step: int
+                        ) -> Dict[str, np.ndarray]:
+        """ZeRO-1 phase 1: each peer receives my grads for ITS leaves;
+        I return the dp-mean grads for MY leaves."""
+        mine = {p: flat[p] for p in self.owned}
+        contributions = self._dp_collect(
+            step, "rs", mine,
+            lambda peer: {p: flat[p] for p, r in self.assignment.items()
+                          if r == peer})
+        return zero.group_mean(contributions)
+
+    def _all_reduce(self, flat: Dict[str, np.ndarray], step: int
+                    ) -> Dict[str, np.ndarray]:
+        """Replicated dp: full grad dict to every peer, mean of all."""
+        contributions = self._dp_collect(step, "ar", flat, lambda peer: flat)
+        return zero.group_mean(contributions)
+
+    def _all_gather(self, owned_new: Dict[str, np.ndarray], step: int
+                    ) -> Dict[str, np.ndarray]:
+        """ZeRO-1 phase 3: broadcast my updated leaves, assemble the full
+        updated param dict from everyone's shards."""
+        contributions = self._dp_collect(
+            step, "ag", owned_new, lambda peer: owned_new)
+        full: Dict[str, np.ndarray] = {}
+        for part in contributions:
+            full.update(part)
+        return full
+
+    # -- the step ----------------------------------------------------------
+
+    def compute_grads(self, step: int, feed: Dict[str, np.ndarray]
+                      ) -> Dict[str, Any]:
+        """Run this worker's half-step: 1F1B over all microbatches
+        (streaming through the stage channels), dp-reduce the mean
+        grads, and report per-leaf squared norms for the driver's global
+        clip. The update itself waits for `apply_update(gnorm)`."""
+        from ..util import slo, tracing
+
+        si, S, M = self.stage, self.S, self.pcfg.num_microbatches
+        self._wait_s = 0.0
+        t_start = time.perf_counter()
+        with tracing.span_if_traced(
+                "pipeline.stage_step",
+                {"stage": si, "dp": self.dp_rank, "step": step}):
+            tok_mb = (np.split(np.asarray(feed["tokens"]), M)
+                      if si == 0 else None)
+            tgt_mb = (np.split(np.asarray(feed["targets"]), M)
+                      if si == S - 1 else None)
+
+            grad_sum: Optional[Dict[str, Any]] = None
+            loss_sum = 0.0
+            metrics_sum: Dict[str, float] = {}
+            stash: deque = deque()  # in-flight microbatch stage inputs
+
+            def accumulate(dparams) -> None:
+                nonlocal grad_sum
+                if grad_sum is None:
+                    grad_sum = dict(dparams)
+                else:
+                    grad_sum = {p: grad_sum[p] + dparams[p]
+                                for p in grad_sum}
+
+            def run_forward(k: int) -> None:
+                nonlocal loss_sum
+                x = (tok_mb[k] if si == 0
+                     else self._recv_tensor(self.act_in, step, "activation"))
+                if si == S - 1:
+                    # last stage fuses F and B: one jitted value_and_grad
+                    if S == 1:
+                        (loss, mets), dparams = self._lossgrad(
+                            self.params, x, tgt_mb[k])
+                    else:
+                        (loss, mets), (dparams, dh) = self._lossgrad(
+                            self.params, x, tgt_mb[k])
+                        self._send_tensor(self.grad_out, dh, step,
+                                          "gradient")
+                    accumulate(dparams)
+                    loss_sum += float(loss)
+                    for name, v in mets.items():
+                        metrics_sum[name] = metrics_sum.get(name, 0.0) \
+                            + float(v)
+                else:
+                    h = self._fwd(self.params, x)
+                    stash.append(x)
+                    self._send_tensor(self.act_out, h, step, "activation")
+
+            def run_backward() -> None:
+                if si == S - 1:
+                    return  # fused into run_forward
+                g = self._recv_tensor(self.grad_in, step, "gradient")
+                x = stash.popleft()
+                if si == 0:
+                    dparams = self._bwd(self.params, x, g)
+                else:
+                    dparams, dh = self._bwd(self.params, x, g)
+                    self._send_tensor(self.grad_out, dh, step, "gradient")
+                accumulate(dparams)
+
+            # 1F1B: warmup fills the pipe, steady state alternates F/B,
+            # cooldown drains
+            n_warm = min(S - 1 - si, M)
+            for k in range(n_warm):
+                run_forward(k)
+            for k in range(n_warm, M):
+                run_forward(k)
+                run_backward()
+            for _ in range(n_warm):
+                run_backward()
+
+            mean = {p: np.asarray(g) / np.float32(M)
+                    for p, g in grad_sum.items()}
+            if self.R > 1:
+                if self.zero1:
+                    self._pending = self._reduce_scatter(mean, step)
+                else:
+                    self._pending = self._all_reduce(mean, step)
+            else:
+                self._pending = mean
+            # grad-norm contributions: exactly one report per leaf across
+            # the dp group (zero1: each rank its shard; else rank 0 all)
+            if self.zero1:
+                sqnorms = zero.leaf_sq_norms(self._pending)
+            elif self.dp_rank == 0:
+                sqnorms = zero.leaf_sq_norms(self._pending)
+            else:
+                sqnorms = {}
+
+        wall = time.perf_counter() - t_start
+        busy = max(0.0, wall - self._wait_s)
+        _stage_step_hist.observe(wall, tags={"stage": str(si)})
+        slo.observe("train_stage_step_seconds", wall,
+                    tags={"stage": str(si)})
+        out: Dict[str, Any] = {
+            "sqnorms": sqnorms, "wall_s": wall, "busy_s": busy,
+        }
+        if si == S - 1:
+            out["loss"] = loss_sum / M
+            out["metrics"] = {name: v / M for name, v in metrics_sum.items()}
+        return out
+
+    def apply_update(self, step: int, gnorm: float) -> int:
+        """Apply the optimizer with the driver's global-norm clip scale
+        (mirrors optax.clip_by_global_norm's formula exactly)."""
+        import jax.numpy as jnp
+        import optax
+
+        clip = self.pcfg.grad_clip
+
+        def clipped(g: np.ndarray) -> np.ndarray:
+            if not clip or gnorm < clip:
+                return g
+            return (g / np.float32(gnorm)) * np.float32(clip)
+
+        if self.zero1:
+            owned_params = {p: self.params[p] for p in self.owned}
+            grads = {p: jnp.asarray(clipped(self._pending[p]))
+                     for p in self.owned}
+            updates, self.opt_state = self.opt.update(
+                grads, self.opt_state, owned_params)
+            new_owned = optax.apply_updates(owned_params, updates)
+            full = self._all_gather(
+                {p: np.asarray(v) for p, v in new_owned.items()}, step)
+            self.params = {p: jnp.asarray(full[p]) for p in sorted(full)}
+        else:
+            grads = {p: jnp.asarray(clipped(g))
+                     for p, g in self._pending.items()}
+            updates, self.opt_state = self.opt.update(
+                grads, self.opt_state, self.params)
+            self.params = optax.apply_updates(self.params, updates)
+        self._pending = None
+        self.step = step + 1
+        return self.step
+
+
+# wrapped under a DIFFERENT name so `pipeline.StageWorker` still resolves
+# to the plain class (see the class docstring for why that matters)
+_StageWorkerActor = api.remote(StageWorker)
+
+
+# ---------------------------------------------------------------------------
+# The gang + driver
+# ---------------------------------------------------------------------------
+
+
+class _Gang:
+    """S x R StageWorkers, placed STRICT_SPREAD when feasible (one bundle
+    per worker, each on a distinct host — the worker_group/disagg fallback
+    idiom: infeasible groups degrade to best-effort placement), channels
+    created consumer-side and cross-wired."""
+
+    def __init__(self, module: LMStageModule, pcfg: PipelineConfig,
+                 opt_kwargs: Dict[str, Any],
+                 stage_params: List[Dict[str, np.ndarray]],
+                 resume_dir: Optional[str], start_step: int):
+        from ..core.task_spec import PlacementGroupSchedulingStrategy
+
+        rt = api._auto_init()
+        S, R = module.num_stages, pcfg.dp
+        n = S * R
+        # explicit in-process stages all live in the driver: reserving a
+        # CPU per worker (or spread-placing them) would just deadlock the
+        # gang on a small box — a 1-CPU node can't "hold" 2 driver threads
+        in_proc = pcfg.stages_in_process is True
+        worker_cpus = 0.0 if in_proc else pcfg.worker_cpus
+        self.pg = None
+        if pcfg.placement_strategy and not in_proc:
+            try:
+                pg = rt.pg_manager.create(
+                    [{"CPU": worker_cpus} for _ in range(n)],
+                    strategy=pcfg.placement_strategy,
+                )
+                if pg.ready(timeout=30.0):
+                    self.pg = pg
+                else:
+                    logger.info(
+                        "pipeline %s group never materialized; best-effort "
+                        "placement", pcfg.placement_strategy)
+                    rt.pg_manager.remove(pg)
+            except Exception as e:  # noqa: BLE001 — infeasible on this cluster
+                logger.info("pipeline placement %s infeasible (%s); "
+                            "best-effort placement",
+                            pcfg.placement_strategy, e)
+        self.workers: Dict[Tuple[int, int], Any] = {}
+        for i, (si, r) in enumerate(
+                (si, r) for si in range(S) for r in range(R)):
+            opts: Dict[str, Any] = {"num_cpus": worker_cpus}
+            if pcfg.stages_in_process is not None:
+                opts["in_process"] = pcfg.stages_in_process
+            if self.pg is not None:
+                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group_id=self.pg.id, bundle_index=i)
+            self.workers[(si, r)] = _StageWorkerActor.options(**opts).remote(
+                module, si, r, pcfg, opt_kwargs)
+
+        self.pids = {
+            key: pid for key, pid in zip(
+                self.workers,
+                api.get([
+                    w.setup.remote(stage_params[si], resume_dir, start_step)
+                    for (si, _r), w in self.workers.items()
+                ], timeout=pcfg.step_timeout_s))
+        }
+        chans = {
+            key: c for key, c in zip(
+                self.workers,
+                api.get([w.make_channels.remote()
+                         for w in self.workers.values()],
+                        timeout=pcfg.step_timeout_s))
+        }
+        connects = []
+        for (si, r), w in self.workers.items():
+            act_out = chans[(si + 1, r)]["act_in"] if si < S - 1 else None
+            grad_out = chans[(si - 1, r)]["grad_in"] if si > 0 else None
+            dp_out = ({peer: chans[(si, peer)]["dp_in"][r]
+                       for peer in range(R) if peer != r} if R > 1 else {})
+            connects.append(w.connect.remote(act_out, grad_out, dp_out))
+        api.get(connects, timeout=pcfg.step_timeout_s)
+
+    def shutdown(self) -> None:
+        for w in self.workers.values():
+            try:
+                api.kill(w)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        if self.pg is not None:
+            try:
+                rt = api._auto_init()
+                rt.pg_manager.remove(self.pg)
+            except Exception:  # noqa: BLE001 — head gone
+                pass
+            self.pg = None
+
+
+class PipelineTrainer:
+    """Drives the stage gangs: per step, fan out `compute_grads` to all
+    S x R workers (1F1B streams between them through the channels), fold
+    the per-leaf squared norms into ONE global grad norm, then fan out
+    `apply_update(gnorm)`. Restart-from-checkpoint on failure, mirroring
+    `JaxTrainer.fit`."""
+
+    def __init__(
+        self,
+        module: LMStageModule,
+        *,
+        pipeline: Optional[PipelineConfig] = None,
+        optimizer_kwargs: Optional[Dict[str, Any]] = None,
+        run_config: Optional[RunConfig] = None,
+        data_fn: Optional[Callable[[int], Dict[str, np.ndarray]]] = None,
+        seed: int = 0,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.module = module
+        self.pipeline = pipeline or PipelineConfig(
+            num_stages=module.num_stages)
+        if self.pipeline.num_stages != module.num_stages:
+            raise ValueError(
+                f"PipelineConfig.num_stages={self.pipeline.num_stages} but "
+                f"module has {module.num_stages} stages")
+        self.opt_kwargs = dict(optimizer_kwargs or {})
+        if "grad_clip" in self.opt_kwargs:
+            raise ValueError(
+                "pass grad_clip via PipelineConfig (it is applied as a "
+                "cross-stage global norm, not per-stage inside the "
+                "optimizer)")
+        self.run_config = run_config or RunConfig()
+        self.data_fn = data_fn
+        self.seed = seed
+        self.resume_checkpoint = resume_from_checkpoint
+        # chaos/test observability: live worker pids + gang restart count
+        self.worker_pids: Dict[Tuple[int, int], int] = {}
+        self.restarts = 0
+        self.final_state: Optional[List[Dict[str, np.ndarray]]] = None
+        self.final_state_all: Dict[Tuple[int, int],
+                                   Dict[str, np.ndarray]] = {}
+
+    # ------------------------------------------------------------------
+
+    def _storage_dir(self) -> str:
+        base = (self.run_config.storage_path
+                or os.path.expanduser("~/ray_tpu_results"))
+        name = self.run_config.name or f"pipeline_{uuid.uuid4().hex[:8]}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _default_data(self, global_batch: int, seq_len: int
+                      ) -> Callable[[int], Dict[str, np.ndarray]]:
+        from .lm import synthetic_batch
+
+        def data(step: int) -> Dict[str, np.ndarray]:
+            batch = synthetic_batch(
+                self.module.cfg, global_batch, seq_len,
+                seed=self.seed * 100_003 + step)
+            return {k: np.asarray(v) for k, v in batch.items()}
+
+        return data
+
+    def fit(self, num_steps: int, global_batch: int = 8,
+            seq_len: int = 32) -> Result:
+        api._auto_init()
+        pcfg = self.pipeline
+        S, R, M = self.module.num_stages, pcfg.dp, pcfg.num_microbatches
+        if global_batch % (R * M):
+            raise ValueError(
+                f"global_batch={global_batch} must divide into dp={R} "
+                f"replicas x {M} microbatches")
+        data_fn = self.data_fn or self._default_data(global_batch, seq_len)
+
+        storage = self._storage_dir()
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            ckpt_cfg.num_to_keep,
+            ckpt_cfg.checkpoint_score_attribute,
+            ckpt_cfg.checkpoint_score_order,
+        )
+        max_failures = self.run_config.failure_config.max_failures
+        failures = 0
+        resume = self.resume_checkpoint
+        start_step = (resume.get_metadata().get("step", -1) + 1
+                      if resume is not None else 0)
+        history: List[Dict[str, Any]] = []
+        error: Optional[BaseException] = None
+
+        full = self.module.init_full(self.seed)
+        stage_params = self.module.partition(full)
+
+        while True:
+            gang = None
+            try:
+                gang = _Gang(self.module, pcfg, self.opt_kwargs,
+                             stage_params,
+                             resume.path if resume is not None else None,
+                             start_step)
+                self.worker_pids = dict(gang.pids)
+                self._run_steps(gang, data_fn, start_step, num_steps,
+                                history, manager, storage)
+                break
+            except (api.RayTaskError, api.RayActorError,
+                    api.GetTimeoutError, RuntimeError) as e:
+                failures += 1
+                self.restarts += 1
+                resume = manager.latest or resume
+                start_step = (resume.get_metadata().get("step", -1) + 1
+                              if resume is not None else 0)
+                del history[start_step:]
+                logger.warning(
+                    "pipeline gang failed (%s); failures=%d/%s; resume=%s",
+                    e, failures, max_failures, resume)
+                if max_failures >= 0 and failures > max_failures:
+                    error = TrainingFailedError(
+                        f"pipeline training failed after {failures} "
+                        f"attempt(s): {e}")
+                    error.__cause__ = e
+                    break
+            finally:
+                if gang is not None:
+                    gang.shutdown()
+
+        return Result(
+            metrics=history[-1] if history else {},
+            checkpoint=(manager.best
+                        if ckpt_cfg.checkpoint_score_attribute
+                        else manager.latest),
+            error=error,
+            metrics_history=history,
+            path=storage,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _run_steps(self, gang: _Gang, data_fn, start_step: int,
+                   num_steps: int, history: List[Dict[str, Any]],
+                   manager: CheckpointManager, storage: str) -> None:
+        from ..util import tracing
+
+        pcfg = self.pipeline
+        S, R = self.module.num_stages, pcfg.dp
+        n_workers = S * R
+        for step in range(start_step, num_steps):
+            batch = data_fn(step)
+            tok_shards = np.split(np.asarray(batch["tokens"]), R)
+            tgt_shards = np.split(np.asarray(batch["targets"]), R)
+            with tracing.span_if_traced("pipeline.step", {"step": step}):
+                refs = []
+                for (si, r), w in gang.workers.items():
+                    feed: Dict[str, np.ndarray] = {}
+                    if si == 0:
+                        feed["tokens"] = tok_shards[r]
+                    if si == S - 1:
+                        feed["targets"] = tgt_shards[r]
+                    refs.append(w.compute_grads.remote(step, feed))
+                outs = dict(zip(
+                    gang.workers,
+                    api.get(refs, timeout=pcfg.step_timeout_s)))
+                # one canonical summation order (sorted stage-prefixed
+                # paths) so sharded and replicated runs clip identically
+                merged: Dict[str, float] = {}
+                for (si, _r), out in outs.items():
+                    for path, sq in out["sqnorms"].items():
+                        merged[f"s{si}/{path}"] = sq
+                gnorm = math.sqrt(
+                    sum(merged[k] for k in sorted(merged)))
+                api.get([w.apply_update.remote(step, gnorm)
+                         for w in gang.workers.values()],
+                        timeout=pcfg.step_timeout_s)
+
+            wall = max(out["wall_s"] for out in outs.values())
+            busy = sum(out["busy_s"] for out in outs.values())
+            bubble = (max(0.0, min(1.0, 1.0 - busy / (n_workers * wall)))
+                      if wall > 0 else 0.0)
+            _bubble_gauge.set(bubble)
+            last = [out for (si, _r), out in outs.items() if si == S - 1]
+            metrics: Dict[str, Any] = {
+                name: float(np.mean([o["metrics"][name] for o in last]))
+                for name in last[0]["metrics"]
+            }
+            metrics.update(
+                step=step, grad_norm=gnorm, bubble_fraction=bubble,
+                step_seconds=wall)
+            history.append(metrics)
+
+            every = pcfg.checkpoint_every
+            if every and (step + 1) % every == 0:
+                ckpt_dir = os.path.join(storage, f"step_{step:06d}")
+                api.get([w.save_checkpoint.remote(ckpt_dir)
+                         for w in gang.workers.values()],
+                        timeout=pcfg.step_timeout_s)
+                ckpt = Checkpoint(ckpt_dir)
+                ckpt.set_metadata({"step": step})
+                manager.register(ckpt, metrics)
+
+        # expose final params for parity tests / weight export: per-stage
+        # (dp rank 0) plus the full (stage, rank) map
+        keys = list(gang.workers)
+        states = api.get([w.get_params.remote()
+                          for w in gang.workers.values()],
+                         timeout=pcfg.step_timeout_s)
+        self.final_state_all = dict(zip(keys, states))
+        self.final_state = [self.final_state_all[(si, 0)]
+                            for si in range(S)]

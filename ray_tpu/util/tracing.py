@@ -7,8 +7,12 @@ shape here without the OTel dependency (zero-egress image): W3C-style
 ids, a thread-local current span, automatic context injection at
 `.remote()` (api.RemoteFunction / core_worker.submit_actor_task) and
 extraction around user-function execution
-(`node_agent._call_user_function`, `actor_process._child_main`) and
-around each disaggregated-serving leg (`serve/disagg.py`). Spans buffer
+(`node_agent._call_user_function`, `actor_process._child_main`), around
+each disaggregated-serving leg (`serve/disagg.py`), and through the
+pipeline trainer (`train/pipeline.py`): a traced `pipeline.step` fans
+out into per-worker `pipeline.stage_step` spans with nested
+`channel_send`/`channel_recv` spans from `core/channels.py`, so one
+trace shows the whole 1F1B timeline. Spans buffer
 per process; worker processes flush them to the head with their
 heartbeat telemetry (`cross_host.WorkerRuntime`, ingested by
 `control_plane.report_telemetry`), so `get_trace()` at the head sees one
