@@ -24,7 +24,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_sched.py tests/test_dag.py tests/test_collectives.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
 	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py \
-	tests/test_tracing.py tests/test_health.py
+	tests/test_tracing.py tests/test_health.py tests/test_profiler.py
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
@@ -37,9 +37,9 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all chaos health pipeline tsan shm status \
-	bench-data bench-object bench-serve bench-trace bench-health \
-	bench-pipeline
+.PHONY: check check-slow check-all chaos health pipeline profile tsan shm \
+	status bench-data bench-object bench-serve bench-trace bench-health \
+	bench-pipeline bench-profile
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -75,6 +75,12 @@ bench-health:
 # plus the 2-stage bubble fraction, merged into BENCH_SUMMARY.json
 bench-pipeline:
 	env RAY_TPU_BENCH_SUITE=pipeline python bench.py
+
+# sampling-profiler overhead loop: serve burst with the profiler off vs
+# collecting (profiler_overhead_pct, acceptance <= 2%), merged into
+# BENCH_SUMMARY.json
+bench-profile:
+	env RAY_TPU_BENCH_SUITE=profile python bench.py
 
 # cluster health at a glance (alerts, SLO digests, node liveness) from
 # the in-process health plane; DASH=host:port reads a running head
@@ -117,6 +123,13 @@ health:
 pipeline:
 	@echo "== pipeline tier =="
 	$(PYTEST) -m pipeline tests/
+
+# profiling-plane tier (stack dumps, sampling profiles, goodput ledger,
+# hung-worker e2e) for iterating on profiler work; the fast subset also
+# runs inside check via CORE_TESTS
+profile:
+	@echo "== profile tier =="
+	$(PYTEST) -m profile tests/
 
 check-all: check check-slow
 

@@ -57,6 +57,9 @@ def _load_anchor(key: str = "bench_anchor") -> float:
 # {metric: value} map to BENCH_SUMMARY.json so one artifact carries the
 # complete result set (the per-line JSON stream remains the driver wire).
 _SUMMARY: dict = {}
+# metric -> lower_is_better, so the regression report knows which way a
+# delta points for the metrics THIS run produced
+_DIRECTION: dict = {}
 
 
 def _emit(metric: str, value: float, unit: str, anchor_key: str,
@@ -67,6 +70,7 @@ def _emit(metric: str, value: float, unit: str, anchor_key: str,
     else:
         vs = 1.0
     _SUMMARY[metric] = round(value, 4)
+    _DIRECTION[metric] = lower_is_better
     print(json.dumps({
         "metric": metric,
         "value": round(value, 4),
@@ -108,6 +112,56 @@ def _write_summary() -> None:
         f.write("\n")
     print(f"# wrote {path} ({len(_SUMMARY)} new / {len(metrics)} total "
           "metrics)", file=sys.stderr)
+    _append_history(doc)
+
+
+REGRESSION_PCT = 10.0
+
+
+def _append_history(doc: dict) -> None:
+    """Persist the perf trajectory: every run appends its full
+    {meta, metrics} row to the immutable BENCH_HISTORY.jsonl (the mutable
+    BENCH_SUMMARY.json only ever shows the latest state), then prints a
+    regression report — per-metric delta vs the previous row, flagging
+    moves worse than REGRESSION_PCT in the metric's own direction. Only
+    metrics freshly emitted THIS run are compared: rows a partial-suite
+    run merely carried over cannot have regressed."""
+    hist = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_HISTORY.jsonl")
+    prev: dict = {}
+    try:
+        with open(hist) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    prev = json.loads(line).get("metrics", {})
+    except Exception:
+        prev = {}
+    with open(hist, "a") as f:
+        f.write(json.dumps(doc, sort_keys=True) + "\n")
+    print(f"# appended run to {hist}", file=sys.stderr)
+    if not prev:
+        print("# no previous history row — nothing to diff", file=sys.stderr)
+        return
+    flagged = []
+    for metric in sorted(_SUMMARY):
+        cur, old = _SUMMARY[metric], prev.get(metric)
+        if not isinstance(old, (int, float)) or old == 0:
+            continue
+        delta_pct = 100.0 * (cur - old) / abs(old)
+        regressed = (delta_pct > REGRESSION_PCT if _DIRECTION.get(metric)
+                     else delta_pct < -REGRESSION_PCT)
+        mark = "  << REGRESSION" if regressed else ""
+        if regressed:
+            flagged.append(metric)
+        print(f"# {metric}: {old} -> {cur} ({delta_pct:+.1f}%){mark}",
+              file=sys.stderr)
+    if flagged:
+        print(f"# {len(flagged)} metric(s) regressed >{REGRESSION_PCT:.0f}% "
+              f"vs previous run: {', '.join(flagged)}", file=sys.stderr)
+    else:
+        print(f"# no regressions >{REGRESSION_PCT:.0f}% vs previous run",
+              file=sys.stderr)
 
 
 def _serve_burst(engine, prompts, max_tokens):
@@ -442,6 +496,81 @@ def bench_health(model: str) -> None:
           "slo_digest_overhead_anchor", lower_is_better=True)
     _emit("slo_digest_observe_ns", obs_ns, "ns",
           "slo_digest_observe_anchor", lower_is_better=True)
+
+
+def bench_profile(model: str) -> None:
+    """Sampling-profiler overhead gate (ISSUE 9 acceptance: <=2%): the
+    SAME colocated serve burst with the in-process sampling profiler
+    stopped vs collecting at the default hz. Rounds strictly alternate
+    off/on with medians, same discipline as bench_trace/bench_health;
+    the sanity check raises if the "on" rounds collected no samples, so
+    a silently-dead sampler cannot mint a 0%% headline."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+    from ray_tpu.util import profiler
+
+    cfg = get_config(model)
+    msl = min(512, cfg.max_seq_len)
+    prompt_len = min(128, msl // 2)
+    max_tokens = min(64, msl - prompt_len - 8)
+    n_req = 16
+    ecfg = EngineConfig(max_batch_size=16, max_seq_len=msl,
+                        prefill_batch_size=8, busy_span=4,
+                        prefill_buckets=(prompt_len,))
+    engine = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                             ecfg)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+    engine.warmup(buckets=[prompt_len])
+    engine.generate(prompts[0], max_tokens=4)
+
+    total_samples = 0
+
+    def run(on: bool) -> float:
+        nonlocal total_samples
+        if on:
+            profiler.start_profile(duration_s=60.0)
+        try:
+            results, wall = _serve_burst(engine, prompts, max_tokens)
+        finally:
+            if on:
+                total_samples += profiler.fetch_profile(stop=True)["samples"]
+        return sum(len(r["token_ids"]) for r in results) / wall
+
+    run(False)  # throwaway: steady-state
+    rounds = 5
+    samples = {False: [], True: []}
+    for _ in range(rounds):  # strictly alternating
+        for on in (False, True):
+            samples[on].append(run(on))
+    engine.stop()
+    if total_samples <= 0:
+        raise RuntimeError("profiled rounds collected no samples — the "
+                           "sampler is not actually running")
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    tps_off, tps_on = median(samples[False]), median(samples[True])
+    overhead_pct = 100.0 * (tps_off - tps_on) / max(tps_off, 1e-9)
+    mname = model.replace("-", "_")
+    print(
+        f"# profile: model={model} n_req={n_req} prompt={prompt_len} "
+        f"max_tokens={max_tokens} tok/s off={tps_off:.1f} on={tps_on:.1f} "
+        f"profiler_samples={total_samples}",
+        file=sys.stderr,
+    )
+    _emit(f"serve_unprofiled_tok_per_s_{mname}", tps_off, "tokens/s",
+          "serve_profile_off_anchor")
+    _emit(f"serve_profiled_tok_per_s_{mname}", tps_on, "tokens/s",
+          "serve_profile_on_anchor")
+    _emit("profiler_overhead_pct", overhead_pct, "%",
+          "profiler_overhead_anchor", lower_is_better=True)
 
 
 def _bench_serve_spec(cfg, mname: str, rng, n_req: int) -> None:
@@ -1011,6 +1140,10 @@ def main() -> None:
         # SLO-digest overhead: digests-on vs -off serve burst. Latency-
         # sensitive like trace — runs before the throughput suites.
         bench_health(model)
+    if "profile" in wanted:
+        # sampling-profiler overhead: profiled vs unprofiled serve burst.
+        # Latency-sensitive like trace/health — before the throughput block.
+        bench_profile(model)
     if "grpo" in wanted:
         # rollout generate pays per-TOKEN dispatches — as latency-bound
         # as serve TTFT, and equally poisoned by the HBM churn the train/

@@ -106,6 +106,29 @@ def status(address: str = "", as_dict: bool = False):
             d = digests[label]
             lines.append(f"  {label}: p50={_ms(d.get('p50'))} "
                          f"p95={_ms(d.get('p95'))} n={d.get('count', 0)}")
+    utilization = payload.get("utilization", {})
+    if utilization:
+        lines.append("utilization:")
+        for key in sorted(utilization):
+            row = utilization[key]
+            parts = []
+            if row.get("cpu_fraction") is not None:
+                parts.append(f"cpu={row['cpu_fraction'] * 100:.0f}%")
+            if row.get("rss_bytes") is not None:
+                parts.append(f"rss={row['rss_bytes'] / 1e6:.0f}MB")
+            if row.get("memory_fraction") is not None:
+                parts.append(f"mem={row['memory_fraction'] * 100:.0f}%")
+            lines.append(f"  {key}: " + " ".join(parts))
+    goodput = payload.get("goodput", {})
+    if goodput and goodput.get("wall_seconds"):
+        lines.append(
+            f"goodput: {goodput.get('goodput_fraction', 0.0) * 100:.1f}% "
+            f"of {goodput.get('wall_seconds', 0.0):.1f}s wall")
+        for part in ("compute", "data_stall", "channel_wait", "bubble",
+                     "migration"):
+            v = goodput.get(part)
+            if v:
+                lines.append(f"  {part}: {v:.2f}s")
     scores = payload.get("scores", {})
     degraded = {k: v for k, v in scores.items() if v < 1.0}
     if degraded:

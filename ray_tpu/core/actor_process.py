@@ -71,6 +71,15 @@ def _child_main(req_q, resp_q, log_dir: str = "") -> None:
         flight_recorder.attach(log_dir, "actor")
     except Exception:  # noqa: BLE001 — observability must not block startup
         pass
+    try:
+        # profiling plane: SIGUSR2 → all-threads stack dump (works even when
+        # every serve thread is wedged — faulthandler is C, no GIL needed),
+        # SIGUSR1 → toggle the sampling profiler (util/profiler)
+        from ..util import profiler
+
+        profiler.install_child_handlers(log_dir)
+    except Exception:  # noqa: BLE001 — observability must not block startup
+        pass
 
     kind, payload = req_q.get()
     if kind != "init":

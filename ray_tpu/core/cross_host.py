@@ -349,6 +349,39 @@ class HeadService:
             for n in self._runtime.directory.locations(ObjectID.from_hex(oid_hex))
         ]
 
+    # -- profiling plane (rpc allowlist: profile_start / profile_fetch) -----
+    def _profile_agent(self, node: str):
+        """Resolve a node-id hex (any unambiguous prefix; "" = the head's
+        own driver node) to the agent holding the profiling duck — a local
+        NodeAgent or a RemoteNodeAgent proxying a joined host."""
+        rt = self._runtime
+        if not node:
+            return rt.driver_agent
+        with rt._lock:
+            agents = dict(rt.agents)
+        matches = [(nid, a) for nid, a in agents.items()
+                   if nid.hex().startswith(node)]
+        if len(matches) == 1:
+            return matches[0][1]
+        known = sorted(nid.hex()[:12] for nid in agents)
+        if not matches:
+            raise KeyError(f"no node matches {node!r} (known: {known})")
+        raise KeyError(f"node prefix {node!r} is ambiguous (known: {known})")
+
+    def profile_start(self, node: str = "", pid: int = 0,
+                      duration_s: float = 5.0, hz=None, kind: str = "cpu",
+                      logdir: str = "") -> Dict[str, Any]:
+        out = dict(self._profile_agent(node).profile_start(
+            pid=pid, duration_s=duration_s, hz=hz, kind=kind, logdir=logdir))
+        out.setdefault("node", node)
+        return out
+
+    def profile_fetch(self, node: str = "", pid: int = 0,
+                      kind: str = "cpu") -> Dict[str, Any]:
+        out = dict(self._profile_agent(node).profile_fetch(pid=pid, kind=kind))
+        out.setdefault("node", node)
+        return out
+
 
 class _AgentStoreAdapter:
     """Serves EVERY local agent's store through one transfer server, so a
@@ -712,6 +745,21 @@ class RemoteNodeAgent:
         except (WorkerCrashedError, RuntimeError):
             pass
 
+    # -- profiling plane (util/profiler via node_agent) ---------------------
+    def profilable_pids(self) -> Dict[str, Any]:
+        return dict(self._call("profilable_pids", timeout=10.0))
+
+    def profile_start(self, pid: int = 0, duration_s: float = 5.0,
+                      hz: Optional[float] = None, kind: str = "cpu",
+                      logdir: str = "") -> Dict[str, Any]:
+        return dict(self._call(
+            "profile_start", timeout=15.0, pid=int(pid),
+            duration_s=float(duration_s), hz=hz, kind=kind, logdir=logdir))
+
+    def profile_fetch(self, pid: int = 0, kind: str = "cpu") -> Dict[str, Any]:
+        return dict(self._call("profile_fetch", timeout=15.0, pid=int(pid),
+                               kind=kind))
+
     def _sync_load(self) -> None:
         """No-op: the worker host heartbeats the control plane itself."""
 
@@ -1028,6 +1076,27 @@ class _WorkerDispatchHandler(socketserver.BaseRequestHandler):
         elif method == "kill_running_tasks":
             agent.kill_running_tasks()
             reply({"id": req_id, "ok": True, "value": True})
+        elif method == "profilable_pids":
+            reply({"id": req_id, "ok": True, "value": agent.profilable_pids()})
+        elif method == "profile_start":
+            reply({"id": req_id, "ok": True, "value": agent.profile_start(
+                pid=req.get("pid", 0),
+                duration_s=req.get("duration_s", 5.0),
+                hz=req.get("hz"), kind=req.get("kind", "cpu"),
+                logdir=req.get("logdir", ""))})
+        elif method == "profile_fetch":
+            # dump_child blocks on the signalled child writing its file:
+            # off the read loop so a slow dump can't stall other dispatches
+            def _fetch():
+                try:
+                    value = agent.profile_fetch(
+                        pid=req.get("pid", 0), kind=req.get("kind", "cpu"))
+                    reply({"id": req_id, "ok": True, "value": value})
+                except Exception as e:  # noqa: BLE001 — serialized to caller
+                    reply({"id": req_id, "ok": False, "error": repr(e)})
+
+            threading.Thread(target=_fetch, daemon=True,
+                             name="dispatch-profile-fetch").start()
         elif method == "ping":
             reply({"id": req_id, "ok": True, "value": True})
         elif method == "stop":
@@ -1243,9 +1312,16 @@ class WorkerRuntime:
         now = time.monotonic()
         if now - self._last_telemetry < float(config.telemetry_report_period_s):
             return
-        from ..util import flight_recorder, slo, timeline, tracing
+        from ..util import flight_recorder, profiler, slo, timeline, tracing
         from .metrics import registry as metrics_registry
 
+        try:
+            # refresh host CPU / RSS / device-memory gauges so every
+            # telemetry flush federates them (no new protocol fields:
+            # they ride the metrics snapshot like any other gauge)
+            profiler.update_resource_gauges()
+        except Exception:  # noqa: BLE001 — accounting must not block the beat
+            pass
         span_cur, spans = tracing.drain_since(self._telemetry_span_cursor)
         event_cur, events = timeline.drain_since(self._telemetry_event_cursor)
         metrics = metrics_registry.snapshot()

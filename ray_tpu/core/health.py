@@ -533,13 +533,64 @@ class HealthPlane:
                     })
             except Exception:
                 pass
+        utilization, goodput = self._profiling_sections(cp)
         return {
             "generated_at": time.time(),
             "nodes": nodes,
             "alerts": self.active(),
             "digests": digests,
             "scores": self.scores(),
+            "utilization": utilization,
+            "goodput": goodput,
         }
+
+    _UTIL_GAUGES = {"host_cpu_used_fraction": "cpu_fraction",
+                    "process_rss_bytes": "rss_bytes",
+                    "host_memory_used_fraction": "memory_fraction"}
+
+    def _profiling_sections(self, cp) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Per-node CPU/RSS/memory gauges + the goodput ledger, both from
+        the same federated family snapshots the rule engine reads
+        (util/profiler sets the gauges; telemetry flushes federate them)."""
+        utilization: Dict[str, Dict[str, float]] = {}
+        goodput: Dict[str, Any] = {}
+        try:
+            from ..util import profiler
+            from .metrics import registry
+
+            try:
+                # worker runtimes refresh on telemetry flushes; the head
+                # has no flush loop, so its own row refreshes here
+                profiler.update_resource_gauges()
+            except Exception:
+                pass
+            sources: List[Tuple[str, List]] = [("head", registry.snapshot())]
+            if cp is not None:
+                try:
+                    for node_hex, rec in cp.telemetry_snapshots().items():
+                        sources.append((node_hex[:12],
+                                        rec.get("metrics") or []))
+                except Exception:
+                    pass
+            for key, fams in sources:
+                row: Dict[str, float] = {}
+                for fam in fams:
+                    out_key = self._UTIL_GAUGES.get(fam.get("name", ""))
+                    if not out_key:
+                        continue
+                    vals = [float(v) for _s, _t, v in fam.get("samples", [])]
+                    if vals:
+                        # fractions are host-wide (any sample is the
+                        # host's value); byte gauges sum across processes
+                        row[out_key] = (max(vals) if "fraction" in out_key
+                                        else sum(vals))
+                if row:
+                    utilization[key] = row
+            goodput = profiler.ledger_from_samples(
+                [f for _k, fams in sources for f in fams])
+        except Exception:  # noqa: BLE001 — payload must render regardless
+            pass
+        return utilization, goodput
 
 
 # -- client-side routing health --------------------------------------------
@@ -684,6 +735,14 @@ def get_health_plane(create: bool = True) -> Optional[HealthPlane]:
             if _plane is None:
                 _plane = HealthPlane()
                 _plane.start()
+                try:
+                    # loop closure (profiling plane): sustained stall /
+                    # heartbeat-gap alerts auto-capture a stack dump into
+                    # the flight recorder + postmortem stream
+                    from ..util import profiler
+                    profiler.install_auto_dump(_plane)
+                except Exception:  # noqa: BLE001 — optional plane
+                    pass
     return _plane
 
 

@@ -934,6 +934,112 @@ class NodeAgent:
         for e in events:
             e.set()
 
+    # ------------------------------------------------------ profiling plane
+    # The node-local half of profile_start/profile_fetch: the head (via
+    # cross_host.HeadService) resolves a node and calls these — locally on
+    # its own agent, over the dispatch socket for joined hosts. pid 0 (or
+    # this process's pid) targets the agent process itself, where threaded
+    # tasks and device actors run; a subprocess child (actor process /
+    # pool worker, see profilable_pids) is driven by the signal handlers
+    # util/profiler.install_child_handlers registered at its startup — so
+    # a HUNG child can still be stack-dumped (faulthandler needs no GIL).
+
+    def _session(self) -> str:
+        from .logging import session_dir
+
+        return session_dir()
+
+    def profilable_pids(self) -> Dict[str, Any]:
+        """Every pid profiling can target on this node: the agent process
+        plus live subprocess actor/pool workers."""
+        import os
+
+        actors: Dict[str, int] = {}
+        with self._lock:
+            runners = list(self._actors.items())
+        for actor_id, runner in runners:
+            proc = getattr(runner, "process", None)
+            pid = getattr(proc, "pid", None) if proc is not None else None
+            if pid:
+                actors[actor_id.hex()] = int(pid)
+        pool_pids: List[int] = []
+        with self._pool_lock:
+            pool = self._pool
+        if pool:
+            try:
+                pool_pids = pool.worker_pids()
+            except Exception:
+                pool_pids = []
+        return {"agent": os.getpid(), "actors": actors, "pool": pool_pids}
+
+    def profile_start(self, pid: int = 0, duration_s: float = 5.0,
+                      hz: Optional[float] = None, kind: str = "cpu",
+                      logdir: str = "") -> Dict[str, Any]:
+        """Open a profiling window. kind="cpu" starts the sampling
+        profiler (in-process, or SIGUSR1-toggled in a child); kind="jax"
+        captures an xplane device trace into `logdir` for `duration_s`."""
+        import os
+
+        from ..util import profiler
+
+        pid = int(pid or 0)
+        if kind == "jax":
+            logdir = logdir or os.path.join(self._session(), "jax_trace")
+            self._start_jax_trace(logdir, float(duration_s or 5.0))
+            return {"pid": os.getpid(), "kind": "jax", "logdir": logdir}
+        if pid in (0, os.getpid()):
+            out = profiler.start_profile(duration_s=duration_s, hz=hz)
+            return {**out, "kind": "cpu"}
+        profiler.toggle_child_profile(pid)
+        return {"pid": pid, "kind": "cpu", "running": True}
+
+    def profile_fetch(self, pid: int = 0, kind: str = "cpu") -> Dict[str, Any]:
+        """Collect: kind="stack" returns a live all-threads dump (works
+        on a hung child via the faulthandler signal); kind="cpu" stops
+        the sampling window and returns the collapsed-stack profile."""
+        import os
+
+        from ..util import profiler
+
+        pid = int(pid or 0)
+        if kind == "pids":
+            return self.profilable_pids()
+        if kind == "stack":
+            if pid in (0, os.getpid()):
+                dump = profiler.dump_stacks()
+                return {"pid": os.getpid(), "kind": "stack",
+                        "threads": len(dump["threads"]),
+                        "text": profiler.format_stacks(dump), "dump": dump}
+            text = profiler.dump_child(pid, self._session())
+            return {"pid": pid, "kind": "stack", "text": text}
+        if pid in (0, os.getpid()):
+            out = profiler.fetch_profile()
+            return {"pid": out["pid"], "kind": "cpu",
+                    "samples": out["samples"], "collapsed": out["collapsed"]}
+        text = profiler.read_child_profile(pid, self._session())
+        return {"pid": pid, "kind": "cpu", "collapsed": text}
+
+    def _start_jax_trace(self, logdir: str, duration_s: float) -> None:
+        """On-demand xplane capture on this node, bounded and one at a
+        time (XLA's profiler cannot nest)."""
+        if getattr(self, "_jax_trace_active", False):
+            raise RuntimeError("a jax trace capture is already running")
+        self._jax_trace_active = True
+
+        def _capture():
+            try:
+                from ..util import timeline
+
+                with timeline.trace_jax(logdir):
+                    self._stopped.wait(max(0.1, duration_s))
+            except Exception as e:
+                logger.warning("jax trace capture failed: %r", e)
+            finally:
+                self._jax_trace_active = False
+
+        threading.Thread(target=_capture, daemon=True,
+                         name="jax-trace-capture").start()
+
     def stop(self, notify: bool = True) -> None:
         # notify is part of the RemoteNodeAgent duck surface (suppresses
         # the remote stop frame); a local agent has no one to notify

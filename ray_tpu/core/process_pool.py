@@ -285,6 +285,15 @@ def _worker_main(store_name: str, req_q, resp_q, log_dir: str = "") -> None:
         flight_recorder.attach(log_dir, "worker")
     except Exception:  # noqa: BLE001 — observability must not block startup
         pass
+    try:
+        # profiling plane: SIGUSR2 → all-threads stack dump (faulthandler —
+        # fires even when this loop is wedged in user code), SIGUSR1 →
+        # toggle the sampling profiler (util/profiler)
+        from ..util import profiler
+
+        profiler.install_child_handlers(log_dir)
+    except Exception:  # noqa: BLE001 — observability must not block startup
+        pass
     store = ShmObjectStore(store_name, create=False)
     while True:
         item = req_q.get()
@@ -341,6 +350,7 @@ class ProcessPool:
         self._submit_lock = threading.Lock()
         self._inflight: dict = {}  # lane index -> (worker pid, start time)
         self._inflight_lock = threading.Lock()
+        self._lane_pids: dict = {}  # lane index -> last spawned worker pid
         self._mem_monitor = None
         self._threads: List[threading.Thread] = []
         for i in range(self.num_workers):
@@ -465,6 +475,21 @@ class ProcessPool:
             proc.start()
         return _Worker(proc, req_q, resp_q)
 
+    def worker_pids(self) -> List[int]:
+        """Pids of the pool's live worker processes (profiling plane:
+        node_agent.profilable_pids). Dead lanes' stale pids are filtered
+        with a 0-signal probe."""
+        with self._inflight_lock:
+            pids = list(self._lane_pids.values())
+        alive = []
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+                alive.append(pid)
+            except OSError:
+                pass
+        return alive
+
     def _lane(self, index: int) -> None:
         """One parent thread drives one worker process: ship task, await
         response or death. Worker death fails only the in-flight task."""
@@ -476,6 +501,9 @@ class ProcessPool:
             worker = self._spawn()
         except Exception:  # noqa: BLE001 — retried lazily per task below
             worker = None
+        if worker is not None:
+            with self._inflight_lock:
+                self._lane_pids[index] = worker.proc.pid
         while not self._closed.is_set():
             item = self._tasks.get()
             if item is None:
@@ -483,6 +511,8 @@ class ProcessPool:
             fn, args, kwargs, complete, sealed, renv = item
             if worker is None or not worker.proc.is_alive():
                 worker = self._spawn()
+                with self._inflight_lock:
+                    self._lane_pids[index] = worker.proc.pid
             tag = uuid.uuid4().hex
             try:
                 payload, buffer_ids, inline = _dump(

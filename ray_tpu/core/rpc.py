@@ -53,6 +53,9 @@ _ALLOWED_METHODS: Set[str] = {
     "register_node", "mark_node_dead", "heartbeat", "alive_nodes",
     "get_node", "all_nodes",
     "report_telemetry", "telemetry_snapshots", "postmortems",
+    # profiling plane (util/profiler.py via cross_host.HeadService):
+    # stack dumps / sampling profiles / xplane captures on any node
+    "profile_start", "profile_fetch",
     "register_actor", "update_actor", "get_actor", "get_named_actor",
     "list_actors",
     "register_job", "finish_job", "list_jobs",
@@ -77,6 +80,9 @@ _IDEMPOTENT_METHODS: Set[str] = {
     # telemetry: metrics replace the prior snapshot, spans dedupe by id,
     # timeline events are cursor-guarded — a resend is absorbed
     "report_telemetry", "telemetry_snapshots", "postmortems",
+    # profile_start is a no-op while a window is already open and
+    # profile_fetch re-reads the same accumulation — resends absorb
+    "profile_start", "profile_fetch",
     "get_actor", "get_named_actor", "list_actors", "list_jobs",
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "dir_add_location", "dir_remove_location", "dir_locations",

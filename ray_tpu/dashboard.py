@@ -61,6 +61,10 @@ def _dashboard(uid: str, title: str, panels: List[Dict[str, Any]]) -> Dict[str, 
 
 def build_dashboards() -> Dict[str, Dict[str, Any]]:
     """name -> Grafana dashboard JSON, from this repo's metric names."""
+    # the profiling board's gauges register on import (util/profiler is
+    # pure stdlib); without this a dashboard export from a process that
+    # never profiled would reference unregistered series
+    from .util import profiler  # noqa: F401
     core = _dashboard("raytpu-core", "ray_tpu / core", [
         _panel("Tasks finished (rate)", "rate(ray_tpu_tasks_finished[1m])",
                0, 0, legend="{{outcome}}"),
@@ -142,8 +146,34 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "p50 {{metric}} {{role}}",
         "refId": "B",
     })
+    profiling = _dashboard("raytpu-profiling", "ray_tpu / profiling & goodput", [
+        _panel("Goodput: data stall (rate)",
+               "rate(data_stage_stall_seconds_sum[5m])", 0, 0, unit="s",
+               legend="stall {{stage}}"),
+        _panel("Goodput: channel wait / migration (rate)",
+               "rate(channel_recv_wait_seconds_sum[5m])", 1, 0, unit="s",
+               legend="channel {{channel}}"),
+        _panel("Host CPU used fraction", "host_cpu_used_fraction", 2, 8,
+               unit="percentunit", legend="{{node_id}}"),
+        _panel("Process RSS", "process_rss_bytes", 3, 8, unit="bytes",
+               legend="{{node_id}} {{role}}"),
+        _panel("Device memory in use (HBM)", "device_memory_bytes_in_use",
+               4, 16, unit="bytes", legend="{{node_id}} {{device}}"),
+        _panel("Sampling profilers active", "profiler_sampling_active",
+               5, 16, legend="{{node_id}}"),
+    ])
+    profiling["panels"][1]["targets"].append({
+        "expr": "rate(serve_kv_migration_seconds_sum[5m])",
+        "legendFormat": "migration {{transport}}",
+        "refId": "B",
+    })
+    profiling["panels"][0]["targets"].append({
+        "expr": "train_pipeline_bubble_fraction",
+        "legendFormat": "bubble {{stage}}",
+        "refId": "B",
+    })
     return {"core": core, "serve": serve, "data": data, "disagg": disagg,
-            "health": health}
+            "health": health, "profiling": profiling}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:
@@ -199,6 +229,44 @@ def _render_metrics() -> str:
     if not snaps:
         return metrics_registry.render_prometheus()
     return render_merged(metrics_registry, snaps)
+
+
+def _profile_payload(rest: str, query: Dict[str, List[str]]) -> Dict[str, Any]:
+    """/api/v0/profile/<node>[/<pid>] → the profiling-plane RPCs.
+
+    kind=stack (default) returns a live all-threads dump — for a
+    subprocess worker this works even when it is HUNG (SIGUSR2 →
+    faulthandler). kind=cpu runs a one-shot sampling window of
+    ?duration= seconds and returns the collapsed-stack profile;
+    kind=jax starts an xplane capture; kind=pids (or no pid segment)
+    lists what the node can profile."""
+    import time as _time
+
+    from .core import core_worker
+    from .core.cross_host import HeadService
+
+    svc = HeadService(core_worker.get_runtime())
+    parts = [p for p in rest.split("/") if p]
+    node = parts[0] if parts else ""
+    if node in ("head", "local", "-"):
+        node = ""
+    pid = int(parts[1]) if len(parts) > 1 else 0
+    kind = (query.get("kind") or [""])[0]
+    if len(parts) < 2 and kind in ("", "pids"):
+        return svc.profile_fetch(node=node, kind="pids")
+    kind = kind or "stack"
+    if kind == "jax":
+        duration = float((query.get("duration") or ["5"])[0])
+        return svc.profile_start(node=node, pid=pid, duration_s=duration,
+                                 kind="jax")
+    if kind == "cpu":
+        duration = float((query.get("duration") or ["2"])[0])
+        hz = query.get("hz")
+        svc.profile_start(node=node, pid=pid, duration_s=duration,
+                          hz=float(hz[0]) if hz else None, kind="cpu")
+        _time.sleep(min(duration, 60.0))
+        return svc.profile_fetch(node=node, pid=pid, kind="cpu")
+    return svc.profile_fetch(node=node, pid=pid, kind=kind)
 
 
 def _trace_payload(trace_id: str) -> Dict[str, Any]:
@@ -371,6 +439,18 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                                             "history": plane.history()})
                 if self.path.rstrip("/") == "/api/v0/postmortems":
                     return self._json(200, _postmortems_payload())
+                # profiling plane: /api/v0/profile/<node>/<pid>?kind=...
+                # (node "head"/"-" = the head's own driver node, pid 0 =
+                # the node's agent process) — must precede the state route
+                if (self.path.startswith("/api/v0/profile/")
+                        or self.path.split("?")[0].rstrip("/")
+                        == "/api/v0/profile"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    parsed = urlparse(self.path)
+                    rest = parsed.path[len("/api/v0/profile"):].strip("/")
+                    return self._json(
+                        200, _profile_payload(rest, parse_qs(parsed.query)))
                 # job REST surface (reference: dashboard job module,
                 # `dashboard/modules/job/job_head.py` HTTP routes)
                 if self.path.startswith("/api/jobs/"):

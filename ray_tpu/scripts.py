@@ -398,6 +398,62 @@ def cmd_health(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Stack-dump / CPU-profile any process in the cluster (profiling
+    plane, util/profiler.py). `--address` reads a running head's
+    dashboard over HTTP; without it the in-process runtime is used."""
+    node = args.node or ""
+    if node in ("head", "local", "-"):
+        node = ""
+    pid = int(args.pid or 0)
+    duration = args.duration
+    if args.address:
+        from urllib.request import urlopen
+
+        url = args.address if "://" in args.address else f"http://{args.address}"
+        path = f"{url.rstrip('/')}/api/v0/profile/{node or 'head'}"
+        if pid:
+            path += f"/{pid}"
+        q = [f"kind={args.kind}"]
+        if duration is not None:
+            q.append(f"duration={duration}")
+        if args.hz is not None:
+            q.append(f"hz={args.hz}")
+        path += "?" + "&".join(q)
+        with urlopen(path, timeout=(duration or 5.0) + 30.0) as r:
+            out = json.loads(r.read().decode())
+    else:
+        import time as _time
+
+        from . import api
+        from .core import core_worker
+        from .core.cross_host import HeadService
+
+        api._auto_init()
+        svc = HeadService(core_worker.get_runtime())
+        if args.kind == "jax":
+            out = svc.profile_start(node=node, pid=pid,
+                                    duration_s=duration or 5.0, kind="jax")
+        elif args.kind == "cpu":
+            svc.profile_start(node=node, pid=pid, duration_s=duration or 2.0,
+                              hz=args.hz, kind="cpu")
+            _time.sleep(min(duration or 2.0, 60.0))
+            out = svc.profile_fetch(node=node, pid=pid, kind="cpu")
+        else:
+            out = svc.profile_fetch(node=node, pid=pid, kind=args.kind)
+    if isinstance(out.get("text"), str):
+        print(out["text"])
+    elif isinstance(out.get("collapsed"), dict):
+        for stack, count in sorted(out["collapsed"].items(),
+                                   key=lambda kv: (-kv[1], kv[0])):
+            print(f"{stack} {count}")
+    elif isinstance(out.get("collapsed"), str):
+        print(out["collapsed"])
+    else:
+        print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -446,6 +502,24 @@ def main(argv=None) -> int:
                     help="dashboard host:port of a running head (default: "
                     "in-process health plane)")
     ph.set_defaults(fn=cmd_health)
+
+    ppf = sub.add_parser("profile", help="profiling plane: stack-dump or "
+                         "CPU-profile any worker (util/profiler.py)")
+    ppf.add_argument("node", nargs="?", default="",
+                     help="node id hex prefix ('' / 'head' = the head node)")
+    ppf.add_argument("pid", nargs="?", type=int, default=0,
+                     help="target pid (0 = the node's agent process; "
+                     "--kind pids lists what a node can profile)")
+    ppf.add_argument("--kind", choices=["stack", "cpu", "jax", "pids"],
+                     default="stack")
+    ppf.add_argument("--duration", type=float, default=None,
+                     help="sampling window seconds (cpu/jax kinds)")
+    ppf.add_argument("--hz", type=float, default=None,
+                     help="cpu sampling rate (default config profiler_sample_hz)")
+    ppf.add_argument("--address", default="",
+                     help="dashboard host:port of a running head (default: "
+                     "in-process runtime)")
+    ppf.set_defaults(fn=cmd_profile)
 
     pmem = sub.add_parser("memory", help="object-plane sizes and totals")
     pmem.add_argument("--limit", type=int, default=100)

@@ -34,7 +34,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 __all__ = [
-    "record", "attach", "snapshot", "write_postmortem",
+    "record", "attach", "snapshot", "write_postmortem", "write_auto_dump",
     "drain_postmortems", "requeue_postmortems", "list_postmortems",
     "load_postmortem", "mirror_path_for",
 ]
@@ -188,6 +188,16 @@ def write_postmortem(pid: int, cause: str, exitcode: Optional[int] = None,
         if os.path.exists(out):
             stdout_tail = _tail_lines(out)
             break
+    # final stack dump: util/profiler registers faulthandler in worker
+    # children (fatal-signal dumps + SIGUSR2 on demand), appending to
+    # <session>/flight/stack-<pid>.txt — whatever it last wrote is the
+    # dead worker's final all-threads traceback
+    stack_dump: List[str] = []
+    try:
+        from . import profiler
+        stack_dump = _tail_lines(profiler.stack_path_for(pid, session), n=120)
+    except Exception:  # noqa: BLE001 — reaping must not fail on the extras
+        pass
     art = {
         "pid": pid,
         "cause": cause,
@@ -197,6 +207,7 @@ def write_postmortem(pid: int, cause: str, exitcode: Optional[int] = None,
         "logs": [e.get("line", "") for e in entries if e.get("kind") == "log"],
         "events": [e for e in entries if e.get("kind") not in ("span", "log")],
         "stdout_tail": stdout_tail,
+        "stack_dump": stack_dump,
     }
     pm_dir = os.path.join(session, "postmortems")
     path = os.path.join(pm_dir, f"postmortem-{pid}-{int(art['written_at'])}.json")
@@ -215,6 +226,40 @@ def write_postmortem(pid: int, cause: str, exitcode: Optional[int] = None,
                         args={"pid": pid, "exitcode": exitcode, "path": path})
     except Exception:
         pass
+    return path or None
+
+
+def write_auto_dump(alert: Dict[str, Any], stack_text: str,
+                    session: Optional[str] = None) -> Optional[str]:
+    """Persist a health-alert-triggered stack dump of THIS process as a
+    postmortem-stream artifact (util/profiler.install_auto_dump is the
+    caller). Unlike write_postmortem the process is alive — no reap dedup;
+    the artifact rides the same `_pending` queue so it federates to the
+    head and shows at /api/v0/postmortems like any crash record."""
+    if session is None:
+        from ..core.logging import session_dir
+        session = session_dir()
+    pid = os.getpid()
+    art = {
+        "pid": pid,
+        "cause": f"auto_dump:{alert.get('rule', 'alert')}",
+        "exitcode": None,
+        "written_at": time.time(),
+        "alert": {k: alert.get(k) for k in ("rule", "state", "labels",
+                                            "value", "node")},
+        "stack_dump": (stack_text or "").splitlines()[-200:],
+    }
+    pm_dir = os.path.join(session, "postmortems")
+    path = os.path.join(pm_dir, f"autodump-{pid}-{int(art['written_at'])}.json")
+    try:
+        os.makedirs(pm_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(art, f, default=repr)
+    except OSError:
+        path = ""
+    with _lock:
+        _pending.append(art)
+        del _pending[:-20]
     return path or None
 
 

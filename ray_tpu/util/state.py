@@ -83,12 +83,32 @@ def summary() -> Dict[str, Any]:
     by_state: Dict[str, int] = {}
     for a in actors:
         by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    utilization: Dict[str, Dict[str, float]] = {}
+    try:
+        # per-node CPU/RSS next to the memory fraction (profiling plane):
+        # same source the health payload reads, so /api/v0/summary and
+        # /api/v0/health agree
+        from ..core.health import get_health_plane
+
+        plane = get_health_plane(create=False)
+        if plane is not None:
+            utilization, _ = plane._profiling_sections(plane._cp())
+        else:
+            from . import profiler
+            row = profiler.update_resource_gauges()
+            utilization = {"head": {
+                "cpu_fraction": row.get("host_cpu_used_fraction", 0.0),
+                "rss_bytes": row.get("process_rss_bytes", 0.0),
+            }}
+    except Exception:  # noqa: BLE001 — summary must render regardless
+        pass
     return {
         "nodes_alive": len(rt.control_plane.alive_nodes()),
         "nodes_total": len(rt.control_plane.all_nodes()),
         "actors_by_state": by_state,
         "cluster_resources": api.cluster_resources(),
         "available_resources": api.available_resources(),
+        "utilization": utilization,
     }
 
 

@@ -171,11 +171,15 @@ class TestMetricsFederation:
 
 class TestGrafana:
     def test_dashboards_reference_real_metrics(self):
+        import ray_tpu.core.channels  # noqa: F401 — registers channel metrics
         import ray_tpu.core.cross_host  # noqa: F401 — registers metrics
         import ray_tpu.core.memory_monitor  # noqa: F401 — registers metrics
         import ray_tpu.core.object_transfer  # noqa: F401 — registers metrics
+        import ray_tpu.data.executor  # noqa: F401 — registers data metrics
         import ray_tpu.serve.disagg  # noqa: F401 — registers disagg metrics
         import ray_tpu.serve.engine  # noqa: F401 — registers serve metrics
+        import ray_tpu.train.pipeline  # noqa: F401 — registers pipeline metrics
+        import ray_tpu.util.profiler  # noqa: F401 — registers profiler gauges
         from ray_tpu.core.metrics import registry
 
         known = set(registry._metrics)
@@ -191,7 +195,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 5  # core, data, serve, disagg, health
+        assert len(jsons) == 6  # core, data, serve, disagg, health, profiling
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p
