@@ -24,7 +24,8 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_sched.py tests/test_dag.py tests/test_collectives.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
 	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py \
-	tests/test_tracing.py tests/test_health.py tests/test_profiler.py
+	tests/test_tracing.py tests/test_health.py tests/test_profiler.py \
+	tests/test_object_ledger.py
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
@@ -37,7 +38,8 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
-.PHONY: check check-slow check-all chaos health pipeline profile tsan shm \
+.PHONY: check check-slow check-all chaos health pipeline profile memory \
+	tsan shm \
 	status bench-data bench-object bench-serve bench-trace bench-health \
 	bench-pipeline bench-profile
 
@@ -130,6 +132,13 @@ pipeline:
 profile:
 	@echo "== profile tier =="
 	$(PYTEST) -m profile tests/
+
+# object-plane tier (ledger metadata, flow accounting, leak sweep,
+# dead-node locate) for iterating on object observability work; also
+# runs inside check via CORE_TESTS
+memory:
+	@echo "== object plane tier =="
+	$(PYTEST) -m objects tests/
 
 check-all: check check-slow
 

@@ -697,12 +697,15 @@ def bench_objects() -> None:
     from ray_tpu.core.control_plane import ControlPlane
     from ray_tpu.core.ids import ObjectID, TaskID
     from ray_tpu.core.object_store import MemoryObjectStore
+    from ray_tpu.core import object_ledger
+    from ray_tpu.core.config import config as _config
     from ray_tpu.core.object_transfer import (
         KV_PREFIX,
         ObjectTransferClient,
         ObjectTransferServer,
         _cache_hits,
         _cache_misses,
+        _pulled_bytes,
         pull_from_any,
     )
 
@@ -724,9 +727,15 @@ def bench_objects() -> None:
         store = MemoryObjectStore(capacity_bytes=4 * nbytes)
         server = ObjectTransferServer(store)
         server.start_load_gossip(cp, f"puller{i}")
-        pullers.append((store, server, ObjectTransferClient()))
+        client = ObjectTransferClient()
+        # distinct dst labels so the flow matrix's per-edge sums can be
+        # reconciled against object_pull_bytes for THESE pulls alone
+        client.local_node = f"bp{i:03d}"
+        pullers.append((store, server, client))
+    dst_labels = {f"bp{i:03d}" for i in range(n_pullers)}
 
     hits0, misses0 = _cache_hits.get(), _cache_misses.get()
+    pulled0 = _pulled_bytes.get()
 
     def cached_get(i: int) -> None:
         """The worker-side get path: local replica first, else pull from
@@ -779,6 +788,56 @@ def bench_objects() -> None:
               "object_broadcast_anchor")
         _emit("object_cache_hit_rate", hit_rate, "ratio",
               "object_cache_hit_anchor")
+
+        # flow-accounting conservation: record_flow sits at the same
+        # sites as object_pull_bytes, so the per-edge sums for our dst
+        # labels must reconcile with the pull-byte delta (<=1% bar)
+        pulled_delta = _pulled_bytes.get() - pulled0
+        flows = object_ledger.collect_flows()
+        flow_sum = sum(e["bytes"] for e in flows["edges"]
+                       if e["dst"] in dst_labels)
+        cons_err_pct = (abs(flow_sum - pulled_delta)
+                        / max(pulled_delta, 1) * 100.0)
+        print(f"# objects: flow_sum={flow_sum:.0f}B "
+              f"pull_bytes={pulled_delta}B err={cons_err_pct:.3f}%",
+              file=sys.stderr)
+        _emit("object_flow_conservation_err_pct", cons_err_pct, "%",
+              "object_flow_conservation_anchor", lower_is_better=True)
+
+        # ledger overhead: alternating on/off cold pulls of the same
+        # object over the wire (the per-chunk record_flow hot path),
+        # medians compared — the ledger must cost <=2%
+        probe_client = pullers[0][2]
+        reps = int(os.environ.get("RAY_TPU_BENCH_LEDGER_REPS", "5"))
+
+        def timed_pull() -> float:
+            t0 = time.perf_counter()
+            probe_client.pull(origin.address, oid, raw=True)
+            return time.perf_counter() - t0
+
+        timed_pull()  # connection warm-up, outside both series
+        on_walls, off_walls = [], []
+        try:
+            for _ in range(reps):
+                for flag, acc in ((True, on_walls), (False, off_walls)):
+                    _config.apply_overrides({"object_ledger": flag})
+                    object_ledger.reload_enabled()
+                    acc.append(timed_pull())
+        finally:
+            _config.apply_overrides({"object_ledger": True})
+            object_ledger.reload_enabled()
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        overhead_pct = ((median(on_walls) - median(off_walls))
+                        / median(off_walls) * 100.0)
+        print(f"# objects: ledger_on={median(on_walls):.4f}s "
+              f"ledger_off={median(off_walls):.4f}s "
+              f"overhead={overhead_pct:+.2f}%", file=sys.stderr)
+        _emit("object_ledger_overhead_pct", overhead_pct, "%",
+              "object_ledger_overhead_anchor", lower_is_better=True)
     finally:
         for _, server, client in pullers:
             client.close()

@@ -129,6 +129,31 @@ def status(address: str = "", as_dict: bool = False):
             v = goodput.get(part)
             if v:
                 lines.append(f"  {part}: {v:.2f}s")
+    objects = payload.get("objects", {})
+    if objects and objects.get("nodes"):
+        leak_counts = objects.get("leak_counts", {})
+        n_leaks = sum(leak_counts.values()) if leak_counts else 0
+        lines.append(
+            f"objects: {objects.get('total_objects', 0)} live, "
+            f"{objects.get('total_bytes', 0) / 1e6:.1f}MB, "
+            f"leaks flagged: {n_leaks}")
+        for key in sorted(objects["nodes"]):
+            row = objects["nodes"][key]
+            lines.append(f"  {key}: {row.get('objects', 0)} objects "
+                         f"{row.get('bytes', 0) / 1e6:.1f}MB"
+                         + (f" (+{row['truncated']} truncated)"
+                            if row.get("truncated") else ""))
+    channels = payload.get("channels", {})
+    if channels:
+        lines.append("channels:")
+        for key in sorted(channels):
+            row = channels[key]
+            lines.append(
+                f"  {key}: {row.get('channels', 0):.0f} open "
+                f"depth={row.get('depth', 0):.0f} "
+                f"sent={row.get('send_bytes', 0) / 1e6:.1f}MB "
+                f"recv_wait={row.get('recv_wait_seconds', 0):.2f}s "
+                f"backpressure={row.get('capacity_reached', 0):.0f}")
     scores = payload.get("scores", {})
     degraded = {k: v for k, v in scores.items() if v < 1.0}
     if degraded:

@@ -31,6 +31,7 @@ import threading
 import uuid
 from typing import Any, Dict, Optional, Tuple
 
+from . import object_ledger
 from .logging import get_logger
 from .metrics import MICRO_BUCKETS, Counter, Histogram
 from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
@@ -80,7 +81,11 @@ def _approx_nbytes(value: Any) -> int:
 
 def channel_stats() -> Dict[str, float]:
     """This process's channel-metric totals (summed over tag sets) — the
-    cheap assertion surface for tests and bench."""
+    cheap assertion surface for tests and bench, and the per-node record
+    federated to the head on heartbeat telemetry."""
+    with _registry._lock:
+        depth = sum(q.qsize() for q in _registry._chans.values())
+        channels = len(_registry._chans)
     return {
         "send_bytes": sum(v for _, _, v in _send_bytes.samples()),
         "recv_count": sum(
@@ -90,6 +95,8 @@ def channel_stats() -> Dict[str, float]:
             v for name, _, v in _recv_wait.samples() if name.endswith("_sum")
         ),
         "capacity_reached": sum(v for _, _, v in _capacity_reached.samples()),
+        "channels": float(channels),
+        "depth": float(depth),
     }
 
 
@@ -250,6 +257,9 @@ class _Writer:
         signal — it never retries and raises queue.Full."""
         blob = _dumps(value)
         _send_bytes.inc(len(blob), tags={"path": "remote"})
+        object_ledger.record_flow(object_ledger.local_node(),
+                                  object_ledger.peer_node(self.addr),
+                                  "channel", len(blob), transfers=1)
         frame = {
             "op": "put", "chan": chan_id, "blob": blob,
             "maxsize": maxsize, "timeout": timeout,

@@ -121,6 +121,38 @@ declare(
     "never go stale).",
 )
 
+# Object-plane observability (core/object_ledger.py)
+declare(
+    "object_ledger", True,
+    "Maintain per-object ledger metadata (creator, pin reason, last "
+    "access) and per-edge transfer-flow counters, shipped as bounded "
+    "snapshots on heartbeat telemetry. Off = zero bookkeeping beyond the "
+    "plain store entries (the bench overhead suite toggles this).",
+)
+declare(
+    "object_ledger_max_objects", 256,
+    "Max object records in one heartbeat ledger snapshot (largest-first; "
+    "the snapshot carries total object/byte counts so truncation is "
+    "visible on the head).",
+)
+declare(
+    "object_leak_age_s", 60.0,
+    "Head-side leak sweep: a pinned/escaped object with zero live driver "
+    "refs older than this is flagged as leaked; a pull-through cache "
+    "entry never re-hit for this long is flagged as cold.",
+)
+declare(
+    "object_sweep_period_s", 5.0,
+    "How often the head's monitor loop runs the object-plane leak/"
+    "staleness sweep (dead-node directory entries, pinned-no-refs, cold "
+    "cache bytes) and re-asserts its health alerts.",
+)
+declare(
+    "object_flow_window_s", 10.0,
+    "Sliding window for the per-edge object_flow_window_bps bandwidth "
+    "gauges (per (src_node, dst_node, path) transfer link).",
+)
+
 # Gang / TPU
 declare("gang_barrier_timeout_ms", 60_000, "SPMD gang entry barrier timeout.")
 declare("slice_restart_max", 3, "Max gang restarts before failing the job.")

@@ -190,6 +190,8 @@ class ControlPlane:
         event_cursor: int = 0,
         digests: Optional[List[Dict[str, Any]]] = None,
         postmortems: Optional[List[Dict[str, Any]]] = None,
+        objects: Optional[List[Dict[str, Any]]] = None,
+        channels: Optional[Dict[str, float]] = None,
     ) -> bool:
         """Worker-process telemetry flush (piggybacked on the heartbeat
         loop, see cross_host.WorkerRuntime). Metrics and SLO digests
@@ -210,6 +212,10 @@ class ControlPlane:
                 else prev.get("metrics", []),
                 "digests": digests if digests is not None
                 else prev.get("digests", []),
+                "objects": objects if objects is not None
+                else prev.get("objects", []),
+                "channels": channels if channels is not None
+                else prev.get("channels", {}),
                 "event_cursor": max(seen_events, int(event_cursor)),
                 "reported_at": time.time(),
             }

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import object_ledger
 from .config import config
 from .control_plane import ActorInfo, ActorState, ControlPlane, NodeInfo
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID
@@ -160,6 +161,10 @@ class ReferenceCounter:
     def count(self, object_id: ObjectID) -> int:
         with self._lock:
             return self._counts.get(object_id, 0)
+
+    def is_escaped(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._escaped
 
 
 @dataclass
@@ -302,6 +307,10 @@ class Runtime:
         self.job_id = job_id or JobID.next()
         self.control_plane = ControlPlane()
         self.directory = ObjectDirectory()
+        # locate() consults this so it never hands a puller a holder on a
+        # node the control plane already marked DEAD (satellite fix; the
+        # DEAD-mark -> directory-purge window used to leak through)
+        self.directory.alive_check = self._node_is_alive
         self.scheduler = ClusterScheduler(
             self.control_plane, config.scheduler_spread_threshold
         )
@@ -389,6 +398,10 @@ class Runtime:
             self.agents[info.node_id] = agent
             if is_head or self.head_node_id is None:
                 self.head_node_id = info.node_id
+                # re-stamp every cycle: after shutdown()+init() this
+                # process's identity is the NEW head node, and flow dst
+                # labels must follow it
+                object_ledger.set_local_node(info.node_id.hex())
         # node join = new capacity: kick queued placement groups too
         self.pg_manager._retry_queued()
         self._kick_scheduler()
@@ -421,6 +434,14 @@ class Runtime:
             if actor.node_id == node_id and actor.state is ActorState.ALIVE:
                 self._on_actor_death(actor, WorkerCrashedError("node died"))
         self._kick_scheduler()
+
+    def _node_is_alive(self, node_id: NodeID) -> bool:
+        from .control_plane import NodeState
+
+        info = self.control_plane.get_node(node_id)
+        # unknown to the control plane = not ours to veto (directory-only
+        # holders, e.g. duck-typed stores); filter only tracked-and-DEAD
+        return info is None or info.state is NodeState.ALIVE
 
     @property
     def driver_agent(self) -> NodeAgent:
@@ -612,6 +633,8 @@ class Runtime:
 
         # aliasing-safe: the caller may keep mutating `value` after put()
         agent.store.put(oid, seal_value(value))
+        agent.store.annotate(oid, pin_reason=object_ledger.PIN_USER_PUT,
+                             creator_task="driver")
         self.directory.add_location(oid, agent.node_id)
         fut = _Future()
         fut.finish()
@@ -728,6 +751,7 @@ class Runtime:
             agent = self.driver_agent
             if not getattr(agent, "is_remote", False):
                 agent.store.put(oid, raw)
+                agent.store.annotate(oid, pin_reason=object_ledger.PIN_CACHE)
                 self.directory.add_location(oid, agent.node_id)
                 with self._cache_lock:
                     self._pulled_through.add(oid)
@@ -826,6 +850,17 @@ class Runtime:
         result, nested argument, cross-process send) — exempt it from
         refcount-zero auto-free so the deserialized copy still resolves."""
         self.reference_counter.note_escaped(object_id)
+        # stamp the pin reason wherever the object lives locally, so the
+        # ledger can answer WHY the entry outlives its python handles
+        with self._lock:
+            agents = list(self.agents.values())
+        for agent in agents:
+            if getattr(agent, "is_remote", False):
+                continue
+            store = getattr(agent, "store", None)
+            if store is not None and store.contains(object_id):
+                store.annotate(object_id,
+                               pin_reason=object_ledger.PIN_ESCAPED)
 
     def free_object(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -851,6 +886,11 @@ class Runtime:
             for node_id in self.control_plane.check_health(timeout):
                 logger.warning("health check: reaping node %s", node_id.hex()[:8])
                 self.remove_node(node_id)
+            try:
+                # throttles itself to config.object_sweep_period_s
+                object_ledger.sweep(self)
+            except Exception:  # noqa: BLE001 — sweep never kills the monitor
+                logger.debug("object leak sweep failed", exc_info=True)
 
     def pending_resource_demand(self) -> List[Dict[str, float]]:
         """Resource shapes of queued-but-unplaced tasks — the autoscaler's

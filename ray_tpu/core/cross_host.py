@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import object_ledger
 from .config import config
 from .control_plane import NodeInfo
 from .metrics import Counter as _MetricCounter
@@ -504,6 +505,7 @@ class RemoteNodeAgent:
         self.node_id = info.node_id
         self.node_service_addr = node_service_addr
         self.transfer_addr = transfer_addr
+        object_ledger.note_peer(transfer_addr, info.node_id.hex())
         self._stopped = threading.Event()
         self.store = RemoteStoreProxy(self)
         self.resources = _RemoteResources(self)
@@ -883,6 +885,11 @@ class RemoteDirectoryClient:
         self._lock = threading.Lock()
         self._waiters: Dict[str, List[Callable[[], None]]] = {}
         self._subscribed = False
+        # ~1s-cached ALIVE node set: locate() must not hand out holders on
+        # nodes the head already marked DEAD (the mark -> KV-purge window),
+        # but a per-locate alive_nodes RPC would double every pull's RTT
+        self._alive_hexes: Optional[set] = None
+        self._alive_at = 0.0
         # waiter callbacks run OFF the control-plane read loop: they issue
         # blocking RPCs (dir_locations, kv_get) on the SAME connection whose
         # read loop delivers the replies — firing inline would deadlock the
@@ -926,16 +933,31 @@ class RemoteDirectoryClient:
     def locations(self, object_id: ObjectID) -> List[NodeID]:
         return [NodeID.from_hex(h) for h in self._cp.dir_locations(object_id.hex())]
 
+    def _alive(self) -> Optional[set]:
+        now = time.monotonic()
+        if self._alive_hexes is None or now - self._alive_at > 1.0:
+            try:
+                self._alive_hexes = {
+                    n.node_id.hex() for n in self._cp.alive_nodes()}
+                self._alive_at = now
+            except Exception:  # noqa: BLE001 — fall back to unfiltered
+                self._alive_at = now
+        return self._alive_hexes
+
     def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None):
+        alive = self._alive()
         for hexid in self._cp.dir_locations(object_id.hex()):
             node_id = NodeID.from_hex(hexid)
             if node_id == exclude:
                 continue
+            if alive is not None and hexid not in alive:
+                continue  # directory entry outlived its node
             addr = self._cp.kv_get(KV_PREFIX + hexid)
             if not addr:
                 continue
             addr = addr.decode() if isinstance(addr, bytes) else addr
-            return _PullHolder(addr, self._transfer)
+            object_ledger.note_peer(addr, hexid)
+            return _PullHolder(addr, self._transfer, node_id)
         return None
 
     def subscribe_once(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
@@ -984,8 +1006,10 @@ class _PullHolder:
             except ObjectPullError as e:
                 raise ObjectLostError(oid) from e
 
-    def __init__(self, addr: str, client: ObjectTransferClient):
+    def __init__(self, addr: str, client: ObjectTransferClient,
+                 node_id: Optional[NodeID] = None):
         self.store = self._Store(addr, client)
+        self.node_id = node_id
         self._stopped = threading.Event()  # duck parity with NodeAgent
 
 
@@ -1169,6 +1193,7 @@ class WorkerRuntime:
             labels=labels or {},
         )
         self.node_id = self.info.node_id
+        object_ledger.set_local_node(self.node_id.hex())
         self.directory = RemoteDirectoryClient(self.control_plane, self.node_id)
         self.agent = NodeAgent(self.info, self.control_plane, self.directory)
         self.dispatch_server = WorkerNodeServer(self.agent, host=node_host)
@@ -1324,6 +1349,20 @@ class WorkerRuntime:
             pass
         span_cur, spans = tracing.drain_since(self._telemetry_span_cursor)
         event_cur, events = timeline.drain_since(self._telemetry_event_cursor)
+        objects: List[Dict[str, Any]] = []
+        channels: Dict[str, float] = {}
+        try:
+            # publish window-bandwidth gauges + the bounded ledger snapshot
+            # so the head's object/flow matrices include this node
+            object_ledger.refresh_flow_gauges()
+            if object_ledger.enabled():
+                objects = object_ledger.local_snapshots(
+                    {self.node_id: self.agent})
+            from .channels import channel_stats
+
+            channels = channel_stats()
+        except Exception:  # noqa: BLE001 — ledger must not block the beat
+            pass
         metrics = metrics_registry.snapshot()
         spans, events = _cap_telemetry(
             metrics, spans, events, int(config.telemetry_max_bytes))
@@ -1338,6 +1377,8 @@ class WorkerRuntime:
                 event_cursor=event_cur,
                 digests=slo.snapshot(),
                 postmortems=postmortems,
+                objects=objects,
+                channels=channels,
                 _deadline_s=5.0,
             )
         except (ControlPlaneUnavailable, WireError, OSError, RuntimeError) as e:

@@ -534,6 +534,16 @@ class HealthPlane:
             except Exception:
                 pass
         utilization, goodput = self._profiling_sections(cp)
+        objects: Dict[str, Any] = {}
+        channels: Dict[str, Any] = {}
+        try:
+            from . import core_worker, object_ledger
+
+            rt = getattr(core_worker, "_global_runtime", None)
+            objects = object_ledger.objects_section(rt)
+            channels = object_ledger.channels_section(rt)
+        except Exception:  # noqa: BLE001 — payload must render regardless
+            pass
         return {
             "generated_at": time.time(),
             "nodes": nodes,
@@ -542,6 +552,8 @@ class HealthPlane:
             "scores": self.scores(),
             "utilization": utilization,
             "goodput": goodput,
+            "objects": objects,
+            "channels": channels,
         }
 
     _UTIL_GAUGES = {"host_cpu_used_fraction": "cpu_fraction",

@@ -265,6 +265,7 @@ class NodeAgent:
         self._cp = control_plane
         self._directory = object_directory
         self.store = MemoryObjectStore()
+        self.store.ledger_node = info.node_id.hex()
         # an object leaving this store must leave the directory too, or a
         # pull-through replica's advertisement outlives the replica and
         # sends pullers to a holder that no longer has the bytes
@@ -451,6 +452,7 @@ class NodeAgent:
                             "worker killed during streaming")
                     oid = ObjectID.for_task_return(spec.task_id, i)
                     self.store.put(oid, seal_value(value, spec.name))
+                    self.store.annotate(oid, creator_task=spec.name)
                     self._directory.add_location(oid, self.node_id)
                     if stream_cb is not None:
                         stream_cb(i, oid)
@@ -611,6 +613,7 @@ class NodeAgent:
         jax.Array trees and already-sealed pool payloads pass through."""
         for oid, value in zip(spec.return_ids, values):
             self.store.put(oid, seal_value(value, spec.name))
+            self.store.annotate(oid, creator_task=spec.name)
             self._directory.add_location(oid, self.node_id)
 
     # ---------------------------------------------------------------- actors
@@ -1096,6 +1099,10 @@ class ObjectDirectory:
         # cross-host hook: every add_location also notifies joined worker
         # hosts via pubsub (set by cross_host.enable_cross_host)
         self.on_add: Optional[Callable[[ObjectID, NodeID], None]] = None
+        # liveness hook (set by Runtime): locate() skips holders on nodes
+        # the control plane no longer reports ALIVE, closing the window
+        # between a DEAD mark and the directory purge
+        self.alive_check: Optional[Callable[[NodeID], bool]] = None
 
     def register_agent(self, agent: NodeAgent) -> None:
         with self._lock:
@@ -1134,12 +1141,18 @@ class ObjectDirectory:
         with self._lock:
             return list(self._locations.get(object_id, []))
 
+    def items(self) -> Dict[ObjectID, List[NodeID]]:
+        """Full location-table snapshot (object_ledger's dead-node sweep)."""
+        with self._lock:
+            return {oid: list(locs) for oid, locs in self._locations.items()}
+
     def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None,
                prefer_local: bool = False) -> Optional[NodeAgent]:
         """First live holder, in registration order. With prefer_local,
         in-process agents rank ahead of cross-host proxies (is_remote
         agents), so a pull-through replica short-circuits future network
         pulls; a remote holder is still returned when it's the only one."""
+        alive_check = self.alive_check
         with self._lock:
             remote_fallback = None
             for node_id in self._locations.get(object_id, []):
@@ -1147,6 +1160,8 @@ class ObjectDirectory:
                     continue
                 agent = self._agents.get(node_id)
                 if agent is None or agent._stopped.is_set():
+                    continue
+                if alive_check is not None and not alive_check(node_id):
                     continue
                 if prefer_local and getattr(agent, "is_remote", False):
                     if remote_fallback is None:

@@ -115,10 +115,19 @@ class _Entry:
     spilling: bool = False  # disk write in flight (value still readable)
     spilled_path: Optional[str] = None
     created_at: float = field(default_factory=time.monotonic)
+    # object-plane ledger metadata (core/object_ledger.py): who made this
+    # object, why it is held, and when it was last read
+    last_access: float = field(default_factory=time.monotonic)
+    pin_reason: str = ""
+    creator_node: str = ""
+    creator_pid: int = 0
+    creator_task: str = ""
 
 
 class MemoryObjectStore:
     """Single-node store with pinning, LRU eviction and disk spill."""
+
+    kind = "memory"
 
     def __init__(self, capacity_bytes: Optional[int] = None, spill_dir: Optional[str] = None):
         if capacity_bytes is None:
@@ -128,6 +137,10 @@ class MemoryObjectStore:
         self._lock = threading.Condition()
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._used = 0
+        self._evictions = 0
+        # ledger identity: the node this store serves (NodeAgent sets it);
+        # stamped as creator_node on entries sealed here
+        self.ledger_node = ""
         self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
         # fires (outside the lock) when an object leaves the store for good
         # — delete, not spill (spilled objects are still gettable). The node
@@ -171,7 +184,9 @@ class MemoryObjectStore:
                 if object_id in self._entries:
                     return  # idempotent seal (retries)
                 if self._used + size <= self.capacity:
-                    self._entries[object_id] = _Entry(value=value, nbytes=size)
+                    self._entries[object_id] = _Entry(
+                        value=value, nbytes=size,
+                        creator_node=self.ledger_node, creator_pid=os.getpid())
                     self._used += size
                     callbacks = self._waiters.pop(object_id, [])
                     self._lock.notify_all()
@@ -235,6 +250,7 @@ class MemoryObjectStore:
                 self._lock.wait(timeout=remaining if remaining is None else min(remaining, 0.1))
             entry = self._entries[object_id]
             self._entries.move_to_end(object_id)  # LRU touch
+            entry.last_access = time.monotonic()
             value = entry.value
             path = entry.spilled_path
         if value is None and path is not None:
@@ -254,10 +270,30 @@ class MemoryObjectStore:
         if ready:
             callback()
 
-    def pin(self, object_id: ObjectID) -> None:
+    def pin(self, object_id: ObjectID, reason: str = "") -> None:
         with self._lock:
-            if object_id in self._entries:
-                self._entries[object_id].pin_count += 1
+            entry = self._entries.get(object_id)
+            if entry is not None:
+                entry.pin_count += 1
+                if reason:
+                    entry.pin_reason = reason
+
+    def annotate(self, object_id: ObjectID, pin_reason: Optional[str] = None,
+                 creator_task: Optional[str] = None,
+                 creator_node: Optional[str] = None) -> None:
+        """Attach ledger metadata to a sealed entry. `serialized_escape`
+        is sticky — once a ref escaped the process, a later cache/channel
+        annotation must not hide why the object cannot be auto-freed."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return
+            if pin_reason is not None and entry.pin_reason != "serialized_escape":
+                entry.pin_reason = pin_reason
+            if creator_task is not None:
+                entry.creator_task = creator_task
+            if creator_node is not None:
+                entry.creator_node = creator_node
 
     def unpin(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -275,6 +311,7 @@ class MemoryObjectStore:
                     self._used -= entry.nbytes
                 entry.spilling = False  # in-flight spill finalizer will no-op
                 path = entry.spilled_path
+                self._evictions += 1
         if path:
             try:
                 os.remove(path)
@@ -304,7 +341,26 @@ class MemoryObjectStore:
                 "used_bytes": self._used,
                 "capacity_bytes": self.capacity,
                 "num_spilled": spilled,
+                "num_evictions": self._evictions,
             }
+
+    def ledger_records(self) -> List[Dict[str, Any]]:
+        """Wire-friendly ledger rows for every resident object (ages as
+        local monotonic deltas — see object_ledger.snapshot_store)."""
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "object_id": oid.hex(),
+                "size_bytes": e.nbytes,
+                "age_s": round(now - e.created_at, 3),
+                "idle_s": round(now - e.last_access, 3),
+                "pin_count": e.pin_count,
+                "pin_reason": e.pin_reason,
+                "creator_node": e.creator_node[:12],
+                "creator_pid": e.creator_pid,
+                "creator_task": e.creator_task,
+                "spilled": e.spilled_path is not None,
+            } for oid, e in self._entries.items()]
 
     # -- eviction / spill ---------------------------------------------------
     def _write_spill_file(self, object_id: ObjectID, value: Any) -> str:

@@ -28,6 +28,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import object_ledger
 from .config import config
 from .ids import ObjectID
 from .logging import get_logger
@@ -557,6 +558,13 @@ class ObjectTransferClient:
                                    _make_client_native)
         self._inflight: set = set()  # sids being pulled by THIS client
         self._inflight_lock = threading.Lock()
+        # flow-accounting identity of the pulling side; empty means the
+        # process-wide node id (set per-client in tests/benches that run
+        # several logical pullers in one process)
+        self.local_node = ""
+
+    def _flow_dst(self) -> str:
+        return self.local_node or object_ledger.local_node()
 
     def _pool(self, address: str) -> _ConnPool:
         with self._global_lock:
@@ -614,7 +622,7 @@ class ObjectTransferClient:
             pool.close()
 
     def pull(self, address: str, object_id, raw: bool = False,
-             peers: Sequence[str] = ()) -> Any:
+             peers: Sequence[str] = (), src_node: str = "") -> Any:
         """Pull one object from the holder at `address`; returns the value
         (raw=True: the sealed payload, store.get_raw parity).
 
@@ -625,6 +633,7 @@ class ObjectTransferClient:
         large fallback pulls stripe byte ranges across `peers` that also
         hold the object (pull_from_any passes the ranked remainder)."""
         oid_hex = object_id.hex() if hasattr(object_id, "hex") else str(object_id)
+        src_node = src_node or object_ledger.peer_node(address)
         t0 = time.monotonic()
         with _pull_inflight.track():
             try:
@@ -638,21 +647,24 @@ class ObjectTransferClient:
                                                 raw), None
             if native_port is not None:
                 value = self._pull_native(address, native_port, oid_hex, raw,
-                                          total)
+                                          total, src_node)
                 if value is not _NATIVE_MISS:
                     _pull_seconds.observe(time.monotonic() - t0,
                                           {"path": "native"})
                     return value
             blob = None
             if (peers and total >= config.object_transfer_stripe_min_bytes):
-                blob = self._pull_striped(address, peers, oid_hex, raw, total)
+                blob = self._pull_striped(address, peers, oid_hex, raw, total,
+                                          src_node)
             if blob is None:
-                blob = self._pull_chunked(address, oid_hex, raw, 0, total)
+                blob = self._pull_chunked(address, oid_hex, raw, 0, total,
+                                          src_node=src_node)
             _pull_seconds.observe(time.monotonic() - t0, {"path": "chunked"})
             return pickle.loads(blob)
 
     def _pull_chunked(self, address: str, oid_hex: str, raw: bool,
-                      start: int, end: int) -> bytes:
+                      start: int, end: int, src_node: str = "",
+                      flow_path: str = "chunked") -> bytes:
         """Pull bytes [start, end) as pipelined chunk requests: a window of
         chunk_window requests stays outstanding on one exclusively-held
         connection instead of one synchronous round trip per ~1MB. The
@@ -664,6 +676,8 @@ class ObjectTransferClient:
         parts: List[bytes] = []
         pending: "deque[Tuple[int, int, int]]" = deque()  # (req_id, off, len)
         offset = start
+        src_node = src_node or object_ledger.peer_node(address)
+        flow_dst = self._flow_dst()
         try:
             sock = slot.sock
             while offset < end or pending:
@@ -690,7 +704,11 @@ class ObjectTransferClient:
                 _pulled_chunks.inc()
                 _pulled_bytes.inc(len(chunk))
                 _pull_bytes.inc(len(chunk))
+                object_ledger.record_flow(src_node, flow_dst, flow_path,
+                                          len(chunk))
             dead = False
+            object_ledger.record_flow(src_node, flow_dst, flow_path, 0,
+                                      transfers=1)
         except (WireError, OSError) as e:
             raise ObjectPullConnectionError(
                 f"transfer connection to {address} lost: {e}")
@@ -706,7 +724,8 @@ class ObjectTransferClient:
         return b"".join(parts)
 
     def _pull_striped(self, address: str, peers: Sequence[str],
-                      oid_hex: str, raw: bool, total: int) -> Optional[bytes]:
+                      oid_hex: str, raw: bool, total: int,
+                      src_node: str = "") -> Optional[bytes]:
         """Stripe a large chunked pull across holders: confirmed peers each
         serve a contiguous byte range in parallel. Returns None when no
         peer confirms (caller falls back to the single-holder path); any
@@ -738,7 +757,13 @@ class ObjectTransferClient:
 
         def work(i: int, holder: str, lo: int, hi: int) -> None:
             try:
-                results[i] = self._pull_chunked(holder, oid_hex, raw, lo, hi)
+                # each stripe is its own edge: bytes flow from the stripe's
+                # holder, not from the primary address
+                src = src_node if holder == address else \
+                    object_ledger.peer_node(holder)
+                results[i] = self._pull_chunked(holder, oid_hex, raw, lo, hi,
+                                                src_node=src,
+                                                flow_path="stripe")
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors[i] = e
 
@@ -758,7 +783,7 @@ class ObjectTransferClient:
         return b"".join(results)  # type: ignore[arg-type]
 
     def _pull_native(self, address: str, native_port: int, oid_hex: str,
-                     raw: bool, total: int) -> Any:
+                     raw: bool, total: int, src_node: str = "") -> Any:
         """One native arena-to-arena pull; returns _NATIVE_MISS to send the
         caller down the chunked path (never raises for availability-class
         failures — the chunked path is the answer to all of them)."""
@@ -833,6 +858,9 @@ class ObjectTransferClient:
                 _pulled_chunks.inc()
                 _pulled_bytes.inc(total)
                 _pull_bytes.inc(total)
+                object_ledger.record_flow(
+                    src_node or object_ledger.peer_node(address),
+                    self._flow_dst(), "native", total, transfers=1)
             return value
         except PullRejected:
             return _NATIVE_MISS  # does not fit the local arena
@@ -866,6 +894,7 @@ def serve_object_transfer(runtime, host: str = "127.0.0.1",
     store = runtime.driver_agent.store
     server = ObjectTransferServer(store, host, port)
     node_hex = runtime.driver_agent.node_id.hex()
+    object_ledger.note_peer(server.address, node_hex)
     try:
         runtime.control_plane.kv_put(KV_PREFIX + node_hex, server.address)
     except Exception:  # noqa: BLE001 — advertising is best-effort
@@ -900,6 +929,7 @@ def _ranked_holders(control_plane) -> List[str]:
         if not address:
             continue
         node_hex = key[len(KV_PREFIX):]
+        object_ledger.note_peer(address, node_hex)
         load = 0.0
         try:
             raw = control_plane.kv_get(LOAD_PREFIX + node_hex)

@@ -141,6 +141,8 @@ def _check_id(object_id: bytes) -> bytes:
 class ShmObjectStore:
     """One mapped store handle (create for the node owner, open for clients)."""
 
+    kind = "shm"
+
     def __init__(self, name: str, capacity: int = 1 << 30, max_objects: int = 4096,
                  create: bool = True):
         self._lib = _load()
@@ -161,6 +163,14 @@ class ShmObjectStore:
         # must tolerate stale advertisements (pullers fall through the
         # ranked holder list on a miss).
         self.on_evict = None
+        # object-plane ledger (core/object_ledger.py): Python-side metadata
+        # for entries THIS handle sealed or pulled (the C arena has no
+        # enumeration API, so other processes' objects are invisible here —
+        # each process's handle reports its own, and the head merges them).
+        self.ledger_node = ""
+        self._meta_lock = threading.Lock()
+        self._meta: dict = {}  # object_id bytes -> ledger meta dict
+        self._evictions = 0
 
     # -- raw byte API --------------------------------------------------------
 
@@ -192,6 +202,43 @@ class ShmObjectStore:
             self._lib.shm_obj_release(h, object_id)  # drop creator pin
             self._lib.shm_obj_delete(h, object_id)
             raise
+        self._note_put(object_id, total)
+
+    def _note_put(self, object_id: bytes, nbytes: int,
+                  pin_reason: str = "") -> None:
+        """Record ledger metadata for an object this handle landed."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._meta_lock:
+            if len(self._meta) > 65536:  # runaway guard for long-lived handles
+                self._meta.clear()
+            self._meta[bytes(object_id)] = {
+                "size_bytes": int(nbytes),
+                "created_at": now,
+                "last_access": now,
+                "pin_reason": pin_reason,
+                "creator_node": self.ledger_node,
+                "creator_pid": os.getpid(),
+                "creator_task": "",
+            }
+
+    def annotate(self, object_id: bytes, pin_reason: Optional[str] = None,
+                 creator_task: Optional[str] = None,
+                 creator_node: Optional[str] = None) -> None:
+        """Ledger-metadata parity with MemoryObjectStore.annotate (the
+        serialized_escape reason is sticky there too)."""
+        with self._meta_lock:
+            meta = self._meta.get(bytes(object_id))
+            if meta is None:
+                return
+            if (pin_reason is not None
+                    and meta["pin_reason"] != "serialized_escape"):
+                meta["pin_reason"] = pin_reason
+            if creator_task is not None:
+                meta["creator_task"] = creator_task
+            if creator_node is not None:
+                meta["creator_node"] = creator_node
 
     def put(self, object_id: bytes, data) -> None:
         """data: bytes or any C-contiguous buffer (memoryview, pickle5 raw)."""
@@ -212,6 +259,12 @@ class ShmObjectStore:
         ptr = self._lib.shm_obj_get(h, object_id, ctypes.byref(size))
         if not ptr:
             return None
+        with self._meta_lock:
+            meta = self._meta.get(bytes(object_id))
+            if meta is not None:
+                import time as _time
+
+                meta["last_access"] = _time.monotonic()
         arr = (ctypes.c_uint8 * size.value).from_address(ptr)
         return memoryview(arr)
 
@@ -234,6 +287,10 @@ class ShmObjectStore:
         if not h:
             return False
         deleted = self._lib.shm_obj_delete(h, _check_id(object_id)) == 0
+        if deleted:
+            with self._meta_lock:
+                self._meta.pop(bytes(object_id), None)
+                self._evictions += 1
         on_evict = self.on_evict
         if deleted and on_evict is not None:
             try:
@@ -287,6 +344,64 @@ class ShmObjectStore:
 
     def capacity(self) -> int:
         return self._lib.shm_store_capacity(self._handle())
+
+    def stats(self) -> dict:
+        """Same dict shape as MemoryObjectStore.stats so the ledger and
+        /metrics report both backends uniformly. Bytes/capacity come from
+        the arena (authoritative, cross-process); the object count is
+        this handle's tracked entries (the arena has no enumeration API)."""
+        try:
+            used, cap = self.live_bytes(), self.capacity()
+        except ShmStoreError:
+            used = cap = 0
+        with self._meta_lock:
+            n = len(self._meta)
+        return {
+            "num_objects": n,
+            "used_bytes": used,
+            "capacity_bytes": cap,
+            "num_spilled": 0,  # the arena never spills; creates fail instead
+            "num_evictions": self._evictions,
+        }
+
+    def list_objects(self):
+        """[(object_id bytes, nbytes)] for this handle's tracked entries
+        (MemoryObjectStore.list_objects parity for introspection)."""
+        with self._meta_lock:
+            items = list(self._meta.items())
+        return [(oid, m["size_bytes"]) for oid, m in items
+                if self.contains(oid)]
+
+    def ledger_records(self) -> list:
+        """Ledger rows in object_ledger wire shape; entries deleted by
+        another process (or LRU-evicted in the arena) are pruned here."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._meta_lock:
+            items = list(self._meta.items())
+        out, stale = [], []
+        for oid, m in items:
+            if not self.contains(oid):
+                stale.append(oid)
+                continue
+            out.append({
+                "object_id": oid.hex(),
+                "size_bytes": m["size_bytes"],
+                "age_s": round(now - m["created_at"], 3),
+                "idle_s": round(now - m["last_access"], 3),
+                "pin_count": 0,  # C-side pins are view refcounts, not holds
+                "pin_reason": m["pin_reason"],
+                "creator_node": m["creator_node"][:12],
+                "creator_pid": m["creator_pid"],
+                "creator_task": m["creator_task"],
+                "spilled": False,
+            })
+        if stale:
+            with self._meta_lock:
+                for oid in stale:
+                    self._meta.pop(oid, None)
+        return out
 
     def close(self) -> None:
         if self._h:
@@ -439,6 +554,9 @@ class NativeTransferClient:
                 f"native pull of {object_id.hex()[:8]} from {host}:{port} "
                 f"failed (rc={rc})"
             )
+        # the pull landed the object via C without a Python put: record it
+        # in the destination handle's ledger as a pull-through replica
+        store._note_put(object_id, int(rc), pin_reason="cache")
         return int(rc)
 
     def _drop(self, host: str, port: int) -> None:

@@ -249,6 +249,8 @@ class CompiledDAG:
                     )
                 addr = raw.decode() if isinstance(raw, bytes) else raw
                 owner_cache[nid] = addr
+                from .core import object_ledger
+                object_ledger.note_peer(addr, nid.hex())
             return addr
 
         return lambda node: DistChannel(

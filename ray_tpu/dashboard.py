@@ -172,8 +172,23 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "bubble {{stage}}",
         "refId": "B",
     })
+    objects = _dashboard("raytpu-objects", "ray_tpu / object plane", [
+        _panel("Live bytes per node/store", "object_store_live_bytes",
+               0, 0, unit="bytes", legend="{{node}} {{store}}"),
+        _panel("Per-edge bandwidth (window)", "object_flow_window_bps",
+               1, 0, unit="Bps", legend="{{src}}→{{dst}} {{path}}"),
+        _panel("Edge throughput (rate)", "rate(object_flow_bytes[1m])",
+               2, 8, unit="Bps", legend="{{src}}→{{dst}} {{path}}"),
+        _panel("Pull-through cache hit rate",
+               "rate(object_cache_hits[5m]) / "
+               "(rate(object_cache_hits[5m]) + rate(object_cache_misses[5m]))",
+               3, 8, unit="percentunit", legend="hit rate"),
+        _panel("Leaks by kind", "object_leaks", 4, 16, legend="{{kind}}"),
+        _panel("Leaked bytes by kind", "object_leaked_bytes", 5, 16,
+               unit="bytes", legend="{{kind}}"),
+    ])
     return {"core": core, "serve": serve, "data": data, "disagg": disagg,
-            "health": health, "profiling": profiling}
+            "health": health, "profiling": profiling, "objects": objects}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:
@@ -326,6 +341,30 @@ def _postmortems_payload() -> Dict[str, Any]:
     }
 
 
+def _objects_payload() -> Dict[str, Any]:
+    """Federated object ledger: every live object across the cluster with
+    size / location set / refcount / pin reason / age, plus the latest
+    leak-sweep report (forced fresh so the API never serves a stale
+    verdict about a leak the caller just created)."""
+    from .core import core_worker, object_ledger
+
+    rt = core_worker._global_runtime
+    if rt is None:
+        return {"generated_at": 0.0, "total_objects": 0, "total_bytes": 0,
+                "objects": [], "nodes": {}, "leaks": [], "leak_counts": {}}
+    object_ledger.sweep(rt)
+    return object_ledger.collect_objects(rt)
+
+
+def _flows_payload() -> Dict[str, Any]:
+    """Per-edge transfer matrix: (src, dst, path) byte/transfer totals and
+    window bandwidth, folded across the head and every node's federated
+    metric snapshot."""
+    from .core import core_worker, object_ledger
+
+    return object_ledger.collect_flows(runtime=core_worker._global_runtime)
+
+
 def _state_payload(what: str) -> Any:
     from .util import state
 
@@ -439,6 +478,12 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                                             "history": plane.history()})
                 if self.path.rstrip("/") == "/api/v0/postmortems":
                     return self._json(200, _postmortems_payload())
+                # object plane (core/object_ledger.py) — the full ledger
+                # body outranks the compact state route's "objects" rows
+                if self.path.rstrip("/") == "/api/v0/objects":
+                    return self._json(200, _objects_payload())
+                if self.path.rstrip("/") == "/api/v0/flows":
+                    return self._json(200, _flows_payload())
                 # profiling plane: /api/v0/profile/<node>/<pid>?kind=...
                 # (node "head"/"-" = the head's own driver node, pid 0 =
                 # the node's agent process) — must precede the state route

@@ -274,10 +274,12 @@ def cmd_logs(args) -> int:
 
 
 def cmd_memory(args) -> int:
-    """Object-plane introspection (reference: `ray memory`): per-object
-    sizes and store totals — from this invocation's runtime, or from a
-    persisted snapshot (`--snapshot`). Objects are node-local, so there
-    is no `--address` mode (same contract as `ray-tpu list objects`)."""
+    """Object-plane introspection (reference: `ray memory`), federated
+    over the cluster ledger: every live object with size / location set /
+    refcount / pin reason / age, top-N by size. `--group-by reason|node`
+    aggregates instead; `--leaks` runs the leak sweep and prints what it
+    flagged. `--snapshot` still lists object ids from a persisted
+    control-plane snapshot of a dead runtime."""
     if args.snapshot:
         from ray_tpu.core import persistence
 
@@ -287,20 +289,60 @@ def cmd_memory(args) -> int:
         print(f"\ntotal: {len(oids)} objects (snapshot)")
         return 0
     import ray_tpu
-    from ray_tpu.util import state
+    from ray_tpu.core import object_ledger
 
     rt = ray_tpu.init()
-    rows = state.list_objects(limit=args.limit)
-    cols = list(rows[0].keys()) if rows else []
-    _print_rows(rows, cols)
-    total_bytes = 0
-    total_objects = 0
-    for agent in rt.agents.values():
-        stats = agent.store.stats()
-        total_bytes += stats.get("used_bytes", 0)
-        total_objects += stats.get("num_objects", 0)
-    print(f"\ntotal: {total_objects} objects, {total_bytes} bytes "
-          f"across {len(rt.agents)} node store(s)")
+    report = object_ledger.sweep(rt, force=True)
+    body = object_ledger.collect_objects(rt, limit=max(args.limit, 10_000))
+    rows = body["objects"]
+
+    if args.leaks:
+        leak_rows = [{
+            "kind": l.get("kind", ""),
+            "object_id": l.get("object_id", "")[:16],
+            "node_id": l.get("node_id", ""),
+            "size_bytes": l.get("size_bytes", 0),
+            "age_s": l.get("age_s", 0.0),
+            "detail": l.get("detail", ""),
+        } for l in report.get("leaks", [])]
+        _print_rows(leak_rows, ["kind", "object_id", "node_id",
+                                "size_bytes", "age_s", "detail"])
+        counts = report.get("counts", {})
+        print(f"\nleaks: {sum(counts.values())} "
+              + " ".join(f"{k}={v}" for k, v in sorted(counts.items())))
+        return 0
+
+    if args.group_by:
+        key = {"reason": "pin_reason", "node": "node_id"}[args.group_by]
+        groups: Dict[str, Dict[str, Any]] = {}
+        for r in rows:
+            g = groups.setdefault(str(r.get(key, "") or "(none)"),
+                                  {args.group_by: str(r.get(key, "") or "(none)"),
+                                   "objects": 0, "bytes": 0})
+            g["objects"] += 1
+            g["bytes"] += int(r.get("size_bytes", 0) or 0)
+        grows = sorted(groups.values(), key=lambda g: g["bytes"], reverse=True)
+        _print_rows(grows, [args.group_by, "objects", "bytes"])
+    else:
+        view = [{
+            "object_id": r.get("object_id", "")[:16],
+            "size_bytes": r.get("size_bytes", 0),
+            "node_id": r.get("node_id", ""),
+            "store": r.get("store", ""),
+            "locations": ",".join(r.get("locations", [])) or "-",
+            "refcount": r.get("refcount", 0),
+            "pin_reason": r.get("pin_reason", "") or "-",
+            "age_s": round(float(r.get("age_s", 0.0)), 1),
+            "creator_task": r.get("creator_task", "") or "-",
+        } for r in rows[:args.limit]]
+        _print_rows(view, ["object_id", "size_bytes", "node_id", "store",
+                           "locations", "refcount", "pin_reason", "age_s",
+                           "creator_task"])
+    counts = report.get("counts", {})
+    print(f"\ntotal: {body['total_objects']} objects, "
+          f"{body['total_bytes']} bytes across "
+          f"{len(body['nodes'])} node store(s); "
+          f"leaks flagged: {sum(counts.values())} (--leaks for detail)")
     return 0
 
 
@@ -521,8 +563,14 @@ def main(argv=None) -> int:
                      "in-process runtime)")
     ppf.set_defaults(fn=cmd_profile)
 
-    pmem = sub.add_parser("memory", help="object-plane sizes and totals")
-    pmem.add_argument("--limit", type=int, default=100)
+    pmem = sub.add_parser("memory", help="object ledger: sizes, locations, "
+                          "refcounts, pin reasons, leaks")
+    pmem.add_argument("--limit", type=int, default=100,
+                      help="top-N objects by size")
+    pmem.add_argument("--group-by", choices=["reason", "node"], default=None,
+                      help="aggregate objects/bytes by pin reason or node")
+    pmem.add_argument("--leaks", action="store_true",
+                      help="run the leak sweep and print flagged objects")
     pmem.add_argument("--snapshot", help="read a control-plane snapshot file")
     pmem.set_defaults(fn=cmd_memory)
 

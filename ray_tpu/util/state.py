@@ -65,15 +65,25 @@ def list_jobs(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
 
 
 def list_objects(filters=None, limit: int = 100) -> List[Dict[str, Any]]:
+    """Cluster-wide object rows from the federated ledger: local stores
+    snapshotted now, joined hosts from their latest telemetry snapshot,
+    each row carrying location set / refcount / pin reason / age."""
     rt = api._auto_init()
+    from ..core import object_ledger
+
     rows = []
-    for agent in rt.agents.values():
-        for oid, size in agent.store.list_objects():
-            rows.append({
-                "object_id": oid.hex()[:16],
-                "node_id": agent.node_id.hex()[:16],
-                "size_bytes": size,
-            })
+    for r in object_ledger.collect_objects(rt, limit=10_000)["objects"]:
+        rows.append({
+            "object_id": r.get("object_id", "")[:16],
+            "node_id": r.get("node_id", "")[:16],
+            "size_bytes": r.get("size_bytes", 0),
+            "store": r.get("store", ""),
+            "pin_reason": r.get("pin_reason", ""),
+            "refcount": r.get("refcount", 0),
+            "locations": ",".join(r.get("locations", [])),
+            "age_s": round(float(r.get("age_s", 0.0)), 1),
+            "creator_task": r.get("creator_task", ""),
+        })
     return _apply_filters(rows, filters)[:limit]
 
 
