@@ -40,8 +40,8 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
 	tsan shm \
-	status bench-data bench-object bench-serve bench-trace bench-health \
-	bench-pipeline bench-profile
+	status bench-data bench-object bench-serve bench-disagg bench-trace \
+	bench-health bench-pipeline bench-profile
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -56,10 +56,17 @@ bench-object:
 
 # serve iteration loop: continuous-batching burst (req/s, p50/p95 TTFT,
 # decode tok/s) plus the disagg-vs-colocated pass (same burst through a
-# prefill+decode pair with KV migrating over the object plane), merged
-# into BENCH_SUMMARY.json
+# prefill+decode pair with KV streamed during prefill), merged into
+# BENCH_SUMMARY.json
 bench-serve:
 	env RAY_TPU_BENCH_SUITE=serve python bench.py
+
+# disagg acceptance loop: ONLY the disagg rows — alternating colocated/
+# disagg rounds with per-side medians (box drift hits both sides), a
+# mixed long-prefill/long-decode load row, and the traced migration-
+# overlaps-prefill evidence row, merged into BENCH_SUMMARY.json
+bench-disagg:
+	env RAY_TPU_BENCH_SUITE=disagg python bench.py
 
 # observability-overhead loop: the same disagg serve burst with tracing
 # off (sample rate 0) vs fully on (1.0) — untraced/traced req/s and the

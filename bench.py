@@ -18,9 +18,10 @@ for train, "serve_anchor"/"data_anchor" for the rest); missing anchor -> 1.0.
 
 Env knobs: RAY_TPU_BENCH_MODEL, RAY_TPU_BENCH_BATCH, RAY_TPU_BENCH_SEQ,
 RAY_TPU_BENCH_STEPS, RAY_TPU_BENCH_SCAN (0 disables the scanned metric),
-RAY_TPU_BENCH_SUITE (comma list of train,train2b,pipeline,serve,data;
-default all; train2b is the pinned ~2B stepping-stone run, anchored
-separately; pipeline is the MPMD stage-gang trainer, tiny model pinned).
+RAY_TPU_BENCH_SUITE (comma list of train,train2b,pipeline,serve,disagg,
+data,...; default all; train2b is the pinned ~2B stepping-stone run,
+anchored separately; pipeline is the MPMD stage-gang trainer, tiny model
+pinned; disagg is the alternating-median disagg-vs-colocated gate).
 
 vs_baseline for train divides by "bench_anchor" (llama-600m) or the
 per-model "bench_anchor_<model>" key (e.g. bench_anchor_llama_2b).
@@ -262,10 +263,13 @@ def bench_serve(model: str) -> None:
 def _bench_serve_disagg(cfg, mname: str, rng, n_req: int, prompt_len: int,
                         max_tokens: int, colocated_req_per_s: float) -> None:
     """Disagg-vs-colocated serve pass: the SAME burst through a
-    prefill+decode replica pair with KV migrating over the object plane,
-    compared against the colocated rows just emitted. In-process pair on
-    one host — the row measures the migration tax and the phase split,
-    not cross-host network (run the slow cross-host test for that)."""
+    prefill+decode replica pair with KV migrating over the configured
+    transport (default: streamed frames overlapping prefill), compared
+    against the colocated rows just emitted. In-process pair on one
+    host — the row measures the migration tax and the phase split, not
+    cross-host network (run the slow cross-host test for that). The
+    "disagg" suite (bench_disagg) is the robust alternating-median
+    version of this comparison."""
     import jax
 
     from ray_tpu.models import init_params
@@ -283,7 +287,7 @@ def _bench_serve_disagg(cfg, mname: str, rng, n_req: int, prompt_len: int,
     pe, de = make_engine(), make_engine()
     co = DisaggCoordinator([EngineWorker(pe, "prefill0")],
                            [EngineWorker(de, "decode0")],
-                           {"small_blob_bytes": 0})  # always object plane
+                           {"small_blob_bytes": 0})  # no inline fast path
     prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
                for _ in range(n_req)]
     co.generate(prompts[0], max_tokens=4)  # warm export/import programs
@@ -294,8 +298,8 @@ def _bench_serve_disagg(cfg, mname: str, rng, n_req: int, prompt_len: int,
     mig_ms = 1e3 * sum(float(r["migration_s"]) for r in results) / n_req
     print(
         f"# serve-disagg: model={cfg.name} n_req={n_req} prompt={prompt_len} "
-        f"max_tokens={max_tokens} wall={wall:.2f}s transport=object "
-        f"migration_mean={mig_ms:.1f}ms",
+        f"max_tokens={max_tokens} wall={wall:.2f}s "
+        f"transport={co.cfg.kv_transfer} migration_mean={mig_ms:.1f}ms",
         file=sys.stderr,
     )
     disagg_rps = n_req / wall
@@ -311,6 +315,178 @@ def _bench_serve_disagg(cfg, mname: str, rng, n_req: int, prompt_len: int,
           "serve_disagg_ratio_anchor")
     _emit(f"serve_kv_migration_ms_mean_{mname}", mig_ms, "ms",
           "serve_kv_migration_anchor", lower_is_better=True)
+
+
+def bench_disagg(model: str) -> None:
+    """Disagg acceptance gate: alternating colocated/disagg rounds with
+    fresh prompts per round (so prefix routing never short-circuits the
+    migration being measured) and MEDIAN req/s per side — on a shared
+    CPU box the per-round spread dwarfs the true disagg tax, and the
+    strictly-alternating schedule makes box drift hit both sides.
+
+    Three row groups:
+      * uniform burst (same shape as bench_serve): the headline
+        `serve_disagg_vs_colocated_req_per_s` ratio (overwrites the
+        single-round value from the serve suite when both run) plus
+        disagg p95 TTFT.
+      * mixed load: half long-prefill/short-decode (exercises CHUNKED
+        streamed export — frames leave as each prefill chunk commits),
+        half short-prefill/long-decode. The shape disaggregation exists
+        for: decode slots are not held hostage by long prefills.
+      * overlap evidence: one traced request's spans — the fraction of
+        the `disagg.kv_migration` wall that overlaps `disagg.prefill`.
+        Near-zero means the transport has regressed to ship-after-
+        prefill; the streamed transport keeps it high."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+    from ray_tpu.util import tracing
+
+    cfg = get_config(model)
+    prompt_len, max_tokens, n_req = 128, 64, 24
+    long_prefill, long_decode = (384, 16), (32, 96)
+    n_mixed = 16
+
+    def make_engine():
+        ecfg = EngineConfig(max_batch_size=16, max_seq_len=512,
+                            prefill_batch_size=8, busy_span=4)
+        e = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                            ecfg)
+        e.warmup(buckets=[prompt_len])
+        return e
+
+    ce = make_engine()  # colocated reference
+    pe, de = make_engine(), make_engine()
+    co = DisaggCoordinator([EngineWorker(pe, "prefill0")],
+                           [EngineWorker(de, "decode0")],
+                           {"small_blob_bytes": 0})
+    rng = np.random.default_rng(7)
+
+    def burst(engine, pairs):
+        """(prompt, max_tokens) pairs, all fired concurrently."""
+        results: list = [None] * len(pairs)
+        errors: list = [None] * len(pairs)
+
+        def worker(i):
+            try:
+                results[i] = engine.generate(pairs[i][0],
+                                             max_tokens=pairs[i][1])
+            except Exception as e:  # noqa: BLE001 — surfaced after join
+                errors[i] = e
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(pairs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        failed = [e for e in errors if e is not None]
+        if failed:
+            raise RuntimeError(f"{len(failed)}/{len(pairs)} disagg bench "
+                               f"requests failed: {failed[0]!r}")
+        return results, wall
+
+    def uniform_pairs():
+        return [(list(rng.integers(1, cfg.vocab_size, prompt_len)),
+                 max_tokens) for _ in range(n_req)]
+
+    def mixed_pairs():
+        pairs = []
+        for i in range(n_mixed):
+            plen, mtok = long_prefill if i % 2 == 0 else long_decode
+            pairs.append((list(rng.integers(1, cfg.vocab_size, plen)), mtok))
+        return pairs
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    def p95(xs):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+    # throwaway round each side: steady-state compile/install paths
+    burst(ce, uniform_pairs())
+    burst(co, uniform_pairs())
+
+    rounds = 5
+    colo, dis, dis_ttfts = [], [], []
+    for _ in range(rounds):  # strictly alternating
+        _, wall = burst(ce, uniform_pairs())
+        colo.append(n_req / wall)
+        res, wall = burst(co, uniform_pairs())
+        dis.append(n_req / wall)
+        dis_ttfts += [float(r["ttft_s"]) for r in res]
+
+    # mixed phase: throwaway compiles the chunked-prefill program on
+    # every engine, then 3 alternating rounds
+    burst(ce, mixed_pairs())
+    burst(co, mixed_pairs())
+    mcolo, mdis, mdis_ttfts = [], [], []
+    for _ in range(3):
+        _, wall = burst(ce, mixed_pairs())
+        mcolo.append(n_mixed / wall)
+        res, wall = burst(co, mixed_pairs())
+        mdis.append(n_mixed / wall)
+        mdis_ttfts += [float(r["ttft_s"]) for r in res]
+
+    # overlap evidence: one traced long-prefill request; under the
+    # streamed transport disagg.kv_migration opens with the first frame
+    # while disagg.prefill is still committing chunks
+    with tracing.start_span("request:bench_disagg") as root:
+        co.generate(list(rng.integers(1, cfg.vocab_size, long_prefill[0])),
+                    max_tokens=8)
+    spans = tracing.get_spans(root.trace_id)
+    tracing.clear()
+
+    def interval(name):
+        ss = [s for s in spans if s["name"] == name and s["end_us"]]
+        if not ss:
+            return None
+        return (min(s["start_us"] for s in ss),
+                max(s["end_us"] for s in ss))
+
+    mig, pre = interval("disagg.kv_migration"), interval("disagg.prefill")
+    overlap_pct = 0.0
+    if mig and pre and mig[1] > mig[0]:
+        ov = max(0.0, min(mig[1], pre[1]) - max(mig[0], pre[0]))
+        overlap_pct = 100.0 * ov / (mig[1] - mig[0])
+
+    ce.stop()
+    pe.stop()
+    de.stop()
+
+    rps_colo, rps_dis = median(colo), median(dis)
+    mrps_colo, mrps_dis = median(mcolo), median(mdis)
+    mname = model.replace("-", "_")
+    print(
+        f"# disagg: model={model} transport={co.cfg.kv_transfer} "
+        f"uniform colo={rps_colo:.2f} disagg={rps_dis:.2f} req/s | "
+        f"mixed colo={mrps_colo:.2f} disagg={mrps_dis:.2f} req/s | "
+        f"migration-prefill overlap={overlap_pct:.0f}%",
+        file=sys.stderr,
+    )
+    _emit("serve_disagg_vs_colocated_req_per_s",
+          rps_dis / max(rps_colo, 1e-9), "ratio",
+          "serve_disagg_ratio_anchor")
+    _emit(f"serve_disagg_p95_ttft_{mname}", p95(dis_ttfts), "s",
+          "serve_disagg_p95_ttft_anchor", lower_is_better=True)
+    _emit(f"serve_disagg_mixed_req_per_s_{mname}", mrps_dis, "req/s",
+          "serve_disagg_mixed_anchor")
+    _emit("serve_disagg_mixed_vs_colocated_req_per_s",
+          mrps_dis / max(mrps_colo, 1e-9), "ratio",
+          "serve_disagg_mixed_ratio_anchor")
+    _emit(f"serve_disagg_mixed_p95_ttft_{mname}", p95(mdis_ttfts), "s",
+          "serve_disagg_mixed_ttft_anchor", lower_is_better=True)
+    _emit("serve_disagg_migration_overlap_pct", overlap_pct, "%",
+          "serve_disagg_overlap_anchor")
 
 
 def bench_trace(model: str) -> None:
@@ -1190,6 +1366,11 @@ def main() -> None:
     # tolerates residue far better (1.5% -> ~2-6% worst case).
     if "serve" in wanted:
         bench_serve(model)
+    if "disagg" in wanted:
+        # disagg acceptance gate: alternating-median colocated-vs-disagg
+        # comparison + mixed load + migration/prefill overlap evidence.
+        # As latency-sensitive as serve — runs in the same early block.
+        bench_disagg(model)
     if "trace" in wanted:
         # observability overhead: traced-vs-untraced disagg serve burst.
         # Runs early for the same reason serve does — req/s is latency-

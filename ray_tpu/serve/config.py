@@ -105,11 +105,17 @@ class DisaggConfig:
                   per-object bookkeeping isn't worth it for small KV).
       "channel" — every blob moves over a consumer-homed DistChannel to
                   the decode replica (lowest latency; no spill/replay).
+      "stream"  — the default: KV frames stream to the decode replica's
+                  DistChannel AS PREFILL COMMITS PAGES (page-window
+                  slices, coalesced per destination), and the decode
+                  engine ingests them eagerly via begin/ingest/finish
+                  _kv_import — migration overlaps prefill compute
+                  instead of starting after the first token.
     """
 
     prefill_replicas: int = 1
     decode_replicas: int = 1
-    kv_transfer: str = "object"
+    kv_transfer: str = "stream"
     # object mode: blobs at or under this many bytes fall back to the
     # decode replica's DistChannel when one is available
     small_blob_bytes: int = 262144
@@ -117,8 +123,24 @@ class DisaggConfig:
     # STRICT_SPREAD placement group; falls back to soft SPREAD when the
     # cluster has too few hosts (e.g. single-host CPU tests)
     strict_spread: bool = True
+    # stream mode: tokens per KV frame (smaller = earlier overlap, more
+    # frames), frames coalesced per destination up to this many bytes
+    # per channel put, per-frame idle timeout before the importer aborts
+    # (a dead prefill must fail the request, never hang it), and how
+    # long the decode inbox parks unclaimed frames before sweeping them
+    kv_stream_tokens: int = 256
+    kv_coalesce_bytes: int = 1 << 20
+    kv_stream_idle_s: float = 30.0
+    kv_inbox_ttl_s: float = 120.0
+    # prefix-aware role routing: a request whose leading prompt pages
+    # are warm on a decode replica (per its PrefixCache digest, gossiped
+    # every prefix_gossip_s) runs there directly — no prefill hop, no
+    # migration — once at least prefix_route_min_tokens are warm
+    prefix_routing: bool = True
+    prefix_route_min_tokens: int = 32
+    prefix_gossip_s: float = 2.0
 
-    TRANSFERS = ("object", "channel")
+    TRANSFERS = ("object", "channel", "stream")
 
     def __post_init__(self) -> None:
         if self.kv_transfer not in self.TRANSFERS:
@@ -133,6 +155,26 @@ class DisaggConfig:
         if int(self.small_blob_bytes) < 0:
             raise ValueError(
                 f"small_blob_bytes must be >= 0, got {self.small_blob_bytes}")
+        if int(self.kv_stream_tokens) < 1:
+            raise ValueError(
+                f"kv_stream_tokens must be >= 1, got {self.kv_stream_tokens}")
+        if int(self.kv_coalesce_bytes) < 0:
+            raise ValueError(
+                f"kv_coalesce_bytes must be >= 0, "
+                f"got {self.kv_coalesce_bytes}")
+        if float(self.kv_stream_idle_s) <= 0:
+            raise ValueError(
+                f"kv_stream_idle_s must be > 0, got {self.kv_stream_idle_s}")
+        if float(self.kv_inbox_ttl_s) <= 0:
+            raise ValueError(
+                f"kv_inbox_ttl_s must be > 0, got {self.kv_inbox_ttl_s}")
+        if int(self.prefix_route_min_tokens) < 1:
+            raise ValueError(
+                f"prefix_route_min_tokens must be >= 1, "
+                f"got {self.prefix_route_min_tokens}")
+        if float(self.prefix_gossip_s) < 0:
+            raise ValueError(
+                f"prefix_gossip_s must be >= 0, got {self.prefix_gossip_s}")
 
     @classmethod
     def parse(cls, value) -> "DisaggConfig":

@@ -16,10 +16,19 @@ Pieces:
   `EngineWorker`s (in-process engines: tier-1 tests, bench) or
   `ReplicaWorker`s wrapping serve replica actors (from_deployments /
   deploy_disagg).
-- KV transfer — `api.put` + pull-through GET on the object plane by
-  default; blobs at or under DisaggConfig.small_blob_bytes fall back to
-  a consumer-homed `DistChannel` advertised by the decode replica
-  (`KvInbox`), or every blob with kv_transfer="channel".
+- KV transfer — kv_transfer="stream" (the default) pipelines page-window
+  KV frames to the decode replica's `KvInbox` over a persistent
+  per-replica-pair `DistChannel` AS PREFILL COMMITS PAGES (frames
+  coalesced per destination by `_KvSender`), and the decode engine
+  ingests them eagerly (begin/ingest/finish_kv_import) — migration
+  overlaps prefill compute instead of starting after the first token.
+  kv_transfer="object" is `api.put` + pull-through GET on the object
+  plane; blobs at or under DisaggConfig.small_blob_bytes fall back to
+  the decode replica's channel, or every blob with kv_transfer="channel".
+- Prefix-aware role routing — requests whose leading prompt pages are
+  warm on a decode replica (matched against its PrefixCache digest,
+  cached per replica for prefix_gossip_s) run there directly: no
+  prefill hop, no migration at all.
 - `deploy_disagg` — two role deployments (`{name}-prefill`,
   `{name}-decode`) placed on distinct hosts via a STRICT_SPREAD
   placement group (soft SPREAD fallback on small clusters), returning a
@@ -32,10 +41,12 @@ serve_disagg_inflight{role} (admission pressure per role).
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import api
 from ..core.health import ReplicaHealth
@@ -43,7 +54,7 @@ from ..core.logging import get_logger
 from ..core.metrics import MICRO_BUCKETS, Counter, Gauge, Histogram
 from ..util import slo, tracing
 from .config import DisaggConfig
-from .engine import InferenceEngine, Request
+from .engine import InferenceEngine, Request, prompt_page_fingerprints
 from .router import _replica_key, pow2_choice
 
 logger = get_logger("serve.disagg")
@@ -86,35 +97,92 @@ def _norm_request(request: Dict[str, Any]) -> Dict[str, Any]:
 # --------------------------------------------------------------------------
 
 
+class KvMigrationError(RuntimeError):
+    """The streamed KV migration died mid-flight: the prefill replica
+    failed or vanished, or the stream went idle past kv_stream_idle_s.
+    The import is torn down cleanly (pages freed, inbox evicted) before
+    this raises — the disagg analogue of the pipeline trainer's
+    PipelineStallError."""
+
+
 class KvInbox:
     """The decode replica's channel-transfer ingest: one consumer-homed
-    DistChannel per process, demultiplexing (request_id, blob) frames
-    onto per-request waiters — frames from concurrent prefills may
-    interleave in any order."""
+    DistChannel per process, demultiplexing (request_id, item) frames
+    onto per-request waiters — items from concurrent prefills may
+    interleave in any order. An item is either a one-shot KV blob
+    (legacy object/channel transports) or one streamed frame; each
+    request's items queue in arrival order.
 
-    def __init__(self, maxsize: int = 16):
+    Hygiene: cancel() evicts a request's parked items and drops its late
+    arrivals (a request cancelled between prefill and ingest used to
+    leak its blob here forever), and every drain pass sweeps items
+    nobody claimed within ttl_s."""
+
+    def __init__(self, maxsize: int = 64, ttl_s: float = 120.0):
         from ..core import channels
 
         addr = channels.service_address() or channels.ensure_service()
         self.channel = channels.DistChannel(addr, maxsize=maxsize)
+        self.ttl_s = float(ttl_s)
         self._cv = threading.Condition()
-        self._parked: Dict[str, Any] = {}
+        self._parked: Dict[str, deque] = {}
+        self._stamped: Dict[str, float] = {}  # rid -> last arrival
+        self._dead: Dict[str, float] = {}  # cancelled rid -> forget-at
         self._draining = False
 
-    def take(self, request_id: str, timeout: float = 120.0) -> Any:
-        """Block until this request's blob arrives. Exactly one thread
-        drains the channel at a time; others wait on the condition for
-        their frame to be parked."""
+    def cancel(self, request_id: str, linger_s: float = 30.0) -> None:
+        """Evict a cancelled request's parked items NOW and drop its
+        late-arriving frames for linger_s (the in-flight tail of a
+        stream whose consumer just gave up)."""
+        with self._cv:
+            self._parked.pop(request_id, None)
+            self._stamped.pop(request_id, None)
+            self._dead[request_id] = time.monotonic() + linger_s
+            self._cv.notify_all()
+
+    def parked(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._parked.values())
+
+    def _sweep(self) -> None:
+        # caller holds _cv: drop unclaimed requests past ttl_s and
+        # expired dead-marks (bounded: one dict pass per drain)
+        now = time.monotonic()
+        for rid, t in list(self._stamped.items()):
+            if now - t > self.ttl_s:
+                self._parked.pop(rid, None)
+                self._stamped.pop(rid, None)
+        for rid, t in list(self._dead.items()):
+            if now > t:
+                self._dead.pop(rid, None)
+
+    def _park(self, item) -> None:
+        # caller holds _cv
+        rid = item[0]
+        if rid in self._dead:
+            return
+        self._parked.setdefault(rid, deque()).append(item[1])
+        self._stamped[rid] = time.monotonic()
+
+    def _next(self, request_id: str, timeout: float, what: str) -> Any:
+        """Block until this request's next item arrives. Exactly one
+        thread drains the channel at a time; others wait on the
+        condition for their items to be parked."""
         import queue as _queue
 
         deadline = time.monotonic() + timeout
         while True:
             with self._cv:
-                if request_id in self._parked:
-                    return self._parked.pop(request_id)
+                q = self._parked.get(request_id)
+                if q:
+                    out = q.popleft()
+                    if not q:
+                        self._parked.pop(request_id, None)
+                        self._stamped.pop(request_id, None)
+                    return out
                 if time.monotonic() >= deadline:
                     raise TimeoutError(
-                        f"KV blob for {request_id} not received in {timeout}s")
+                        f"{what} for {request_id} not received in {timeout}s")
                 if self._draining:
                     self._cv.wait(timeout=0.25)
                     continue
@@ -128,30 +196,113 @@ class KvInbox:
                 with self._cv:
                     self._draining = False
                     if item is not None:
-                        self._parked[item[0]] = item[1]
+                        self._park(item)
+                    self._sweep()
                     self._cv.notify_all()
+
+    def take(self, request_id: str, timeout: float = 120.0) -> Any:
+        """One-shot transports: block until this request's blob arrives."""
+        return self._next(request_id, timeout, "KV blob")
+
+    def next_chunk(self, request_id: str, timeout: float = 30.0) -> Any:
+        """Streamed transport: block until the request's next frame."""
+        return self._next(request_id, timeout, "KV frame")
+
+
+class _KvSender:
+    """Persistent per-destination KV frame pump: engine kv_sink
+    callables enqueue (request_id, frame) pairs here, and ONE thread per
+    destination channel drains them, coalescing everything pending (up
+    to coalesce_bytes) into a single channel put_many — one wire frame
+    per batch to a remote decode replica, a plain enqueue loop locally.
+    Prefill threads therefore never block on the wire; a dead
+    destination surfaces on the NEXT send (failing that request), while
+    the decode side times out on its idle window."""
+
+    def __init__(self, channel, coalesce_bytes: int = 1 << 20):
+        self.channel = channel
+        self.coalesce = max(0, int(coalesce_bytes))
+        self._q: "queue.Queue" = queue.Queue(maxsize=512)
+        self.error: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"kv-sender-{channel.chan_id[:8]}")
+        self._thread.start()
+
+    def send(self, request_id: str, frame: Dict[str, Any]) -> None:
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        self._q.put((request_id, frame), timeout=60.0)
+
+    @staticmethod
+    def _nbytes(frame: Dict[str, Any]) -> int:
+        k = frame.get("k")
+        v = frame.get("v")
+        return (int(getattr(k, "nbytes", 0) or 0)
+                + int(getattr(v, "nbytes", 0) or 0))
+
+    def _run(self) -> None:
+        import queue as _queue
+
+        while True:
+            item = self._q.get()
+            batch = [item]
+            nbytes = self._nbytes(item[1])
+            while nbytes < self.coalesce:
+                try:
+                    nxt = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                batch.append(nxt)
+                nbytes += self._nbytes(nxt[1])
+            try:
+                self.channel.put_many(batch, timeout=_KV_SEND_TIMEOUT_S)
+            except Exception as e:  # noqa: BLE001 — poison the sender
+                self.error = f"kv stream send failed: {e!r}"
+                logger.warning("kv sender for %s died: %s",
+                               self.channel.chan_id[:8], self.error)
+                return
+
+
+_KV_SEND_TIMEOUT_S = 120.0
+_kv_senders: Dict[Tuple[str, str], _KvSender] = {}
+_kv_senders_lock = threading.Lock()
+
+
+def _sender_for(channel, coalesce_bytes: int) -> _KvSender:
+    """The process-wide sender for a destination channel (persistent
+    per replica pair); a poisoned sender is replaced on next use."""
+    key = (channel.owner_addr, channel.chan_id)
+    with _kv_senders_lock:
+        s = _kv_senders.get(key)
+        if s is None or s.error is not None:
+            s = _kv_senders[key] = _KvSender(channel, coalesce_bytes)
+        return s
 
 
 def replica_prefill(engine: InferenceEngine,
                     request: Dict[str, Any]) -> Dict[str, Any]:
-    """Prefill-role entry: run a prefill_only request, export its KV,
-    and stage the blob for the decode side. The transfer decision lives
-    HERE because only the exporter knows the blob size: object plane by
-    default, DistChannel when kv_transfer=="channel" or the blob is at
-    or under small_blob_bytes and a destination channel was provided."""
+    """Prefill-role entry: run a prefill_only request and hand its KV to
+    the decode side. kv_transfer=="stream" (with a destination channel)
+    pipelines frames DURING prefill; otherwise the transfer decision
+    lives HERE because only the exporter knows the blob size: object
+    plane by default, DistChannel when kv_transfer=="channel" or the
+    blob is at or under small_blob_bytes and a destination was given."""
     opts = _norm_request(request)
+    kv_dest = request.get("kv_dest")
+    if request.get("kv_transfer") == "stream" and kv_dest is not None:
+        return _prefill_streamed(engine, request, opts, kv_dest)
     with tracing.span_if_traced(
-            "prefill", {"request_id": opts["request_id"]},
+            "disagg.prefill", {"request_id": opts["request_id"]},
             context=request.get("trace_ctx")):
         req = Request(prefill_only=True, **opts)
         engine.add_request(req)
         blob = engine.export_kv_pages(
             req, timeout_s=float(request.get("timeout_s", 600.0)))
         nbytes = int(blob["k"].nbytes) + int(blob["v"].nbytes)
-        kv_dest = request.get("kv_dest")
         kv_transfer = request.get("kv_transfer", "object")
         small = int(request.get("small_blob_bytes", 0))
-        with tracing.span_if_traced("kv_export", {"bytes": nbytes}):
+        with tracing.span_if_traced("disagg.kv_export", {"bytes": nbytes}):
             if kv_dest is not None and (
                     kv_transfer == "channel" or nbytes <= small):
                 kv_dest.put((req.request_id, blob))
@@ -168,6 +319,74 @@ def replica_prefill(engine: InferenceEngine,
     }
 
 
+def _prefill_streamed(engine: InferenceEngine, request: Dict[str, Any],
+                      opts: Dict[str, Any], kv_dest) -> Dict[str, Any]:
+    """Streamed prefill: the engine pushes page-window KV frames to the
+    per-destination sender AS IT COMMITS PAGES, so migration overlaps
+    prefill compute. The kv_export span is built manually: the sink
+    fires on engine threads where this thread's trace-local is
+    invisible."""
+    rid = opts["request_id"]
+    timeout = float(request.get("timeout_s", 600.0))
+    sender = _sender_for(kv_dest,
+                         int(request.get("kv_coalesce_bytes", 1 << 20)))
+    sent = {"bytes": 0, "frames": 0}
+
+    def sink(frame: Dict[str, Any]) -> None:
+        sent["bytes"] += _KvSender._nbytes(frame)
+        sent["frames"] += 1
+        sender.send(rid, frame)
+
+    with tracing.span_if_traced(
+            "disagg.prefill", {"request_id": rid, "stream": True},
+            context=request.get("trace_ctx")):
+        cur = tracing.current_span()
+        xattrs = {"request_id": rid, "stream": True}
+        xspan = None
+        if cur is not None:
+            # covers admission through the last frame (finished below) —
+            # the export leg of the overlap evidence
+            xspan = tracing.Span("disagg.kv_export", attrs=xattrs,
+                                 trace_id=cur.trace_id,
+                                 parent_id=cur.span_id)
+        req = Request(
+            prefill_only=True, kv_sink=sink,
+            kv_window=int(request.get("kv_stream_tokens", 256)), **opts)
+        engine.add_request(req)
+        done = req.done.wait(timeout)
+        if xspan is not None:
+            xattrs.update(bytes=sent["bytes"], frames=sent["frames"])
+            xspan.finish()
+        if not done:
+            engine.cancel(req.request_id)
+            _push_error_frame(kv_dest, rid,
+                              f"prefill for {rid} timed out after {timeout}s")
+            raise TimeoutError(f"request {rid} timed out")
+        if req.error:
+            # unblock the eager importer NOW instead of letting it wait
+            # out its idle window
+            _push_error_frame(kv_dest, rid, req.error)
+            raise ValueError(req.error)
+    return {
+        "request_id": rid,
+        "first_token": int(req.output[-1]) if req.output else -1,
+        "ttft_s": (req.first_token_at or 0) - req.submitted_at,
+        "prefill_s": (req.finished_at or 0) - req.submitted_at,
+        "kv": {"kind": "stream", "bytes": sent["bytes"],
+               "frames": sent["frames"]},
+    }
+
+
+def _push_error_frame(kv_dest, request_id: str, error: str) -> None:
+    """Best-effort poison frame so the decode-side importer fails fast
+    instead of idling out."""
+    try:
+        kv_dest.put((request_id, {"request_id": request_id, "error": error}),
+                    timeout=5.0)
+    except Exception:  # noqa: BLE001 — importer still has its idle timeout
+        pass
+
+
 def _fetch_blob(request: Dict[str, Any],
                 inbox: Optional[KvInbox]) -> Dict[str, Any]:
     handoff = request["kv"]
@@ -180,23 +399,98 @@ def _fetch_blob(request: Dict[str, Any],
     return inbox.take(request["request_id"], timeout=timeout)
 
 
+def _import_streamed(engine: InferenceEngine, request: Dict[str, Any],
+                     inbox: KvInbox, stream: bool) -> Request:
+    """Eager streamed import: begin on frame 0, ingest every frame as it
+    arrives, finalize on the last — so the kv_migration span OPENS while
+    prefill is still computing (the overlap the stream transport is
+    for). A dead stream (idle past kv_stream_idle_s, or a poison frame
+    from a failed prefill) tears the import down cleanly — pages freed,
+    inbox evicted — and raises KvMigrationError instead of hanging.
+
+    migration_s accounting: the span records WALL time (it deliberately
+    overlaps prefill — that overlap is the trace evidence), but the
+    reported migration_s / serve_kv_migration_seconds count only ACTIVE
+    import work (begin + per-frame ingest + finalize). Time spent
+    waiting for the next frame is prefill/queueing time the request
+    would pay anyway; billing it to migration made the metric explode
+    with queue depth while the actual transfer tax stayed flat."""
+    rid = request["request_id"]
+    idle = float(request.get("kv_stream_idle_s", 30.0))
+    opts = _norm_request(request)
+    req = Request(stream_q=queue.Queue() if stream else None, **opts)
+    total = 0
+    frames = 0
+    begun = False
+    active = 0.0
+    try:
+        with tracing.span_if_traced("disagg.kv_migration",
+                                    {"transport": "stream"}) as mspan:
+            while True:
+                frame = inbox.next_chunk(rid, timeout=idle)
+                if "error" in frame:
+                    raise KvMigrationError(
+                        f"kv stream for {rid} failed upstream: "
+                        f"{frame['error']}")
+                ta = time.monotonic()
+                if not begun:
+                    # frame 0 carries the blob metadata begin needs
+                    if not engine.begin_kv_import(
+                            req, int(frame["true_len"]), frame):
+                        raise KvMigrationError(
+                            req.error or f"kv import rejected for {rid}")
+                    begun = True
+                engine.ingest_kv_chunk(req, frame)
+                active += time.monotonic() - ta
+                total += _KvSender._nbytes(frame)
+                frames += 1
+                if frame.get("last"):
+                    first = int(frame["first_token"])
+                    break
+            if mspan is not None:
+                mspan.attrs.update(bytes=total, frames=frames)
+            with tracing.span_if_traced("disagg.kv_import"):
+                ta = time.monotonic()
+                engine.finish_kv_import(req, first)
+                active += time.monotonic() - ta
+    except BaseException as e:
+        inbox.cancel(rid)
+        engine.abort_kv_import(
+            req, error=f"kv stream import failed: {e}")
+        if isinstance(e, (KvMigrationError, KeyboardInterrupt, SystemExit)):
+            raise
+        raise KvMigrationError(
+            f"kv stream for {rid} died mid-transfer: {e}") from e
+    tags = {"transport": "stream"}
+    _m_migration_s.observe(active, tags=tags)
+    _m_migration_b.inc(total, tags=tags)
+    if getattr(engine, "_slo_on", False):
+        slo.observe("serve_kv_migration_seconds", active, tags=tags)
+    req._migration_s = active
+    request["kv"]["bytes"] = total  # the importer is who knows the size
+    return req
+
+
 def _import_request(engine: InferenceEngine, request: Dict[str, Any],
                     inbox: Optional[KvInbox],
                     stream: bool = False) -> Request:
-    """Decode-role entry: fetch the blob, import it, observe the
-    migration tax. Returns the live engine request."""
-    import queue as _queue
-
+    """Decode-role entry: fetch the blob (or drain the stream), import
+    it, observe the migration tax. Returns the live engine request."""
     handoff = request["kv"]
+    if handoff["kind"] == "stream":
+        if inbox is None:
+            raise ValueError(
+                "stream handoff but this replica has no KV inbox")
+        return _import_streamed(engine, request, inbox, stream)
     t0 = time.monotonic()
     with tracing.span_if_traced(
-            "kv_migration",
+            "disagg.kv_migration",
             {"transport": handoff["kind"],
              "bytes": int(handoff.get("bytes", 0))}):
         blob = _fetch_blob(request, inbox)
     opts = _norm_request(request)
-    req = Request(stream_q=_queue.Queue() if stream else None, **opts)
-    with tracing.span_if_traced("kv_import"):
+    req = Request(stream_q=queue.Queue() if stream else None, **opts)
+    with tracing.span_if_traced("disagg.kv_import"):
         engine.import_kv_pages(req, blob)
     elapsed = time.monotonic() - t0
     tags = {"transport": handoff["kind"]}
@@ -211,7 +505,7 @@ def _import_request(engine: InferenceEngine, request: Dict[str, Any],
 def replica_decode(engine: InferenceEngine, request: Dict[str, Any],
                    inbox: Optional[KvInbox] = None) -> Dict[str, Any]:
     with tracing.span_if_traced(
-            "decode", {"request_id": request.get("request_id", "")},
+            "disagg.decode", {"request_id": request.get("request_id", "")},
             context=request.get("trace_ctx")):
         req = _import_request(engine, request, inbox)
         timeout = float(request.get("timeout_s", 600.0))
@@ -242,8 +536,9 @@ def replica_decode_stream(engine: InferenceEngine, request: Dict[str, Any],
         # manual span: decode covers import through stream exhaustion, so
         # it must outlive this call and finish when the generator does
         span = tracing.Span(
-            "decode", attrs={"request_id": request.get("request_id", ""),
-                             "stream": True},
+            "disagg.decode",
+            attrs={"request_id": request.get("request_id", ""),
+                   "stream": True},
             **({"trace_id": ctx["trace_id"], "parent_id": ctx["span_id"]}
                if ctx is not None else
                {"trace_id": tracing.current_span().trace_id,
@@ -265,6 +560,69 @@ def replica_decode_stream(engine: InferenceEngine, request: Dict[str, Any],
                 "migration_s": req._migration_s,
                 "migration_bytes": int(request["kv"].get("bytes", 0)),
                 "kv_transport": request["kv"]["kind"],
+            }
+        finally:
+            if span is not None:
+                span.finish()
+
+    return gen()
+
+
+def replica_generate(engine: InferenceEngine,
+                     request: Dict[str, Any]) -> Dict[str, Any]:
+    """Prefix-routed entry: the full request runs HERE because its
+    leading prompt pages are already warm in this replica's PrefixCache
+    — no prefill hop, no migration."""
+    opts = _norm_request(request)
+    with tracing.span_if_traced(
+            "disagg.decode", {"request_id": opts["request_id"],
+                              "routed": "prefix"},
+            context=request.get("trace_ctx")):
+        res = engine.generate(
+            opts["prompt"], max_tokens=opts["max_tokens"],
+            temperature=opts["temperature"],
+            request_id=opts["request_id"],
+            timeout_s=float(request.get("timeout_s", 600.0)),
+            top_p=opts["top_p"], top_k=opts["top_k"], stop=opts["stop"])
+    return {**res, "migration_s": 0.0, "migration_bytes": 0,
+            "kv_transport": "skipped"}
+
+
+def replica_generate_stream(engine: InferenceEngine,
+                            request: Dict[str, Any]):
+    """Streaming variant of replica_generate: yields token ids, then the
+    same trailing summary dict replica_decode_stream emits."""
+    opts = _norm_request(request)
+    ctx = request.get("trace_ctx")
+    span = None
+    if ctx is not None or tracing.current_span() is not None:
+        cur = tracing.current_span()
+        span = tracing.Span(
+            "disagg.decode",
+            attrs={"request_id": opts["request_id"], "stream": True,
+                   "routed": "prefix"},
+            **({"trace_id": ctx["trace_id"], "parent_id": ctx["span_id"]}
+               if ctx is not None else
+               {"trace_id": cur.trace_id, "parent_id": cur.span_id}))
+    req, inner = engine.open_stream(
+        opts["prompt"], max_tokens=opts["max_tokens"],
+        temperature=opts["temperature"], request_id=opts["request_id"],
+        timeout_s=float(request.get("timeout_s", 600.0)),
+        top_p=opts["top_p"], top_k=opts["top_k"], stop=opts["stop"])
+
+    def gen():
+        err = None
+        try:
+            try:
+                yield from inner
+            except ValueError as e:
+                err = str(e)
+            yield {
+                "finish_reason": req.finish_reason,
+                "error": err or req.error,
+                "migration_s": 0.0,
+                "migration_bytes": 0,
+                "kv_transport": "skipped",
             }
         finally:
             if span is not None:
@@ -307,11 +665,15 @@ class EngineWorker(_LoadTracker):
         self._inbox: Optional[KvInbox] = None
         self._inbox_lock = threading.Lock()
 
-    def kv_dest(self):
+    def kv_dest(self, ttl_s: Optional[float] = None):
         with self._inbox_lock:
             if self._inbox is None:
-                self._inbox = KvInbox()
+                self._inbox = KvInbox(
+                    ttl_s=ttl_s if ttl_s is not None else 120.0)
             return self._inbox.channel
+
+    def prefix_digest(self) -> Dict[str, Any]:
+        return self.engine.prefix_digest()
 
     def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
@@ -340,8 +702,31 @@ class EngineWorker(_LoadTracker):
 
         return gen()
 
+    def generate_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        self._begin()
+        try:
+            return replica_generate(self.engine, request)
+        finally:
+            self._end()
+
+    def generate_stream(self, request: Dict[str, Any]):
+        self._begin()
+
+        def gen():
+            try:
+                yield from replica_generate_stream(self.engine, request)
+            finally:
+                self._end()
+
+        return gen()
+
     def cancel(self, request_id: str) -> bool:
-        return self.engine.cancel(request_id)
+        hit = self.engine.cancel(request_id)
+        if self._inbox is not None:
+            # a blob/stream parked (or still in flight) for this request
+            # must not outlive it — the leak the inbox sweeps guard
+            self._inbox.cancel(request_id)
+        return hit
 
 
 class ReplicaWorker(_LoadTracker):
@@ -355,16 +740,25 @@ class ReplicaWorker(_LoadTracker):
         self._replica = replica
         self.key = _replica_key(replica)
         self._kv_dest = None
+        self._kv_dest_lock = threading.Lock()
 
     def _call(self, method: str, request: Dict[str, Any],
               timeout: float) -> Any:
         ref = self._replica.handle_request.remote(method, (request,), {}, "")
         return api.get(ref, timeout=timeout)
 
-    def kv_dest(self):
-        if self._kv_dest is None:
-            self._kv_dest = self._call("kv_ingest", {}, 30.0)
-        return self._kv_dest
+    def kv_dest(self, ttl_s: Optional[float] = None):
+        # serialize the first fetch: kv_ingest is idempotent replica-side,
+        # but concurrent fetchers would still each pay the round trip
+        with self._kv_dest_lock:
+            if self._kv_dest is None:
+                req = {} if ttl_s is None else \
+                    {"kv_inbox_ttl_s": float(ttl_s)}
+                self._kv_dest = self._call("kv_ingest", req, 30.0)
+            return self._kv_dest
+
+    def prefix_digest(self) -> Dict[str, Any]:
+        return self._call("prefix_digest", {}, 30.0)
 
     def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
@@ -386,6 +780,31 @@ class ReplicaWorker(_LoadTracker):
         self._begin()
         try:
             inner = self._call("decode_stream", request,
+                               float(request.get("timeout_s", 600.0)) + 30.0)
+        except BaseException:
+            self._end()
+            raise
+
+        def gen():
+            try:
+                yield from inner
+            finally:
+                self._end()
+
+        return gen()
+
+    def generate_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        self._begin()
+        try:
+            return self._call("generate_request", request,
+                              float(request.get("timeout_s", 600.0)) + 30.0)
+        finally:
+            self._end()
+
+    def generate_stream(self, request: Dict[str, Any]):
+        self._begin()
+        try:
+            inner = self._call("generate_stream", request,
                                float(request.get("timeout_s", 600.0)) + 30.0)
         except BaseException:
             self._end()
@@ -455,6 +874,13 @@ class DisaggCoordinator:
         }
         self._lock = threading.Lock()
         self._live: Dict[str, Any] = {}  # request_id -> (pworker, dworker)
+        # per-replica-identity caches, invalidated on membership change
+        # (_sync): the decode replica's KV destination channel (resolving
+        # it is a round-trip to the replica — once per replica lifetime,
+        # not once per request) and its prefix-cache digest (refreshed
+        # every prefix_gossip_s)
+        self._kv_dest_cache: Dict[Any, Any] = {}
+        self._prefix_digests: Dict[Any, Tuple[float, Any]] = {}
         # serve mode (from_deployments): re-synced against the controller
         self._deployments: Optional[Dict[str, str]] = None
         self._controller = None
@@ -523,6 +949,13 @@ class DisaggCoordinator:
                     cur.get(_replica_key(r)) or ReplicaWorker(r)
                     for r in replicas
                 ]
+                # drop per-identity caches for replicas that went away —
+                # a replaced replica gets a fresh kv_dest / digest on its
+                # next use instead of a stale channel to a dead process
+                gone = set(cur) - {w.key for w in self._workers[role]}
+                for key in gone:
+                    self._kv_dest_cache.pop(key, None)
+                    self._prefix_digests.pop(key, None)
 
     # -------------------------------------------------------------- picks
 
@@ -549,6 +982,74 @@ class DisaggCoordinator:
         finally:
             _m_queue_depth.add(-1, tags={"role": role})
 
+    def _kv_dest_for(self, worker):
+        """The decode replica's KV channel, resolved ONCE per replica
+        identity (not per request, not per resync) and dropped by _sync
+        when the replica leaves the membership."""
+        with self._lock:
+            dest = self._kv_dest_cache.get(worker.key)
+        if dest is None:
+            dest = worker.kv_dest(self.cfg.kv_inbox_ttl_s)
+            with self._lock:
+                self._kv_dest_cache[worker.key] = dest
+        return dest
+
+    def _prefix_digest_for(self, worker):
+        """The decode replica's prefix-cache digest, refreshed at most
+        every prefix_gossip_s (0 = every request). A digest fetch that
+        fails caches None — the replica just doesn't attract routes
+        until the next refresh."""
+        now = time.monotonic()
+        with self._lock:
+            hit = self._prefix_digests.get(worker.key)
+        if hit is not None and (self.cfg.prefix_gossip_s > 0
+                                and now - hit[0] < self.cfg.prefix_gossip_s):
+            return hit[1]
+        try:
+            digest = worker.prefix_digest()
+        except Exception:  # noqa: BLE001 — replica mid-death; skip it
+            digest = None
+        with self._lock:
+            self._prefix_digests[worker.key] = (now, digest)
+        return digest
+
+    def _prefix_route(self, base: Dict[str, Any]):
+        """Prefix-aware role routing: if some decode replica already
+        holds the request's leading prompt pages warm (per its gossiped
+        PrefixCache digest), return (worker, warm_tokens) so the request
+        runs there directly — skipping prefill AND migration. None when
+        routing is off or nothing is warm enough."""
+        if not self.cfg.prefix_routing:
+            return None
+        prompt = base["prompt_ids"]
+        with self._lock:
+            workers = list(self._workers["decode"])
+        if not workers:
+            return None
+        elig = self.health.eligible([w.key for w in workers])
+        cand = [w for w in workers if w.key in elig] or workers
+        fps_by_ps: Dict[int, List[str]] = {}
+        best, best_tokens = None, 0
+        for w in cand:
+            digest = self._prefix_digest_for(w)
+            if not digest or not digest.get("hashes"):
+                continue
+            ps = int(digest["page_size"])
+            if ps not in fps_by_ps:
+                fps_by_ps[ps] = prompt_page_fingerprints(prompt, ps)
+            fps = fps_by_ps[ps]
+            warm = set(digest["hashes"])
+            n = 0
+            for fp in fps:
+                if fp not in warm:
+                    break
+                n += 1
+            if n * ps > best_tokens:
+                best, best_tokens = w, n * ps
+        if best is not None and best_tokens >= self.cfg.prefix_route_min_tokens:
+            return best, best_tokens
+        return None
+
     def _base_request(self, prompt, max_tokens, temperature, top_p, top_k,
                       stop, request_id, timeout_s) -> Dict[str, Any]:
         return {
@@ -562,6 +1063,9 @@ class DisaggCoordinator:
             "timeout_s": float(timeout_s),
             "kv_transfer": self.cfg.kv_transfer,
             "small_blob_bytes": self.cfg.small_blob_bytes,
+            "kv_stream_tokens": self.cfg.kv_stream_tokens,
+            "kv_coalesce_bytes": self.cfg.kv_coalesce_bytes,
+            "kv_stream_idle_s": self.cfg.kv_stream_idle_s,
             # None when untraced: replicas skip all span work on that path
             "trace_ctx": tracing.current_context(),
         }
@@ -570,7 +1074,7 @@ class DisaggCoordinator:
                      dworker) -> Dict[str, Any]:
         kv_dest = None
         if self.cfg.kv_transfer == "channel" or self.cfg.small_blob_bytes > 0:
-            kv_dest = dworker.kv_dest()
+            kv_dest = self._kv_dest_for(dworker)
         pworker = self._pick("prefill", deadline)
         self._live[base["request_id"]] = (pworker, dworker)
         t0 = time.monotonic()
@@ -584,7 +1088,87 @@ class DisaggCoordinator:
                             role="prefill")
         return res
 
+    def _spawn_prefill(self, base: Dict[str, Any], deadline: float,
+                       dworker, kv_dest):
+        """Stream mode: launch the prefill leg on its own thread so the
+        decode-side eager import runs CONCURRENTLY (that concurrency IS
+        the overlap). Returns (thread, box); box['res'] or box['err']
+        is set when the leg finishes. A failed prefill also poisons the
+        stream so the importer fails fast instead of idling out."""
+        pworker = self._pick("prefill", deadline)
+        self._live[base["request_id"]] = (pworker, dworker)
+        ctx = tracing.current_context()
+        box: Dict[str, Any] = {}
+
+        def run():
+            t0 = time.monotonic()
+            try:
+                with tracing.activate(ctx):
+                    with _m_inflight.track(tags={"role": "prefill"}):
+                        box["res"] = pworker.prefill_request(
+                            {**base, "kv_dest": kv_dest})
+                self.health.observe(pworker.key, time.monotonic() - t0,
+                                    role="prefill")
+            except BaseException as e:  # noqa: BLE001 — reported via box
+                box["err"] = e
+                self.health.record_error(pworker.key)
+                _push_error_frame(kv_dest, base["request_id"], str(e))
+
+        t = threading.Thread(
+            target=run, daemon=True,
+            name=f"disagg-prefill-{base['request_id'][:8]}")
+        t.start()
+        return t, box
+
     # ---------------------------------------------------------- blocking
+
+    def _generate_streamed(self, base: Dict[str, Any], deadline: float,
+                           dworker) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Stream transport: prefill runs on a side thread pushing KV
+        frames while THIS thread blocks in the decode replica's eager
+        import — the two legs overlap by construction. Returns
+        (decode result, prefill result)."""
+        kv_dest = self._kv_dest_for(dworker)
+        pt, pbox = self._spawn_prefill(base, deadline, dworker, kv_dest)
+        td = time.monotonic()
+        try:
+            with _m_inflight.track(tags={"role": "decode"}):
+                dres = dworker.decode_request(
+                    {**base, "kv": {"kind": "stream"}})
+        except BaseException as e:
+            self.health.record_error(dworker.key)
+            pt.join(timeout=30.0)
+            if "err" in pbox:
+                # the decode-side failure is downstream of the prefill
+                # leg dying — surface the root cause
+                raise pbox["err"] from e
+            raise
+        self.health.observe(dworker.key, time.monotonic() - td,
+                            role="decode")
+        pt.join(timeout=30.0)
+        if "err" in pbox:
+            raise pbox["err"]
+        pres = pbox.get("res") or {"ttft_s": 0.0, "prefill_s": 0.0,
+                                   "kv": {"kind": "stream"}}
+        return dres, pres
+
+    def _generate_routed(self, base: Dict[str, Any], dworker,
+                         warm: int) -> Dict[str, Any]:
+        """Prefix-routed: the whole request runs on the decode replica
+        whose cache is warm — no prefill leg at all."""
+        with tracing.span_if_traced(
+                "disagg.route", {"prefix_warm_tokens": warm,
+                                 "replica": str(dworker.key)}):
+            td = time.monotonic()
+            try:
+                with _m_inflight.track(tags={"role": "decode"}):
+                    dres = dworker.generate_request(base)
+            except BaseException:
+                self.health.record_error(dworker.key)
+                raise
+            self.health.observe(dworker.key, time.monotonic() - td,
+                                role="decode")
+        return dres
 
     def generate(self, prompt: List[int], max_tokens: int = 32,
                  temperature: float = 0.0, top_p: float = 1.0,
@@ -596,19 +1180,39 @@ class DisaggCoordinator:
                                       top_k, stop, request_id, timeout_s)
             t0 = time.monotonic()
             deadline = t0 + timeout_s
+            routed = self._prefix_route(base)
             try:
+                if routed is not None:
+                    dworker, warm = routed
+                    self._live[base["request_id"]] = (dworker,)
+                    dres = self._generate_routed(base, dworker, warm)
+                    return {
+                        "request_id": base["request_id"],
+                        "token_ids": dres["token_ids"],
+                        "finish_reason": dres["finish_reason"],
+                        "ttft_s": dres.get("ttft_s", 0.0),
+                        "latency_s": time.monotonic() - t0,
+                        "migration_s": 0.0,
+                        "migration_bytes": 0,
+                        "kv_transport": "skipped",
+                        "prefix_warm_tokens": warm,
+                    }
                 dworker = self._pick("decode", deadline)
-                pres = self._run_prefill(base, deadline, dworker)
-                td = time.monotonic()
-                try:
-                    with _m_inflight.track(tags={"role": "decode"}):
-                        dres = dworker.decode_request(
-                            {**base, "kv": pres["kv"]})
-                except BaseException:
-                    self.health.record_error(dworker.key)
-                    raise
-                self.health.observe(dworker.key, time.monotonic() - td,
-                                    role="decode")
+                if self.cfg.kv_transfer == "stream":
+                    dres, pres = self._generate_streamed(
+                        base, deadline, dworker)
+                else:
+                    pres = self._run_prefill(base, deadline, dworker)
+                    td = time.monotonic()
+                    try:
+                        with _m_inflight.track(tags={"role": "decode"}):
+                            dres = dworker.decode_request(
+                                {**base, "kv": pres["kv"]})
+                    except BaseException:
+                        self.health.record_error(dworker.key)
+                        raise
+                    self.health.observe(dworker.key, time.monotonic() - td,
+                                        role="decode")
             finally:
                 self._live.pop(base["request_id"], None)
         return {
@@ -629,22 +1233,46 @@ class DisaggCoordinator:
                     top_k: int = 0, stop: Optional[List[List[int]]] = None,
                     request_id: Optional[str] = None,
                     timeout_s: float = 600.0) -> DisaggStream:
-        """Prefill synchronously (TTFT is paid here), then return a
-        stream over the decode replica's tokens — the seeded first token
-        arrives as the stream's first item."""
+        """Run the prefill leg (TTFT is paid here — concurrently with
+        the eager import under the stream transport, synchronously
+        otherwise), then return a stream over the decode replica's
+        tokens — the seeded first token arrives as the stream's first
+        item. A prefix-routed request skips the prefill leg entirely."""
         with tracing.span_if_traced("disagg.admit", {"kind": "stream"}):
             base = self._base_request(prompt, max_tokens, temperature, top_p,
                                       top_k, stop, request_id, timeout_s)
             deadline = time.monotonic() + timeout_s
-            dworker = self._pick("decode", deadline)
+            routed = self._prefix_route(base)
+            dworker = None
             try:
-                pres = self._run_prefill(base, deadline, dworker)
-                try:
+                if routed is not None:
+                    dworker, warm = routed
+                    self._live[base["request_id"]] = (dworker,)
+                    with tracing.span_if_traced(
+                            "disagg.route",
+                            {"prefix_warm_tokens": warm,
+                             "replica": str(dworker.key)}):
+                        raw = dworker.generate_stream(base)
+                elif self.cfg.kv_transfer == "stream":
+                    dworker = self._pick("decode", deadline)
+                    kv_dest = self._kv_dest_for(dworker)
+                    pt, pbox = self._spawn_prefill(
+                        base, deadline, dworker, kv_dest)
+                    try:
+                        raw = dworker.decode_stream(
+                            {**base, "kv": {"kind": "stream"}})
+                    except BaseException as e:
+                        pt.join(timeout=30.0)
+                        if "err" in pbox:
+                            raise pbox["err"] from e
+                        raise
+                else:
+                    dworker = self._pick("decode", deadline)
+                    pres = self._run_prefill(base, deadline, dworker)
                     raw = dworker.decode_stream({**base, "kv": pres["kv"]})
-                except BaseException:
-                    self.health.record_error(dworker.key)
-                    raise
             except BaseException:
+                if dworker is not None:
+                    self.health.record_error(dworker.key)
                 self._live.pop(base["request_id"], None)
                 raise
 
@@ -692,9 +1320,9 @@ class DisaggCoordinator:
                     w.load() for w in self._workers["decode"]),
                 "kv_transfer": self.cfg.kv_transfer,
                 "health": self.health.snapshot(),
-                "kv_migrations": _m_migration_s.count(
-                    tags={"transport": "object"}) + _m_migration_s.count(
-                    tags={"transport": "channel"}),
+                "kv_migrations": sum(
+                    _m_migration_s.count(tags={"transport": t})
+                    for t in ("object", "channel", "stream")),
             }
 
     def close(self) -> None:

@@ -36,7 +36,7 @@ import queue
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -245,6 +245,15 @@ class Request:
     # prompt, not prompt+max_tokens.
     prefill_only: bool = False
     _kv_export: Optional[Dict[str, Any]] = None
+    # streamed KV export (disaggregated serving): when set on a
+    # prefill_only request, KV frames are pushed to this callable as
+    # prefill commits them (page-window slices of the bucketed row cache,
+    # or per-chunk gathers on the chunked path) instead of one blob
+    # parked in _kv_export after the first token. The sink runs on engine
+    # threads and must never block for long; a raising sink fails the
+    # request. Frame shape: see _stream_kv_frames.
+    kv_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+    kv_window: int = 256  # tokens per streamed frame (bucketed path)
 
     def _emit(self, tok: Optional[int]) -> None:
         if self.stream_q is not None:
@@ -254,7 +263,8 @@ class Request:
 class _ChunkState:
     """One long prompt mid-chunked-prefill."""
 
-    __slots__ = ("request", "pages", "table", "true_len", "next_chunk")
+    __slots__ = ("request", "pages", "table", "true_len", "next_chunk",
+                 "emitted_upto", "sink_seq")
 
     def __init__(self, request: Request, pages: List[int], table, true_len: int):
         self.request = request
@@ -262,6 +272,10 @@ class _ChunkState:
         self.table = table  # np [pages_per_seq]
         self.true_len = true_len
         self.next_chunk = 0
+        # streamed export bookkeeping: tokens already pushed to kv_sink
+        # (page-aligned except after the final frame) and the frame seq
+        self.emitted_upto = 0
+        self.sink_seq = 0
 
 
 class _Slot:
@@ -849,13 +863,226 @@ class InferenceEngine:
                 f"{req.prefill_only}, finish_reason={req.finish_reason!r})")
         return blob
 
+    def _stream_kv_frames(self, req: Request, k, v, start: int, *,
+                          true_len: int, last: bool, seq0: int = 0) -> int:
+        """Push host KV `k`/`v` ([L, t, KVH, hd], covering prompt tokens
+        [start, start+t)) to req.kv_sink in kv_window-token frames.
+        Returns the next frame seq. Frame wire format:
+
+          {"request_id", "seq", "start", "k", "v", "last"}
+
+        plus the blob metadata (true_len/layers/kv_heads/head_dim/dtype)
+        on seq 0 — everything begin_kv_import needs — and, on the final
+        frame, "first_token" for finish_kv_import. A raising sink
+        propagates to the caller, which fails the request."""
+        win = max(int(req.kv_window), self.ecfg.page_size)
+        t = k.shape[1]
+        seq, off = seq0, 0
+        while True:
+            end = min(off + win, t)
+            frame = {
+                "request_id": req.request_id,
+                "seq": seq,
+                "start": start + off,
+                "k": k[:, off:end],
+                "v": v[:, off:end],
+                "last": False,
+            }
+            if seq == 0:
+                frame.update(
+                    true_len=int(true_len),
+                    layers=int(k.shape[0]),
+                    kv_heads=int(k.shape[2]),
+                    head_dim=int(k.shape[3]),
+                    dtype=str(k.dtype),
+                )
+            tail = end >= t
+            if tail and last:
+                frame["last"] = True
+                frame["true_len"] = int(true_len)
+                frame["first_token"] = int(req.output[-1])
+            req.kv_sink(frame)
+            seq += 1
+            off = end
+            if tail:
+                return seq
+
+    def _stream_chunk_frames(self, st: _ChunkState, upto: int,
+                             last: bool) -> None:
+        """Chunked-prefill streamed export (decode thread only): gather
+        the pages committed since the last frame — including the cached
+        prefix before the first computed chunk on a prefix hit — and push
+        them to the sink. Non-final frames stop at a page boundary (the
+        gather is page-granular), so migration overlaps the remaining
+        chunks instead of waiting for the first token."""
+        ps = self.ecfg.page_size
+        if not last:
+            upto = (upto // ps) * ps
+        if upto <= st.emitted_upto:
+            return
+        p0 = st.emitted_upto // ps  # emitted_upto is page-aligned here
+        p1 = -(-upto // ps)
+        page_arr = jnp.asarray(st.pages[p0:p1], jnp.int32)
+        k, v = _gather_pages_jit(self.k_pages, self.v_pages, page_arr)
+        k = np.asarray(k[:, : upto - p0 * ps])
+        v = np.asarray(v[:, : upto - p0 * ps])
+        st.sink_seq = self._stream_kv_frames(
+            st.request, k, v, st.emitted_upto, true_len=st.true_len,
+            last=last, seq0=st.sink_seq)
+        st.emitted_upto = upto
+
+    def begin_kv_import(self, req: Request, true_len: int,
+                        meta: Dict[str, Any],
+                        timeout_s: float = 60.0) -> bool:
+        """Start a partial (streamed) KV import: validate against this
+        model, allocate pages for prompt+max_tokens, and stage a host
+        buffer that ingest_kv_chunk fills as frames arrive. Returns False
+        if the request was failed instead (req.error/done set — matching
+        import_kv_pages' failure contract). `meta` carries the frame-0
+        header fields (layers/kv_heads/head_dim/dtype)."""
+        try:
+            req.stop = _normalize_stops(req.stop)
+        except ValueError as e:
+            self._finish_request(req, error=str(e))
+            return False
+        try:
+            T = int(true_len)
+            Lb = int(meta["layers"])
+            KVHb = int(meta["kv_heads"])
+            hdb = int(meta["head_dim"])
+        except (KeyError, TypeError, ValueError) as e:
+            self._finish_request(req, error=f"malformed kv blob: {e!r}")
+            return False
+        L, KVH, hd = self.cfg.n_layers, self.cfg.kv_heads, self.cfg.hdim
+        if (Lb, KVHb, hdb) != (L, KVH, hd):
+            self._finish_request(req, error=(
+                f"kv blob shape {(Lb, T, KVHb, hdb)} does not match model "
+                f"[layers={L}, true_len={T}, kv_heads={KVH}, head_dim={hd}]"))
+            return False
+        if len(req.prompt) != T:
+            self._finish_request(req, error=(
+                f"kv blob covers {T} tokens but the prompt has "
+                f"{len(req.prompt)}"))
+            return False
+        total = T + req.max_tokens
+        if total > self.ecfg.max_seq_len:
+            self._finish_request(req, error=(
+                f"prompt+max_tokens {T}+{req.max_tokens} exceeds "
+                f"max_seq_len {self.ecfg.max_seq_len}"))
+            return False
+        n_pages = -(-total // self.ecfg.page_size)
+        if n_pages > self.ecfg.max_pages - 1:
+            self._finish_request(req, error=(
+                f"request needs {n_pages} pages but the pool only has "
+                f"{self.ecfg.max_pages - 1}; raise EngineConfig.max_pages"))
+            return False
+        if self.prefix is not None:
+            req._page_hashes = self.prefix.page_hashes(
+                req.prompt, T // self.ecfg.page_size)
+        with self._req_lock:
+            self._requests[req.request_id] = req
+        deadline = time.monotonic() + timeout_s
+        pages = None
+        while True:
+            with self._alloc_lock:
+                if req.cancelled.is_set():
+                    break
+                pages = self._alloc_with_reclaim(n_pages)
+            if pages is not None:
+                break
+            if time.monotonic() >= deadline:
+                self._finish_request(req, error=(
+                    f"no pages free for KV import within {timeout_s}s"))
+                return False
+            time.sleep(0.005)
+        if req.cancelled.is_set():
+            if pages:
+                self._free_pages_and_revive(pages)
+            self._finish_request(req, "cancelled")
+            return False
+        ps = self.ecfg.page_size
+        Tpad = -(-T // ps) * ps
+        # host staging in the SOURCE dtype: finish casts to the pool
+        # dtype exactly as the one-shot path does, so decode continues
+        # token-identically
+        dt = np.dtype(meta.get("dtype", str(self.k_pages.dtype)))
+        req._kv_ingest = {
+            "pages": pages,
+            "T": T,
+            "k": np.zeros((L, Tpad, KVH, hd), dt),
+            "v": np.zeros((L, Tpad, KVH, hd), dt),
+        }
+        return True
+
+    def ingest_kv_chunk(self, req: Request, frame: Dict[str, Any]) -> None:
+        """Copy one streamed frame into the staging buffer (any order;
+        duplicate writes are idempotent). Raises on malformed frames —
+        the caller aborts the import."""
+        st = req._kv_ingest
+        s = int(frame["start"])
+        k, v = frame["k"], frame["v"]
+        t = int(k.shape[1])
+        if s < 0 or s + t > st["k"].shape[1]:
+            raise ValueError(
+                f"kv frame [{s}:{s + t}) outside the staged "
+                f"{st['k'].shape[1]} tokens")
+        st["k"][:, s:s + t] = k
+        st["v"][:, s:s + t] = v
+
+    def finish_kv_import(self, req: Request, first_token: int) -> Request:
+        """Finalize a streamed import: move the staged KV to device and
+        publish the request to the decode batch, seeding the first token
+        exactly as the prefill emitters do (it was sampled and
+        TTFT-observed on the prefill engine)."""
+        st, req._kv_ingest = req._kv_ingest, None
+        if req.cancelled.is_set():
+            self._free_pages_and_revive(st["pages"])
+            self._finish_request(req, "cancelled")
+            return req
+        dtype = self.k_pages.dtype
+        cache = {
+            "k": jnp.asarray(st["k"], dtype)[:, None],  # [L,1,Tpad,KVH,hd]
+            "v": jnp.asarray(st["v"], dtype)[:, None],
+        }
+        first = int(first_token)
+        if not req.output:
+            req.output.append(first)
+            eos = self.ecfg.eos_token_id
+            if eos is not None and first == eos:
+                pass  # eos is control
+            elif req.stop:
+                req._held.append(first)  # hold-back from token 1
+            else:
+                req._emit(first)
+        with self._ready_lock:
+            self._ready.append((req, st["pages"], cache, st["T"]))
+        self._work.set()
+        self._ensure_loop()
+        return req
+
+    def abort_kv_import(self, req: Request,
+                        error: Optional[str] = None) -> None:
+        """Tear down a partial import (stream died / cancelled): free the
+        staged pages and finish the request."""
+        st = getattr(req, "_kv_ingest", None)
+        req._kv_ingest = None
+        if st is not None and st.get("pages"):
+            self._free_pages_and_revive(st["pages"])
+        if not req.done.is_set():
+            if error is not None:
+                self._finish_request(req, error=error)
+            else:
+                self._finish_request(req, "cancelled")
+
     def import_kv_pages(self, req: Request, blob: Dict[str, Any],
                         timeout_s: float = 60.0) -> Request:
         """Admit `req` straight into the decode phase from an exported KV
         blob (disaggregated serving: prefill ran on another engine). The
         blob is re-paginated for THIS engine's page_size/max_pages; the
         request then behaves exactly as if prefilled here (stops, stream
-        hold-back, prefix registration, speculation all apply).
+        hold-back, prefix registration, speculation all apply). One-shot
+        wrapper over begin/ingest/finish_kv_import — the streamed path
+        uses those directly and lands token-identically.
 
         Failures surface on the request (req.error + done set), matching
         add_request's contract. Pages are allocated inline with a bounded
@@ -880,75 +1107,16 @@ class InferenceEngine:
                 f"kv blob shape {tuple(k.shape)} does not match model "
                 f"[layers={L}, true_len={T}, kv_heads={KVH}, head_dim={hd}]"))
             return req
-        if len(req.prompt) != T:
-            self._finish_request(req, error=(
-                f"kv blob covers {T} tokens but the prompt has "
-                f"{len(req.prompt)}"))
+        meta = {"layers": L, "kv_heads": KVH, "head_dim": hd,
+                "dtype": str(np.asarray(k).dtype)}
+        if not self.begin_kv_import(req, T, meta, timeout_s=timeout_s):
             return req
-        total = T + req.max_tokens
-        if total > self.ecfg.max_seq_len:
-            self._finish_request(req, error=(
-                f"prompt+max_tokens {T}+{req.max_tokens} exceeds "
-                f"max_seq_len {self.ecfg.max_seq_len}"))
+        try:
+            self.ingest_kv_chunk(req, {"start": 0, "k": k, "v": v})
+        except Exception as e:  # noqa: BLE001 — fail just this request
+            self.abort_kv_import(req, f"kv ingest failed: {e!r}")
             return req
-        n_pages = -(-total // self.ecfg.page_size)
-        if n_pages > self.ecfg.max_pages - 1:
-            self._finish_request(req, error=(
-                f"request needs {n_pages} pages but the pool only has "
-                f"{self.ecfg.max_pages - 1}; raise EngineConfig.max_pages"))
-            return req
-        if self.prefix is not None:
-            req._page_hashes = self.prefix.page_hashes(
-                req.prompt, T // self.ecfg.page_size)
-        with self._req_lock:
-            self._requests[req.request_id] = req
-        deadline = time.monotonic() + timeout_s
-        pages = None
-        while True:
-            with self._alloc_lock:
-                if req.cancelled.is_set():
-                    break
-                pages = self._alloc_with_reclaim(n_pages)
-            if pages is not None:
-                break
-            if time.monotonic() >= deadline:
-                self._finish_request(req, error=(
-                    f"no pages free for KV import within {timeout_s}s"))
-                return req
-            time.sleep(0.005)
-        if req.cancelled.is_set():
-            if pages:
-                self._free_pages_and_revive(pages)
-            self._finish_request(req, "cancelled")
-            return req
-        ps = self.ecfg.page_size
-        Tpad = -(-T // ps) * ps
-        if Tpad != T:  # re-paginate: pad to THIS pool's page boundary
-            pad = ((0, 0), (0, Tpad - T), (0, 0), (0, 0))
-            k = np.pad(k, pad)
-            v = np.pad(v, pad)
-        dtype = self.k_pages.dtype
-        cache = {
-            "k": jnp.asarray(k, dtype)[:, None],  # [L, 1, Tpad, KVH, hd]
-            "v": jnp.asarray(v, dtype)[:, None],
-        }
-        # Seed the first token exactly as the prefill emitters do: it was
-        # sampled (and TTFT-observed) on the prefill engine, so here it
-        # only enters output/stream bookkeeping.
-        if not req.output:
-            req.output.append(first)
-            eos = self.ecfg.eos_token_id
-            if eos is not None and first == eos:
-                pass  # eos is control
-            elif req.stop:
-                req._held.append(first)  # hold-back from token 1
-            else:
-                req._emit(first)
-        with self._ready_lock:
-            self._ready.append((req, pages, cache, T))
-        self._work.set()
-        self._ensure_loop()
-        return req
+        return self.finish_kv_import(req, first)
 
     # ------------------------------------------------------------- requests
 
@@ -1284,6 +1452,18 @@ class InferenceEngine:
             for i, (req, _p, _T, _b, _cl) in enumerate(group)
         ]
         now = time.monotonic()
+        streamed = [i for i, it in enumerate(group)
+                    if it[0].prefill_only and it[0].kv_sink is not None]
+        k_host = v_host = None
+        if streamed:
+            # ONE device->host pull for the whole group, on THIS thread —
+            # the per-request row readbacks the one-shot export path pays
+            # serialized on the decode thread are the measured disagg
+            # bottleneck. Cast matches _export_blob so import -> decode
+            # continues token-exactly.
+            dtype = self.k_pages.dtype
+            k_host = np.asarray(cache["k"].astype(dtype))
+            v_host = np.asarray(cache["v"].astype(dtype))
         eos = self.ecfg.eos_token_id
         with self._ready_lock:
             for i, (req, pages, T, _b, _cl) in enumerate(group):
@@ -1301,12 +1481,28 @@ class InferenceEngine:
                     req._held.append(int(first))  # hold-back from token 1
                 else:
                     req._emit(int(first))
+                if i in streamed:
+                    continue  # frames pushed below; never parks in _ready
                 row_cache = {
                     "k": cache["k"][:, i:i + 1],
                     "v": cache["v"][:, i:i + 1],
                 }
                 self._ready.append((req, pages, row_cache, T))
         self._work.set()  # revive the decode thread if it is idle-waiting
+        for i in streamed:
+            req, pages, T, _b, _cl = group[i]
+            try:
+                self._stream_kv_frames(req, k_host[:, i, :T],
+                                       v_host[:, i, :T], 0,
+                                       true_len=T, last=True)
+            except Exception as e:  # noqa: BLE001 — fail just this request
+                logger.warning("kv stream failed for %s", req.request_id,
+                               exc_info=True)
+                self._free_pages_and_revive(pages)
+                self._fail_request(req, f"kv stream failed: {e!r}")
+                continue
+            self._free_pages_and_revive(pages)
+            self._finish_request(req, "prefill_done")
 
     def _install_ready(self) -> bool:
         """Decode thread: move finished prefills into free decode slots
@@ -1406,11 +1602,27 @@ class InferenceEngine:
             jnp.int32(start), jnp.asarray(st.table), jnp.int32(last_idx),
         )
         st.next_chunk += 1
+        req = st.request
+        streaming = req.prefill_only and req.kv_sink is not None
         if not is_last:
+            if streaming:
+                # pages for [emitted_upto, start+C) are committed: ship
+                # them NOW so migration overlaps the remaining chunks
+                # (the first call also covers a cached prefix, whose
+                # shared pages hold identical KV by the chain-hash key)
+                try:
+                    self._stream_chunk_frames(st, start + C, last=False)
+                except Exception as e:  # noqa: BLE001 — fail this request
+                    logger.warning("kv stream failed for %s",
+                                   req.request_id, exc_info=True)
+                    with self._chunk_lock:
+                        if st in self._chunk_queue:
+                            self._chunk_queue.remove(st)
+                    self._free_pages_and_revive(st.pages)
+                    self._fail_request(req, f"kv stream failed: {e!r}")
             return True
         with self._chunk_lock:
             self._chunk_queue.pop(0)
-        req = st.request
         first = _sample_host(np.asarray(logits), req.temperature,
                              req.top_p, req.top_k)
         now = time.monotonic()
@@ -1427,6 +1639,20 @@ class InferenceEngine:
             req._held.append(int(first))  # hold-back from token 1
         else:
             req._emit(int(first))
+        if streaming:
+            # final frame carries first_token; pages free immediately —
+            # the request never parks in _ready on the streamed path
+            try:
+                self._stream_chunk_frames(st, st.true_len, last=True)
+            except Exception as e:  # noqa: BLE001 — fail this request
+                logger.warning("kv stream failed for %s", req.request_id,
+                               exc_info=True)
+                self._free_pages_and_revive(st.pages)
+                self._fail_request(req, f"kv stream failed: {e!r}")
+                return True
+            self._free_pages_and_revive(st.pages)
+            self._finish_request(req, "prefill_done")
+            return True
         with self._ready_lock:
             # cache=None: this prompt's KV is already in its pages
             self._ready.append((req, st.pages, None, st.true_len))
@@ -1796,6 +2022,18 @@ class InferenceEngine:
             **spec,
         }
 
+    def prefix_digest(self) -> Dict[str, Any]:
+        """Compact prefix-cache fingerprint for router gossip: truncated
+        chain hashes of every cached full prompt page. A router matches
+        prompt_page_fingerprints(prompt, page_size) against this set to
+        count warm leading pages per replica (prefix-aware role routing
+        in serve/disagg.py)."""
+        if self.prefix is None:
+            return {"page_size": self.ecfg.page_size, "hashes": []}
+        with self._alloc_lock:
+            hashes = [h[:8].hex() for h in self.prefix.by_hash]
+        return {"page_size": self.ecfg.page_size, "hashes": hashes}
+
     def stop(self):
         self._stop.set()
         self._work.set()  # wake the decode thread so it observes _stop
@@ -1822,6 +2060,17 @@ def _scatter_pages_jit(k_pages, v_pages, k, v, page_arr, n_full, ps):
     k_pages = k_pages.at[:, :, page_arr].set(kb.astype(k_pages.dtype))
     v_pages = v_pages.at[:, :, page_arr].set(vb.astype(v_pages.dtype))
     return k_pages, v_pages
+
+
+def prompt_page_fingerprints(prompt, page_size: int) -> List[str]:
+    """Router-side half of InferenceEngine.prefix_digest: the truncated
+    chain-hash fingerprints of every full page of `prompt`, in the same
+    wire format the digest advertises."""
+    n = len(prompt) // page_size
+    if n <= 0:
+        return []
+    return [h[:8].hex()
+            for h in PrefixCache(page_size).page_hashes(prompt, n)]
 
 
 def _normalize_stops(stop) -> Optional[List[List[int]]]:

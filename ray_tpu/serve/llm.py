@@ -7,6 +7,7 @@ requests over replicas, the engine continuously batches within a replica.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -57,6 +58,7 @@ class LLMServer:
                 f"role must be one of {self.ROLES}, got {role!r}")
         self.role = role
         self._kv_inbox = None  # decode role: created on first kv_ingest
+        self._kv_inbox_lock = threading.Lock()
         if params_fn is not None:
             params, cfg = params_fn()
         else:
@@ -142,17 +144,42 @@ class LLMServer:
 
         return replica_decode_stream(self.engine, request, self._kv_inbox)
 
-    def kv_ingest(self, _request: Any = None):
+    def generate_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        from .disagg import replica_generate
+
+        return replica_generate(self.engine, request)
+
+    def generate_stream(self, request: Dict[str, Any]):
+        from .disagg import replica_generate_stream
+
+        return replica_generate_stream(self.engine, request)
+
+    def prefix_digest(self, _request: Any = None) -> Dict[str, Any]:
+        """Compact prefix-cache fingerprint for the coordinator's
+        prefix-aware role routing."""
+        return self.engine.prefix_digest()
+
+    def kv_ingest(self, request: Any = None):
         """Lazily create this replica's KV inbox and return its
         DistChannel handle (picklable: prefill replicas put into it)."""
         from .disagg import KvInbox
 
-        if self._kv_inbox is None:
-            self._kv_inbox = KvInbox()
-        return self._kv_inbox.channel
+        # concurrent first requests race here (in-process replicas
+        # dispatch handle_request from many threads); without the lock
+        # each caller mints its own inbox and all but the last-written
+        # channel are orphans no drainer ever reads
+        with self._kv_inbox_lock:
+            if self._kv_inbox is None:
+                ttl = float((request or {}).get("kv_inbox_ttl_s", 120.0)) \
+                    if isinstance(request, dict) else 120.0
+                self._kv_inbox = KvInbox(ttl_s=ttl)
+            return self._kv_inbox.channel
 
     def cancel(self, request: Dict[str, Any]) -> bool:
-        return self.engine.cancel(request["request_id"])
+        hit = self.engine.cancel(request["request_id"])
+        if self._kv_inbox is not None:
+            self._kv_inbox.cancel(request["request_id"])
+        return hit
 
     def stats(self, _request: Any = None) -> Dict[str, Any]:
         out = self.engine.stats()

@@ -8,7 +8,11 @@ ids, a thread-local current span, automatic context injection at
 `.remote()` (api.RemoteFunction / core_worker.submit_actor_task) and
 extraction around user-function execution
 (`node_agent._call_user_function`, `actor_process._child_main`), around
-each disaggregated-serving leg (`serve/disagg.py`), and through the
+each disaggregated-serving leg (`serve/disagg.py`: `disagg.admit` /
+`disagg.queue_wait` / `disagg.route` / `disagg.prefill` /
+`disagg.kv_export` / `disagg.kv_migration` / `disagg.kv_import` /
+`disagg.decode` — under the stream transport `disagg.kv_migration`
+overlaps `disagg.prefill` in the same trace), and through the
 pipeline trainer (`train/pipeline.py`): a traced `pipeline.step` fans
 out into per-worker `pipeline.stage_step` spans with nested
 `channel_send`/`channel_recv` spans from `core/channels.py`, so one

@@ -2,15 +2,21 @@
 
 Covers the KV migration contract (export -> import into a differently
 sized page pool is token-exact vs an uninterrupted engine), the
-coordinator e2e (concurrent mixed-length prompts through a real
-prefill+decode replica pair match a colocated engine token-for-token,
-with migration metrics emitted), the Pow2Router resize accounting fix,
-and the channel-writer reconnect regression.
+streamed transport (multi-frame partial-blob import token-exact across
+mismatched page sizes, prefix-aware role routing that skips migration,
+chaos paths failing cleanly instead of hanging), the coordinator e2e
+(concurrent mixed-length prompts through a real prefill+decode replica
+pair match a colocated engine token-for-token, with migration metrics
+emitted), KvInbox hygiene (cancel eviction + TTL sweep), the kv_dest
+per-identity cache, the Pow2Router resize accounting fix, and the
+channel-writer reconnect regression.
 """
 
 import os
 import queue
 import threading
+import time
+import uuid
 
 import numpy as np
 import pytest
@@ -153,7 +159,8 @@ class TestDisaggCoordinator:
         ref = _engine(cfg, params, page_size=8)
         co = DisaggCoordinator([EngineWorker(pe, "p0")],
                                [EngineWorker(de, "d0")],
-                               {"small_blob_bytes": 0})
+                               {"kv_transfer": "object",
+                                "small_blob_bytes": 0})
         yield cfg, co, ref
         pe.stop(), de.stop(), ref.stop()
 
@@ -224,8 +231,9 @@ class TestDisaggCoordinator:
         assert out["token_ids"]
         spans = tracing.get_spans(root.trace_id)
         names = {s["name"] for s in spans}
-        assert {"disagg.admit", "disagg.queue_wait", "prefill", "kv_export",
-                "kv_migration", "kv_import", "decode"} <= names
+        assert {"disagg.admit", "disagg.queue_wait", "disagg.prefill",
+                "disagg.kv_export", "disagg.kv_migration",
+                "disagg.kv_import", "disagg.decode"} <= names
         # connected: every span's parent is also in the trace
         by_id = {s["span_id"]: s for s in spans}
         for s in spans:
@@ -241,6 +249,406 @@ class TestDisaggCoordinator:
         before = len(tracing.get_spans())
         co.generate(_mixed_prompts(cfg, (7,))[0], max_tokens=4)
         assert len(tracing.get_spans()) == before  # zero-overhead path
+
+
+# --------------------------------------------------------------------------
+# streamed KV migration (kv_transfer="stream") + prefix-aware routing
+# --------------------------------------------------------------------------
+
+
+class TestStreamedMigration:
+    @pytest.fixture(scope="class")
+    def spair(self, tiny):
+        """Streamed-transport pair with mismatched page sizes (8 -> 4),
+        tiny kv_window so every request spans several frames, and chunked
+        prefill small enough that the 40-token prompt exercises the
+        chunked (page-committed) streaming path."""
+        from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+
+        cfg, params = tiny
+        pe = _engine(cfg, params, page_size=8, prefill_chunk=16)
+        de = _engine(cfg, params, page_size=4, max_pages=96,
+                     prefill_chunk=16)
+        ref = _engine(cfg, params, page_size=8, prefill_chunk=16)
+        co = DisaggCoordinator([EngineWorker(pe, "sp0")],
+                               [EngineWorker(de, "sd0")],
+                               {"kv_stream_tokens": 8,
+                                "prefix_routing": False})
+        yield cfg, co, ref, pe, de
+        pe.stop(), de.stop(), ref.stop()
+
+    def test_streamed_token_exact_mismatched_pages(self, spair):
+        """Partial-blob (multi-frame) import is token-identical to the
+        colocated engine across both prefill paths: bucketed (short
+        prompts) and chunked (40 > prefill_chunk), into a 4-token-page
+        pool fed from an 8-token-page source."""
+        cfg, co, ref, _, _ = spair
+        mig_s = registry.get("serve_kv_migration_seconds")
+        tags = {"transport": "stream"}
+        n0 = mig_s.count(tags)
+        prompts = _mixed_prompts(cfg, (5, 13, 29, 40), seed=21)
+        for prompt in prompts:
+            want = ref.generate(prompt, max_tokens=8)["token_ids"]
+            out = co.generate(prompt, max_tokens=8)
+            assert out["token_ids"] == want
+            assert out["kv_transport"] == "stream"
+            assert out["migration_bytes"] > 0
+        assert mig_s.count(tags) - n0 >= len(prompts)
+
+    def test_open_stream_streamed(self, spair):
+        cfg, co, ref, _, _ = spair
+        prompt = _mixed_prompts(cfg, (23,), seed=22)[0]
+        want = ref.generate(prompt, max_tokens=8)["token_ids"]
+        ds = co.open_stream(prompt, max_tokens=8)
+        assert list(ds.tokens()) == want
+        assert ds.finish_reason == "length"
+        assert ds.migration_bytes > 0
+
+    def test_prefix_warm_destination_token_exact(self, spair):
+        """Destination whose PrefixCache already holds the prompt's
+        pages (from a prior import): re-importing the same prompt over
+        the stream stays token-exact (routing disabled on this pair, so
+        the second pass really is a second migration)."""
+        cfg, co, ref, _, de = spair
+        prompt = _mixed_prompts(cfg, (40,), seed=23)[0]
+        want = ref.generate(prompt, max_tokens=8)["token_ids"]
+        first = co.generate(prompt, max_tokens=8)
+        assert first["token_ids"] == want
+        assert de.prefix_digest()["hashes"]  # dest cache is now warm
+        again = co.generate(prompt, max_tokens=8)
+        assert again["token_ids"] == want
+        assert again["kv_transport"] == "stream"
+
+    def test_prefix_route_skips_migration(self, spair):
+        """The tentpole routing win: a repeat prompt whose prefix is
+        warm on the decode replica runs there directly — kv_transport
+        'skipped', zero migration bytes, token-identical, for both the
+        blocking and streaming APIs."""
+        from ray_tpu.serve.disagg import DisaggCoordinator
+
+        cfg, co, ref, _, _ = spair
+        co2 = DisaggCoordinator(co._workers["prefill"],
+                                co._workers["decode"],
+                                {"kv_stream_tokens": 8,
+                                 "prefix_gossip_s": 0.0})
+        prompt = _mixed_prompts(cfg, (40,), seed=24)[0]
+        want = ref.generate(prompt, max_tokens=8)["token_ids"]
+        cold = co2.generate(prompt, max_tokens=8)
+        assert cold["token_ids"] == want
+        warm = co2.generate(prompt, max_tokens=8)
+        assert warm["token_ids"] == want
+        assert warm["kv_transport"] == "skipped"
+        assert warm["migration_bytes"] == 0
+        assert warm["prefix_warm_tokens"] >= 32
+        ds = co2.open_stream(prompt, max_tokens=8)
+        assert list(ds.tokens()) == want
+
+    def test_streamed_smoke(self, spair):
+        """Fast two-replica streamed-migration smoke for make check."""
+        cfg, co, ref, _, _ = spair
+        prompt = _mixed_prompts(cfg, (9,), seed=25)[0]
+        out = co.generate(prompt, max_tokens=4)
+        assert out["token_ids"] == ref.generate(
+            prompt, max_tokens=4)["token_ids"]
+        assert out["kv_transport"] == "stream"
+
+
+class TestStreamChaos:
+    """A dying replica mid-stream must FAIL the request cleanly (no
+    hang) and release every page/blob it staged."""
+
+    def test_decode_death_fails_prefill_cleanly(self, tiny):
+        """kv_sink raising (the decode-side channel is gone) fails the
+        prefill request — bucketed and chunked paths — and returns its
+        pages to the allocator."""
+        cfg, params = tiny
+        src = _engine(cfg, params, prefill_chunk=16)
+        try:
+            free0 = src.stats()["free_pages"]
+            for n in (24, 40):  # bucketed, chunked
+                def sink(frame):
+                    raise RuntimeError("decode replica died")
+
+                req = Request(request_id=uuid.uuid4().hex,
+                              prompt=_mixed_prompts(cfg, (n,))[0],
+                              max_tokens=8, prefill_only=True,
+                              kv_sink=sink, kv_window=8)
+                src.add_request(req)
+                assert req.done.wait(60.0), "prefill hung on dead sink"
+                assert req.error and "kv stream failed" in req.error
+            deadline = time.monotonic() + 10
+            while (src.stats()["free_pages"] != free0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert src.stats()["free_pages"] == free0
+        finally:
+            src.stop()
+
+    def test_prefill_death_mid_stream_raises(self, tiny):
+        """An error frame mid-stream (prefill replica died after some
+        frames) surfaces as KvMigrationError on the decode side, with
+        staged pages freed and the inbox left empty."""
+        from ray_tpu.serve import disagg
+        from ray_tpu.serve.disagg import KvInbox, KvMigrationError
+
+        cfg, params = tiny
+        src = _engine(cfg, params, prefill_chunk=16)
+        de = _engine(cfg, params, page_size=4, max_pages=96)
+        try:
+            frames = []
+            prompt = _mixed_prompts(cfg, (40,))[0]
+            req = Request(request_id="chaos-1", prompt=list(prompt),
+                          max_tokens=8, prefill_only=True,
+                          kv_sink=frames.append, kv_window=8)
+            src.add_request(req)
+            assert req.done.wait(60.0) and req.error is None
+            assert len(frames) >= 3
+            free0 = de.stats()["free_pages"]
+            inbox = KvInbox()
+            rid = "chaos-1"
+            for f in frames[:2]:
+                inbox.channel.put((rid, f))
+            inbox.channel.put((rid, {"request_id": rid,
+                                     "error": "prefill replica died"}))
+            request = {"request_id": rid, "prompt_ids": list(prompt),
+                       "max_tokens": 8, "kv": {"kind": "stream"},
+                       "kv_stream_idle_s": 10.0}
+            t0 = time.monotonic()
+            with pytest.raises(KvMigrationError, match="prefill replica"):
+                disagg._import_request(de, request, inbox)
+            assert time.monotonic() - t0 < 10.0  # failed fast, no hang
+            assert inbox.parked() == 0
+            deadline = time.monotonic() + 10
+            while (de.stats()["free_pages"] != free0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert de.stats()["free_pages"] == free0
+        finally:
+            src.stop(), de.stop()
+
+    def test_stream_idle_timeout_raises(self, tiny):
+        """A stream that never produces a frame aborts after the idle
+        window instead of hanging forever."""
+        from ray_tpu.serve import disagg
+        from ray_tpu.serve.disagg import KvInbox, KvMigrationError
+
+        cfg, params = tiny
+        de = _engine(cfg, params)
+        try:
+            inbox = KvInbox()
+            request = {"request_id": "ghost", "prompt_ids": [1, 2, 3],
+                       "max_tokens": 4, "kv": {"kind": "stream"},
+                       "kv_stream_idle_s": 0.5}
+            t0 = time.monotonic()
+            with pytest.raises(KvMigrationError):
+                disagg._import_request(de, request, inbox)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            de.stop()
+
+    def test_e2e_prefill_reject_fails_fast(self, tiny):
+        """Coordinator-level: a prefill-side rejection poisons the
+        stream, so the concurrent decode leg fails within the idle
+        window instead of hanging, and the root cause surfaces."""
+        from ray_tpu.serve.disagg import (DisaggCoordinator, EngineWorker,
+                                          KvMigrationError)
+
+        cfg, params = tiny
+        # 60-token prompt: the prefill replica rejects it at admission
+        # (exceeds its largest bucket); the decode replica could fit it
+        pe = _engine(cfg, params)
+        de = _engine(cfg, params)
+        try:
+            co = DisaggCoordinator([EngineWorker(pe, "cp0")],
+                                   [EngineWorker(de, "cd0")],
+                                   {"kv_stream_idle_s": 20.0,
+                                    "prefix_routing": False})
+            free0 = de.stats()["free_pages"]
+            prompt = _mixed_prompts(cfg, (60,))[0]
+            t0 = time.monotonic()
+            with pytest.raises((ValueError, KvMigrationError)):
+                co.generate(prompt, max_tokens=8, timeout_s=60.0)
+            assert time.monotonic() - t0 < 20.0
+            assert de.stats()["free_pages"] == free0
+        finally:
+            pe.stop(), de.stop()
+
+
+class TestKvInboxHygiene:
+    """Regression: a request cancelled between prefill and decode ingest
+    used to leak its parked blob in the inbox forever."""
+
+    def test_cancel_evicts_parked_and_drops_late_frames(self):
+        from ray_tpu.serve.disagg import KvInbox
+
+        inbox = KvInbox(maxsize=8, ttl_s=60.0)
+        inbox.channel.put(("r1", {"blob": 1}))
+        with pytest.raises(TimeoutError):
+            inbox.take("r2", timeout=0.6)  # drains, parking r1's blob
+        assert inbox.parked() == 1
+        inbox.cancel("r1")
+        assert inbox.parked() == 0
+        # the in-flight tail of the cancelled stream is dropped at park
+        inbox.channel.put(("r1", {"blob": 2}))
+        with pytest.raises(TimeoutError):
+            inbox.take("r2", timeout=0.6)
+        assert inbox.parked() == 0
+
+    def test_ttl_sweep_evicts_unclaimed(self):
+        from ray_tpu.serve.disagg import KvInbox
+
+        inbox = KvInbox(maxsize=8, ttl_s=1.5)
+        inbox.channel.put(("r1", {"blob": 1}))
+        with pytest.raises(TimeoutError):
+            inbox.take("rX", timeout=0.3)
+        assert inbox.parked() == 1
+        time.sleep(1.3)  # past ttl_s counting the drain above
+        with pytest.raises(TimeoutError):
+            inbox.take("rY", timeout=0.6)  # this drain pass sweeps
+        assert inbox.parked() == 0
+
+    def test_take_still_delivers(self):
+        from ray_tpu.serve.disagg import KvInbox
+
+        inbox = KvInbox(maxsize=8, ttl_s=60.0)
+        inbox.channel.put(("r1", {"blob": 1}))
+        assert inbox.take("r1", timeout=5.0) == {"blob": 1}
+        assert inbox.parked() == 0
+
+
+# --------------------------------------------------------------------------
+# satellite: kv_dest cached per replica identity across _sync
+# --------------------------------------------------------------------------
+
+
+class _FakeController:
+    def __init__(self, replicas):
+        self.replicas = replicas  # deployment name -> [fake replicas]
+
+    @property
+    def get_replicas(self):
+        outer = self
+
+        class _M:
+            def remote(self, name):
+                return (outer.replicas[name], 1)
+
+        return _M()
+
+
+class TestKvDestCache:
+    def test_kv_dest_resolved_once_per_replica_identity(self, tiny,
+                                                        monkeypatch):
+        """Regression: every 1s resync used to hand back worker objects
+        whose kv_dest re-resolved per call site; the coordinator cache
+        must resolve ONCE per replica identity and re-resolve only when
+        the membership actually changes."""
+        from ray_tpu.serve import disagg
+        from ray_tpu.serve.disagg import DisaggCoordinator
+
+        monkeypatch.setattr(disagg.api, "get",
+                            lambda ref, timeout=None: ref)
+        pa, da = _FakeReplica("pa"), _FakeReplica("da")
+        ctrl = _FakeController({"P": [pa], "D": [da]})
+        co = DisaggCoordinator([], [], {"prefix_routing": False})
+        co._deployments = {"prefill": "P", "decode": "D"}
+        co._controller = ctrl
+        co._sync(force=True)
+        w = co._workers["decode"][0]
+        d1 = co._kv_dest_for(w)
+        d2 = co._kv_dest_for(w)
+        assert d1 is d2
+        assert len(da.calls) == 1
+        # resync with unchanged membership: same worker, cache intact
+        co._last_sync = 0.0
+        co._sync(force=True)
+        w2 = co._workers["decode"][0]
+        assert w2 is w
+        co._kv_dest_for(w2)
+        assert len(da.calls) == 1
+        # replica replaced: cache invalidated, new identity re-resolves
+        db = _FakeReplica("db")
+        ctrl.replicas["D"] = [db]
+        co._last_sync = 0.0
+        co._sync(force=True)
+        w3 = co._workers["decode"][0]
+        assert w3 is not w
+        co._kv_dest_for(w3)
+        assert len(db.calls) == 1
+        assert w.key not in co._kv_dest_cache
+
+
+class TestKvDestConcurrency:
+    """Regression: the deploy path minted one KV inbox PER concurrent
+    first request. LLMServer.kv_ingest and ReplicaWorker.kv_dest both
+    lazily initialised without a lock, so N racing cold requests got N
+    distinct channels — the prefill senders then streamed frames into
+    orphaned channels no drainer reads and every import idled out.
+    (EngineWorker always had the lock, which is why the in-process
+    tests never caught it.)"""
+
+    def test_concurrent_kv_ingest_single_inbox(self, tiny):
+        from ray_tpu.serve.llm import LLMServer
+
+        cfg, params = tiny
+        srv = LLMServer._target(  # the class under the @deployment wrapper
+            params_fn=lambda: (params, cfg),
+            engine_config=dict(max_batch_size=2, page_size=8,
+                               max_pages=32, max_seq_len=64),
+            role="decode",
+        )
+        try:
+            n = 8
+            bar = threading.Barrier(n)
+            chans = [None] * n
+
+            def grab(i):
+                bar.wait()
+                chans[i] = srv.kv_ingest({})
+
+            ts = [threading.Thread(target=grab, args=(i,))
+                  for i in range(n)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            ids = {c.chan_id for c in chans}
+            assert len(ids) == 1, f"minted {len(ids)} inbox channels"
+            # and the one everyone got is the one decode actually drains
+            assert chans[0].chan_id == srv._kv_inbox.channel.chan_id
+        finally:
+            srv.engine.stop()
+
+    def test_concurrent_kv_dest_single_fetch(self, monkeypatch):
+        from ray_tpu.serve import disagg
+        from ray_tpu.serve.disagg import ReplicaWorker
+
+        monkeypatch.setattr(disagg.api, "get",
+                            lambda ref, timeout=None: ref)
+
+        class _SlowReplica(_FakeReplica):
+            class _Method(_FakeReplica._Method):
+                def remote(self, *a):
+                    time.sleep(0.05)  # widen the race window
+                    return super().remote(*a)
+
+            @property
+            def handle_request(self):
+                return self._Method(self)
+
+        rep = _SlowReplica("d0")
+        w = ReplicaWorker(rep)
+        n = 6
+        bar = threading.Barrier(n)
+        dests = [None] * n
+
+        def grab(i):
+            bar.wait()
+            dests[i] = w.kv_dest()
+
+        ts = [threading.Thread(target=grab, args=(i,)) for i in range(n)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(rep.calls) == 1, f"kv_ingest fetched {len(rep.calls)}x"
+        assert all(d is dests[0] for d in dests)
 
 
 # --------------------------------------------------------------------------
@@ -377,7 +785,7 @@ class TestDisaggCrossHost:
                 want = ref.generate(prompt, max_tokens=6)["token_ids"]
                 out = co.generate(prompt, max_tokens=6, timeout_s=300.0)
                 assert out["token_ids"] == want
-                assert out["kv_transport"] == "object"
+                assert out["kv_transport"] == "stream"
         finally:
             ref.stop()
             co.close()
@@ -408,7 +816,8 @@ class TestDisaggCrossHost:
             with tracing.start_span("xhost-client") as root:
                 out = co.generate(prompt, max_tokens=4, timeout_s=300.0)
             assert out["token_ids"]
-            needed = {"prefill", "kv_migration", "decode"}
+            needed = {"disagg.prefill", "disagg.kv_migration",
+                      "disagg.decode"}
             deadline = _time.monotonic() + 60
             spans = []
             while _time.monotonic() < deadline:
@@ -419,9 +828,9 @@ class TestDisaggCrossHost:
             names = {s["name"] for s in spans}
             assert needed <= names, f"federated spans missing: {names}"
             role_pids = {s["name"]: s["pid"] for s in spans
-                         if s["name"] in ("prefill", "decode")}
+                         if s["name"] in ("disagg.prefill", "disagg.decode")}
             # STRICT_SPREAD put the roles on different hosts => processes
-            assert role_pids["prefill"] != role_pids["decode"]
+            assert role_pids["disagg.prefill"] != role_pids["disagg.decode"]
             assert len({s["pid"] for s in spans}) >= 2
         finally:
             co.close()
