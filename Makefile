@@ -25,7 +25,8 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
 	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py \
 	tests/test_tracing.py tests/test_health.py tests/test_profiler.py \
-	tests/test_object_ledger.py
+	tests/test_object_ledger.py tests/test_raylint.py \
+	tests/test_sanitizer.py
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
@@ -39,9 +40,9 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	tsan shm \
+	tsan shm lint \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
-	bench-health bench-pipeline bench-profile
+	bench-health bench-pipeline bench-profile bench-sanitize
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -91,6 +92,12 @@ bench-pipeline:
 bench-profile:
 	env RAY_TPU_BENCH_SUITE=profile python bench.py
 
+# concurrency-sanitizer overhead loop: serve burst on tracked vs stock
+# locks (sanitizer_overhead_pct, acceptance <= 2% enabled / 0 disabled),
+# merged into BENCH_SUMMARY.json
+bench-sanitize:
+	env RAY_TPU_BENCH_SUITE=sanitize python bench.py
+
 # cluster health at a glance (alerts, SLO digests, node liveness) from
 # the in-process health plane; DASH=host:port reads a running head
 status:
@@ -99,7 +106,17 @@ status:
 shm:
 	$(MAKE) -C ray_tpu/core/_shm
 
-check: shm
+# static correctness gate: compileall as the syntax check, then raylint
+# (ray_tpu.tools.raylint) over ray_tpu/ + tests/ — the rule catalog is in
+# README "Correctness tooling"; suppress a deliberate pattern inline with
+# `# raylint: disable=<rule>` plus a justification comment
+lint:
+	@echo "== lint: compileall =="
+	python -m compileall -q ray_tpu tests bench.py
+	@echo "== lint: raylint =="
+	python -m ray_tpu.tools.raylint
+
+check: shm lint
 	@echo "== chunk 1/3: core runtime =="
 	$(PYTEST) $(FAST) $(CORE_TESTS)
 	@echo "== chunk 2/3: libraries (data/train/tune/rl/serve) =="

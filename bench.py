@@ -749,6 +749,117 @@ def bench_profile(model: str) -> None:
           "profiler_overhead_anchor", lower_is_better=True)
 
 
+def bench_sanitize(model: str) -> None:
+    """Concurrency-sanitizer overhead gate (ISSUE 12 acceptance: <=2%
+    enabled, zero disabled): the SAME colocated serve burst on an engine
+    built with stock locks vs one built under sanitizer.install() —
+    every Lock/RLock the tracked engine creates pays the acquisition
+    bookkeeping (held-stack push/pop, first-edge graph insert, hold
+    timing). Rounds strictly alternate off/on with medians, same
+    discipline as bench_trace/bench_health/bench_profile; install/
+    uninstall toggles around each round so runtime-created locks
+    (per-request threads, queues) match the engine's mode. The sanity
+    check raises if the install tracked no locks, so a silently-stock
+    "on" engine cannot mint a 0%% headline. Also emits the raw tracked
+    acquire+release micro-cost (ns) next to the stock primitive's.
+    Disabled overhead is structurally zero — nothing is patched and
+    threading.Lock IS the stock primitive (asserted in tests) — so only
+    the enabled row needs a measured number."""
+    import timeit
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+    from ray_tpu.util import sanitizer
+
+    cfg = get_config(model)
+    msl = min(512, cfg.max_seq_len)
+    prompt_len = min(128, msl // 2)
+    max_tokens = min(64, msl - prompt_len - 8)
+    n_req = 16
+    ecfg = EngineConfig(max_batch_size=16, max_seq_len=msl,
+                        prefill_batch_size=8, busy_span=4,
+                        prefill_buckets=(prompt_len,))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+
+    engine_off = InferenceEngine(params, cfg, ecfg)  # stock locks
+    sites_before = len(sanitizer._sites)
+    # huge hold budget: the burst legitimately holds scheduler locks for
+    # ms-scale stretches and report I/O must not pollute the timing — the
+    # hold CHECK (monotonic diff on release) still runs and is measured
+    sanitizer.install(hold_ms=60_000.0)
+    engine_on = InferenceEngine(params, cfg, ecfg)   # tracked locks
+    sanitizer.uninstall()
+
+    for engine in (engine_off, engine_on):
+        engine.warmup(buckets=[prompt_len])
+        engine.generate(prompts[0], max_tokens=4)
+
+    def run(on: bool) -> float:
+        if on:
+            sanitizer.install(hold_ms=60_000.0)
+        try:
+            results, wall = _serve_burst(engine_on if on else engine_off,
+                                         prompts, max_tokens)
+        finally:
+            if on:
+                sanitizer.uninstall()
+        return sum(len(r["token_ids"]) for r in results) / wall
+
+    run(False)  # throwaway: steady-state
+    rounds = 5
+    samples = {False: [], True: []}
+    for _ in range(rounds):  # strictly alternating
+        for on in (False, True):
+            samples[on].append(run(on))
+    tracked_locks = len(sanitizer._sites) - sites_before
+    engine_off.stop()
+    engine_on.stop()
+    sanitizer.clear_reports()
+    if tracked_locks <= 0:
+        raise RuntimeError("sanitized rounds tracked no locks — the 'on' "
+                           "engine is running on stock primitives")
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    tps_off, tps_on = median(samples[False]), median(samples[True])
+    overhead_pct = 100.0 * (tps_off - tps_on) / max(tps_off, 1e-9)
+
+    # micro-cost: one tracked acquire+release pair vs the stock primitive
+    n_ops = 100_000
+    stock = sanitizer._real_allocate()
+    stock_ns = timeit.timeit(
+        lambda: (stock.acquire(), stock.release()), number=n_ops) / n_ops * 1e9
+    tracked = sanitizer._TrackedLock()
+    tracked_ns = timeit.timeit(
+        lambda: (tracked.acquire(), tracked.release()),
+        number=n_ops) / n_ops * 1e9
+
+    mname = model.replace("-", "_")
+    print(
+        f"# sanitize: model={model} n_req={n_req} prompt={prompt_len} "
+        f"max_tokens={max_tokens} tok/s off={tps_off:.1f} on={tps_on:.1f} "
+        f"tracked_locks={tracked_locks} acquire_release "
+        f"stock={stock_ns:.0f}ns tracked={tracked_ns:.0f}ns",
+        file=sys.stderr,
+    )
+    _emit(f"serve_unsanitized_tok_per_s_{mname}", tps_off, "tokens/s",
+          "serve_sanitize_off_anchor")
+    _emit(f"serve_sanitized_tok_per_s_{mname}", tps_on, "tokens/s",
+          "serve_sanitize_on_anchor")
+    _emit("sanitizer_overhead_pct", overhead_pct, "%",
+          "sanitizer_overhead_anchor", lower_is_better=True)
+    _emit("sanitizer_acquire_release_ns", tracked_ns, "ns",
+          "sanitizer_acquire_release_anchor", lower_is_better=True)
+
+
 def _bench_serve_spec(cfg, mname: str, rng, n_req: int) -> None:
     """Speculative-decoding serve pass (opt-in via RAY_TPU_BENCH_SPEC=1:
     the default serve rows stay anchor-comparable). Draft-mode
@@ -1384,6 +1495,10 @@ def main() -> None:
         # sampling-profiler overhead: profiled vs unprofiled serve burst.
         # Latency-sensitive like trace/health — before the throughput block.
         bench_profile(model)
+    if "sanitize" in wanted:
+        # concurrency-sanitizer overhead: tracked-locks vs stock-locks
+        # serve burst. Latency-sensitive like trace/health/profile.
+        bench_sanitize(model)
     if "grpo" in wanted:
         # rollout generate pays per-TOKEN dispatches — as latency-bound
         # as serve TTFT, and equally poisoned by the HBM churn the train/

@@ -37,6 +37,14 @@ from .core.task_spec import (  # noqa: F401
 
 __version__ = "0.1.0"
 
+# RAY_TPU_SANITIZE=1 arms the concurrency sanitizer (instrumented
+# Lock/RLock, lock-order-cycle + hold-time detection) in every process
+# that imports ray_tpu — workers inherit the env var, so one flag covers
+# the whole cluster. No-op (stock primitives, zero overhead) otherwise.
+from .util import sanitizer as _sanitizer  # noqa: E402
+
+_sanitizer.maybe_install()
+
 
 def timeline(path: str) -> int:
     """Export the task-event timeline as chrome-trace JSON (open in

@@ -295,7 +295,7 @@ def _make_options(kwargs: Dict[str, Any]) -> TaskOptions:
         resources=kwargs.pop("resources", {}) or {},
         max_retries=kwargs.pop("max_retries", None),
         retry_exceptions=kwargs.pop("retry_exceptions", False),
-        max_restarts=kwargs.pop("max_restarts", 0),
+        max_restarts=kwargs.pop("max_restarts", config.actor_max_restarts),
         max_task_retries=kwargs.pop("max_task_retries", 0),
         name=kwargs.pop("name", ""),
         scheduling_strategy=kwargs.pop("scheduling_strategy", None) or TaskOptions().scheduling_strategy,

@@ -14,6 +14,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import api as _api
+from ..core.config import config
 from ..core.logging import get_logger
 
 logger = get_logger("host_collectives")
@@ -72,7 +73,9 @@ class CollectiveGroup:
             except ValueError:
                 return _api.get_actor(actor_name)  # lost the creation race
 
-    def barrier(self, timeout_s: float = 60.0) -> None:
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        if timeout_s is None:
+            timeout_s = config.gang_barrier_timeout_ms / 1000.0
         target = _api.get(self._actor.generation.remote()) + 1
         _api.get(self._actor.arrive.remote())
         deadline = time.monotonic() + timeout_s

@@ -81,7 +81,6 @@ declare(
 )
 declare("task_max_retries", 3, "Default retries for tasks on worker/node death.")
 declare("actor_max_restarts", 0, "Default actor restarts on failure.")
-declare("lease_timeout_ms", 10_000, "Worker lease grant timeout.")
 declare("scheduler_top_k_fraction", 0.2, "Top-k fraction for hybrid scheduling.")
 declare("scheduler_spread_threshold", 0.5, "Utilization below which local wins.")
 declare("health_check_period_ms", 1_000, "Control-plane health check interval.")
@@ -90,7 +89,6 @@ declare("health_check_timeout_ms", 10_000, "Misses before a node is declared dea
 # Object store
 declare("object_store_memory_bytes", 0, "Host shm store capacity; 0 = 30% of RAM.")
 declare("object_store_fallback_dir", "/tmp/ray_tpu_spill", "Spill directory.")
-declare("object_inline_max_bytes", 100 * 1024, "Small objects travel inline.")
 declare("object_transfer_chunk_bytes", 1024 * 1024, "Inter-node chunk size.")
 declare(
     "get_concurrency", 8,
@@ -155,12 +153,10 @@ declare(
 
 # Gang / TPU
 declare("gang_barrier_timeout_ms", 60_000, "SPMD gang entry barrier timeout.")
-declare("slice_restart_max", 3, "Max gang restarts before failing the job.")
 declare("device_prefetch_depth", 2, "Host->HBM double buffering depth.")
 
 # Observability
 declare("log_to_driver", True, "Tail worker logs back to the driver process.")
-declare("metrics_export_port", 0, "Prometheus port; 0 = disabled.")
 declare("event_log_dir", "", "Structured event-log directory; empty = session dir.")
 declare("task_events_max_buffer", 10_000, "Ring-buffer size for task events.")
 declare(
@@ -288,6 +284,21 @@ declare(
 declare(
     "control_plane_snapshot_interval_s", 5.0,
     "Seconds between control-plane snapshots when persistence is on.",
+)
+
+# Correctness tooling (util/sanitizer.py, ray_tpu.tools.raylint)
+declare(
+    "sanitize", False,
+    "RAY_TPU_SANITIZE=1 swaps threading.Lock/RLock for instrumented "
+    "wrappers at import time: acquisition order feeds a per-process "
+    "lock-order graph (cycles = potential deadlock) and long holds are "
+    "flagged, both reported through the flight recorder. Off = the "
+    "stock primitives, zero overhead.",
+)
+declare(
+    "sanitize_hold_ms", 100.0,
+    "Sanitizer lock-hold budget: releasing a lock held longer than this "
+    "(blocking work under a lock) records a hold-time violation.",
 )
 
 

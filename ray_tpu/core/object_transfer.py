@@ -541,10 +541,11 @@ class ObjectTransferClient:
     reference pools object-manager RPC channels likewise; here the pool
     additionally lets concurrent pulls from one holder overlap)."""
 
-    def __init__(self, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    def __init__(self, chunk_bytes: Optional[int] = None,
                  pool_conns: Optional[int] = None,
                  chunk_window: Optional[int] = None):
-        self.chunk_bytes = int(chunk_bytes)
+        self.chunk_bytes = int(chunk_bytes if chunk_bytes is not None
+                               else config.object_transfer_chunk_bytes)
         self.pool_conns = int(pool_conns if pool_conns is not None
                               else config.object_transfer_pool_conns)
         self.chunk_window = max(1, int(

@@ -26,6 +26,13 @@ client. The client proxy serializes request/response pairs over one
 socket with a lock and routes pushed events to its Pubsub from a
 per-connection reader thread; a short-lived reconnect thread re-dials
 after a loss and exits once a connection is installed.
+
+Registry invariant (machine-enforced by `ray_tpu.tools.raylint` rule R3):
+`_IDEMPOTENT_METHODS` must be a subset of `_ALLOWED_METHODS` — a
+transparently retried method that isn't served would loop into
+'method not served' rejections. New control-plane methods must be added
+to `_ALLOWED_METHODS` and, deliberately, to `_IDEMPOTENT_METHODS` only
+when a blind resend after an ambiguous connection loss is safe.
 """
 
 from __future__ import annotations
@@ -68,6 +75,10 @@ _ALLOWED_METHODS: Set[str] = {
     "proxy_submit_actor_task", "proxy_kill_actor", "proxy_ref_state",
     "proxy_put", "proxy_pin", "proxy_free", "proxy_get_value",
     "proxy_keepalive", "proxy_submit_streaming",
+    # pubsub registration: dispatched before the allowlist check in the
+    # handler (it mutates per-connection push state), but it belongs here
+    # so the registry invariant (idempotent ⊆ allowed) holds
+    "subscribe",
 }
 
 # Methods safe to resend after an ambiguous connection loss (the reply may

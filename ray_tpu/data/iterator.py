@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import numpy as np
 
 from .. import api
+from ..core.config import config
 from .block import BlockAccessor
 from .executor import _m_stall
 
@@ -239,7 +240,7 @@ class DataIterator:
         self,
         batch_size: int,
         sharding: Optional[Any] = None,
-        prefetch: int = 2,
+        prefetch: Optional[int] = None,
         drop_last: bool = True,
         transform: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None,
         host_prefetch_batches: int = 2,
@@ -256,6 +257,9 @@ class DataIterator:
         gang mesh batch sharding for SPMD ingestion.
         """
         import jax
+
+        if prefetch is None:
+            prefetch = config.device_prefetch_depth
 
         def host_iter():
             for batch in self._iter_batches_inline(

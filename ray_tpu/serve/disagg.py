@@ -924,8 +924,12 @@ class DisaggCoordinator:
         return co
 
     def _controller_handle(self):
+        # double-checked: two racing _sync threads must not both resolve
+        # the controller (raylint R1); callers never hold self._lock here
         if self._controller is None:
-            self._controller = api.get_actor("SERVE_CONTROLLER")
+            with self._lock:
+                if self._controller is None:
+                    self._controller = api.get_actor("SERVE_CONTROLLER")
         return self._controller
 
     def _sync(self, force: bool = False) -> None:

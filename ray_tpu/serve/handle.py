@@ -37,8 +37,12 @@ class DeploymentHandle:
         self._lock = threading.Lock()
 
     def _controller_handle(self):
+        # double-checked: two racing _sync threads must not both resolve
+        # the controller (raylint R1)
         if self._controller is None:
-            self._controller = api.get_actor("SERVE_CONTROLLER")
+            with self._lock:
+                if self._controller is None:
+                    self._controller = api.get_actor("SERVE_CONTROLLER")
         return self._controller
 
     def _sync(self, force: bool = False):

@@ -44,8 +44,10 @@ class ServeReplica:
         from .multiplex import _current_model_id
 
         if self._sem is None:
-            # lazily bound to the replica's event loop
-            self._sem = asyncio.Semaphore(max(1, self.max_ongoing_requests))
+            # lazily bound to the replica's event loop: this runs on the
+            # single event loop before any await, so there is no
+            # interleaving point — a lock here would be theater
+            self._sem = asyncio.Semaphore(max(1, self.max_ongoing_requests))  # raylint: disable=R1
         with self._lock:
             # counts queued + executing: the autoscaler's load signal must
             # see pressure beyond max_ongoing, not just what's running

@@ -1,0 +1,2 @@
+"""Developer-facing correctness tooling (raylint). Not imported by the
+runtime — `python -m ray_tpu.tools.raylint` is the entry point."""

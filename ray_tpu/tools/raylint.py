@@ -1,0 +1,928 @@
+"""raylint — an AST linter codifying this repo's recurring bug classes.
+
+Every rule below is a pattern-match distilled from a defect that actually
+shipped and had to be hand-found in a later PR (see CHANGES.md): unlocked
+lazy init minting orphan KV inboxes, pubsub callback leaks, fd leaks in
+the transfer pool, blocking work dispatched on an RPC read loop, spans
+left open on early-return paths, config knobs that drifted from the
+central registry. The linter runs clean over the shipped tree (`make
+lint`); a finding is either a real bug or gets an inline pragma with a
+justification:
+
+    self._x = build()  # raylint: disable=R1 — single-threaded builder
+
+Pragmas attach to the FIRST line of the flagged statement and accept rule
+ids (`R1`) or slugs (`unlocked-lazy-init`), comma-separated, or `all`.
+
+Rules
+-----
+R1 unlocked-lazy-init
+    `if self._x is None: self._x = ...` on a class that also owns
+    threading state (locks/threads/conditions), where the assignment is
+    not under a `with <lock>` — two racing threads each see None and mint
+    two objects (the PR 11 `kv_ingest`/`kv_dest` orphan-inbox bug). The
+    fix is a double-checked lock: re-test under the lock. Classes with no
+    threading surface are skipped (plain lazy caching is fine there).
+
+R2 blocking-under-lock
+    A blocking call — `api.get`/`api.wait`, channel/queue `recv`/`put`,
+    socket receive/connect/accept, `<thread>.join()`, `time.sleep`,
+    `<event>.wait()` — while lexically inside `with <lock>`: every other
+    thread needing that lock stalls for the full blocking duration (and a
+    cycle deadlocks). `cv.wait()` on the held condition is exempt (it
+    releases the lock); frame *sends* under a per-connection send lock
+    are the framework's deliberate serialization pattern and are not
+    flagged. The same blocking set is also flagged anywhere inside an RPC
+    read-loop method (`_read_loop`/`_recv_loop`/`_handle_conn`) except
+    the loop's own receives — the PR 9 rule that moved `profile_fetch`
+    (which blocks in `dump_child`) off the dispatch read loop.
+
+R3 rpc-registry
+    `core/rpc.py` consistency: `_IDEMPOTENT_METHODS` ⊆
+    `_ALLOWED_METHODS` (a transparently-retried method that is not
+    served would retry forever into rejections), and no duplicate
+    entries in either literal. Methods are added to exactly one or both
+    sets deliberately; the docstrings in rpc.py state the contract this
+    rule enforces.
+
+R4 daemon-thread
+    `threading.Thread(...)`/`Timer(...)` with neither a `daemon=` kwarg
+    nor a visible lifecycle: an implicit non-daemon thread blocks
+    interpreter exit forever if its loop doesn't terminate (the class of
+    silent hang that makes MPMD pipelines wedge rather than fail). The
+    call is accepted when it passes `daemon=` explicitly, or when the
+    file shows a `.join(...)` / `.daemon = ...` on the receiving
+    variable (a registered stop/join path).
+
+R5 span-leak
+    A manually-owned span (`tracing.maybe_begin(...)` / `tracing.Span(...)`
+    bound to a local) whose `.finish()` is not guaranteed on all exit
+    paths: `finish()` must sit in a `finally` block, or the span must
+    escape (returned / stored / passed on — ownership transfer). Since
+    `Span.finish` is idempotent the mechanical fix is wrapping the body
+    in try/finally. (The with-statement forms `start_span`/
+    `span_if_traced` finish themselves and are never flagged.)
+
+R6 config-knob
+    Every `config.<flag>` / `config.get("<flag>")` read (on the central
+    `core.config.config` object) must name a flag `declare()`d somewhere
+    in the tree, and every declared flag must be read somewhere — dead
+    knobs are flagged at their declaration (a knob nobody reads silently
+    stops gating anything when its call-site is refactored away).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "R1": "unlocked-lazy-init",
+    "R2": "blocking-under-lock",
+    "R3": "rpc-registry",
+    "R4": "daemon-thread",
+    "R5": "span-leak",
+    "R6": "config-knob",
+}
+_SLUG_TO_ID = {slug: rid for rid, slug in RULES.items()}
+
+_PRAGMA_RE = re.compile(r"#\s*raylint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}"
+                f"({RULES[self.rule]}): {self.message}")
+
+
+# ---------------------------------------------------------------------------
+# pragma handling
+# ---------------------------------------------------------------------------
+
+def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
+    """line -> set of disabled rule ids ('*' disables all)."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules: Set[str] = set()
+            for part in m.group(1).split(","):
+                part = part.strip().split()[0] if part.strip() else ""
+                if not part:
+                    continue
+                if part.lower() == "all":
+                    rules.add("*")
+                elif part in RULES:
+                    rules.add(part)
+                elif part in _SLUG_TO_ID:
+                    rules.add(_SLUG_TO_ID[part])
+            out.setdefault(tok.start[0], set()).update(rules)
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def _suppressed(pragmas: Dict[int, Set[str]], line: int, rule: str) -> bool:
+    rules = pragmas.get(line)
+    return bool(rules) and ("*" in rules or rule in rules)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+_LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+_CONDISH = re.compile(r"\bcv\b|cond", re.IGNORECASE)
+
+
+def _dump(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ast.dump(node)
+
+
+def _expr_idents(expr: ast.AST) -> List[str]:
+    """Identifier tokens (names + attribute names) in an expression —
+    string constants deliberately excluded so payload text can't
+    pattern-match as a lock."""
+    out: List[str] = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.append(node.attr)
+    return out
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """A `with` context manager that names a lock/condition — the
+    heuristic both R1 (what guards a lazy init) and R2 (what is held)
+    share."""
+    return any(_LOCKISH.search(ident) or _CONDISH.search(ident)
+               for ident in _expr_idents(expr))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'self.X' / 'cls.X' attribute name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# R1: unlocked lazy init
+# ---------------------------------------------------------------------------
+
+def _class_is_concurrent(cls: ast.ClassDef) -> bool:
+    """Does this class own any threading surface? Lock/Condition/Thread
+    construction or lock-named attributes anywhere in its body."""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name in ("Lock", "RLock", "Condition", "Thread", "Timer",
+                        "Event", "Semaphore", "BoundedSemaphore"):
+                return True
+        if isinstance(node, ast.Attribute) and _LOCKISH.search(node.attr):
+            return True
+    return False
+
+
+class _R1Visitor(ast.NodeVisitor):
+    def __init__(self, findings: List[Finding], path: str):
+        self.findings = findings
+        self.path = path
+        self._class_stack: List[bool] = []   # concurrent?
+        self._func_stack: List[str] = []
+        self._with_lock_depth = 0
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(_class_is_concurrent(node))
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(_is_lockish(item.context_expr) for item in node.items)
+        if lockish:
+            self._with_lock_depth += 1
+        self.generic_visit(node)
+        if lockish:
+            self._with_lock_depth -= 1
+
+    def visit_If(self, node: ast.If) -> None:
+        attr = self._lazy_test_attr(node.test)
+        if (attr is not None
+                and self._class_stack and self._class_stack[-1]
+                and self._func_stack
+                and self._func_stack[-1] not in ("__init__", "__new__",
+                                                 "__init_subclass__")):
+            self._check_lazy_body(node, attr)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _lazy_test_attr(test: ast.AST) -> Optional[str]:
+        # `self.X is None`  /  `not self.X`
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Is)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None):
+            return _self_attr(test.left)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return _self_attr(test.operand)
+        return None
+
+    def _check_lazy_body(self, node: ast.If, attr: str) -> None:
+        """Flag assignments to the tested attr in the If body that are
+        not themselves under a with-lock (the double-checked pattern puts
+        the re-test + assign under the lock and stays clean)."""
+        base_depth = self._with_lock_depth
+        if base_depth > 0:
+            return  # the whole test already runs under a lock
+
+        class _AssignFinder(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.hits: List[int] = []
+                self._depth = 0
+
+            def visit_With(self, w: ast.With) -> None:
+                lockish = any(_is_lockish(i.context_expr) for i in w.items)
+                self._depth += 1 if lockish else 0
+                self.generic_visit(w)
+                self._depth -= 1 if lockish else 0
+
+            def visit_Assign(self, a: ast.Assign) -> None:
+                if self._depth == 0:
+                    for t in a.targets:
+                        if _self_attr(t) == attr:
+                            self.hits.append(a.lineno)
+                self.generic_visit(a)
+
+            # nested function bodies run later, in unknown lock context
+            def visit_FunctionDef(self, f) -> None:
+                pass
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+        finder = _AssignFinder()
+        for stmt in node.body:
+            finder.visit(stmt)
+        for lineno in finder.hits:
+            self.findings.append(Finding(
+                self.path, lineno, "R1",
+                f"lazy init of shared 'self.{attr}' without a lock: two "
+                f"racing threads can both see None and construct twice — "
+                f"use a double-checked lock (re-test under the lock)"))
+
+
+# ---------------------------------------------------------------------------
+# R2: blocking call while holding a lock / on an RPC read loop
+# ---------------------------------------------------------------------------
+
+_READ_LOOP_NAMES = ("_read_loop", "_recv_loop", "_handle_conn", "read_loop")
+
+# receive-side socket ops + unbounded connects; sends are the framework's
+# deliberate under-send-lock serialization pattern and stay exempt
+_BLOCKING_ATTRS = {"recv", "recv_msg", "accept", "connect",
+                   "create_connection", "recv_into"}
+_CHANNELISH = re.compile(r"chan|queue|inbox|mailbox", re.IGNORECASE)
+
+
+class _R2Visitor(ast.NodeVisitor):
+    def __init__(self, findings: List[Finding], path: str):
+        self.findings = findings
+        self.path = path
+        self._held: List[str] = []       # dumps of held lock exprs
+        self._read_loop_depth = 0
+
+    def _visit_func(self, node) -> None:
+        # a fresh function body neither holds the enclosing scope's locks
+        # nor runs on its read loop (nested defs are dispatched elsewhere)
+        held, self._held = self._held, []
+        prev_rl = self._read_loop_depth
+        self._read_loop_depth = 1 if node.name in _READ_LOOP_NAMES else 0
+        self.generic_visit(node)
+        self._read_loop_depth = prev_rl
+        self._held = held
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        added = [
+            _dump(item.context_expr) for item in node.items
+            if _is_lockish(item.context_expr)
+        ]
+        self._held.extend(added)
+        self.generic_visit(node)
+        del self._held[len(self._held) - len(added):]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        reason = self._blocking_reason(node)
+        if reason is not None:
+            if self._held:
+                self.findings.append(Finding(
+                    self.path, node.lineno, "R2",
+                    f"{reason} while holding {self._held[-1]!r}: every "
+                    f"thread contending on that lock stalls for the full "
+                    f"blocking duration — move the call outside the lock"))
+            elif self._read_loop_depth > 0 and not self._is_own_recv(node):
+                self.findings.append(Finding(
+                    self.path, node.lineno, "R2",
+                    f"{reason} inside an RPC read loop: a blocked "
+                    f"dispatch starves every other request on this "
+                    f"connection — hand the work to another thread"))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_own_recv(node: ast.Call) -> bool:
+        """The read loop's own receive — its job, not a finding."""
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        return name in ("recv", "recv_msg", "recv_into")
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "recv_msg":
+                return "blocking frame receive"
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        base = _dump(fn.value)
+        attr = fn.attr
+        if attr in ("get", "wait") and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("api", "ray", "ray_tpu"):
+            return f"blocking {fn.value.id}.{attr}()"
+        if attr in _BLOCKING_ATTRS:
+            return f"blocking socket/channel .{attr}()"
+        if attr == "sleep" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            return "time.sleep()"
+        if attr in ("put", "put_many") and _CHANNELISH.search(base):
+            return f"blocking channel/queue .{attr}()"
+        if attr == "join":
+            return "thread .join()" if self._is_thread_join(node) else None
+        if attr == "wait":
+            # cv.wait() releases the held lock — correct; event.wait()
+            # and friends do not
+            if any(_CONDISH.search(i) for i in _expr_idents(fn.value)):
+                return None
+            if any(base == held for held in self._held):
+                return None
+            return f"blocking {base}.wait()"
+        return None
+
+    @staticmethod
+    def _is_thread_join(node: ast.Call) -> bool:
+        """Distinguish thread.join([timeout]) from str.join(iterable):
+        zero args or a single numeric/keyword timeout is a thread join;
+        one non-numeric positional arg is a string join."""
+        if isinstance(node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Constant):
+            return False  # "sep".join(...)
+        if len(node.args) == 0:
+            return True
+        if len(node.args) == 1:
+            a = node.args[0]
+            return isinstance(a, ast.Constant) and isinstance(
+                a.value, (int, float))
+        return False
+
+
+# ---------------------------------------------------------------------------
+# R3: rpc registry consistency (core/rpc.py)
+# ---------------------------------------------------------------------------
+
+def _check_rpc_registry(path: str, tree: ast.Module,
+                        findings: List[Finding]) -> None:
+    sets: Dict[str, Tuple[int, List[str]]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.AnnAssign) and not isinstance(
+                node, ast.Assign):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        value = node.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in (
+                    "_ALLOWED_METHODS", "_IDEMPOTENT_METHODS"):
+                if isinstance(value, ast.Set) and all(
+                        isinstance(e, ast.Constant) for e in value.elts):
+                    sets[t.id] = (node.lineno,
+                                  [e.value for e in value.elts])
+                else:
+                    findings.append(Finding(
+                        path, node.lineno, "R3",
+                        f"{t.id} must be a literal set of strings so the "
+                        f"registry stays machine-checkable"))
+    if "_ALLOWED_METHODS" not in sets or "_IDEMPOTENT_METHODS" not in sets:
+        findings.append(Finding(
+            path, 1, "R3",
+            "core/rpc.py must declare both _ALLOWED_METHODS and "
+            "_IDEMPOTENT_METHODS as literal sets"))
+        return
+    for name, (lineno, elts) in sets.items():
+        seen: Set[str] = set()
+        for e in elts:
+            if e in seen:
+                findings.append(Finding(
+                    path, lineno, "R3", f"duplicate entry {e!r} in {name}"))
+            seen.add(e)
+    allowed = set(sets["_ALLOWED_METHODS"][1])
+    idem_line, idem = sets["_IDEMPOTENT_METHODS"]
+    for name in sorted(set(idem) - allowed):
+        findings.append(Finding(
+            path, idem_line, "R3",
+            f"{name!r} is in _IDEMPOTENT_METHODS but not in "
+            f"_ALLOWED_METHODS: a transparent retry would loop into "
+            f"'method not served' rejections — allowlist it or drop it"))
+
+
+# ---------------------------------------------------------------------------
+# R4: daemon-thread hygiene
+# ---------------------------------------------------------------------------
+
+class _R4Visitor(ast.NodeVisitor):
+    """Two passes: collect lifecycle evidence (joins / .daemon assigns)
+    file-wide, then flag bare Thread()/Timer() constructions."""
+
+    def __init__(self, findings: List[Finding], path: str, tree: ast.Module):
+        self.findings = findings
+        self.path = path
+        self._joined: Set[str] = set()
+        self._daemonized: Set[str] = set()
+        self._in_comp = 0
+        self._accepted: Set[int] = set()  # id()s of pooled ctor calls
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("join", "setDaemon")):
+                self._joined.add(_dump(node.func.value))
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        self._daemonized.add(_dump(t.value))
+
+    @staticmethod
+    def _is_thread_ctor(node: ast.Call) -> bool:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "threading" \
+                and fn.attr in ("Thread", "Timer"):
+            return True
+        return isinstance(fn, ast.Name) and fn.id in ("Thread", "Timer")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call) and self._is_thread_ctor(
+                node.value):
+            self._check(node.value, targets=node.targets)
+            # don't re-visit the call generically
+            for t in node.targets:
+                self.visit(t)
+            for a in node.value.args:
+                self.visit(a)
+            for kw in node.value.keywords:
+                self.visit(kw.value)
+            return
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        self._in_comp += 1
+        self.generic_visit(node)
+        self._in_comp -= 1
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # threads.append(Thread(...)) — pooled into a collection that the
+        # file later iterates and joins
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"):
+            for arg in node.args:
+                if isinstance(arg, ast.Call) and self._is_thread_ctor(arg):
+                    self._accepted.add(id(arg))
+        if self._is_thread_ctor(node):
+            self._check(node, targets=[])
+        self.generic_visit(node)
+
+    def _check(self, call: ast.Call, targets: List[ast.AST]) -> None:
+        if any(kw.arg == "daemon" for kw in call.keywords):
+            return
+        # pooled pattern: [Thread(...) for ...] / threads.append(Thread(...))
+        # with SOME thread joined in this file — the collection is the
+        # lifecycle (`for t in threads: t.join()`)
+        if (self._in_comp > 0 or id(call) in self._accepted) and self._joined:
+            return
+        for t in targets:
+            d = _dump(t)
+            if d in self._joined or d in self._daemonized:
+                return
+        self.findings.append(Finding(
+            self.path, call.lineno, "R4",
+            "thread created with neither daemon= nor a visible "
+            ".join()/.daemon lifecycle in this file: an implicit "
+            "non-daemon thread blocks interpreter exit if its loop "
+            "doesn't terminate — pass daemon= explicitly or register a "
+            "stop/join path"))
+
+
+# ---------------------------------------------------------------------------
+# R5: span finished on all paths
+# ---------------------------------------------------------------------------
+
+_SPAN_CTORS = {"maybe_begin", "Span"}
+
+
+def _walk_shallow(func) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function
+    definitions (their bindings/paths are analyzed on their own visit)."""
+    stack: List[ast.AST] = list(func.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _R5Visitor(ast.NodeVisitor):
+    def _visit_func(self, node) -> None:
+        self._check_function(node)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def __init__(self, findings: List[Finding], path: str):
+        self.findings = findings
+        self.path = path
+
+    @staticmethod
+    def _span_ctor_name(call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id in _SPAN_CTORS:
+            return fn.id
+        if isinstance(fn, ast.Attribute) and fn.attr in _SPAN_CTORS:
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "tracing":
+                return fn.attr
+        return None
+
+    def _check_function(self, func) -> None:
+        body_nodes = list(_walk_shallow(func))
+        # bindings: name -> (lineno, ctor)
+        bindings: Dict[str, Tuple[int, str]] = {}
+        for node in body_nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                ctor = self._span_ctor_name(node.value)
+                if ctor and len(node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name):
+                    bindings[node.targets[0].id] = (node.lineno, ctor)
+        if not bindings:
+            return
+        safe: Set[str] = set()
+        plain_finish: Dict[str, int] = {}
+
+        def _in_finally(target: ast.AST) -> bool:
+            for node in body_nodes:
+                if isinstance(node, ast.Try):
+                    for fin_stmt in node.finalbody:
+                        for sub in ast.walk(fin_stmt):
+                            if sub is target:
+                                return True
+            return False
+
+        for node in body_nodes:
+            # a closure capturing the span owns its teardown (stream
+            # generators, pool callbacks) — deferred ownership, not a leak
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id in bindings:
+                        safe.add(sub.id)
+                continue
+            # span.finish() — where?
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "finish"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in bindings):
+                name = node.func.value.id
+                if _in_finally(node):
+                    safe.add(name)
+                else:
+                    plain_finish[name] = max(
+                        plain_finish.get(name, 0), node.lineno)
+            # escapes: ownership transfer
+            if isinstance(node, ast.Call):
+                fn = node.func
+                callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if callee in ("activate", "finish"):
+                    continue  # activate() does NOT finish; not an escape
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in bindings:
+                        safe.add(arg.id)
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id in bindings:
+                        safe.add(sub.id)
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id in bindings:
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            safe.add(node.value.id)  # stored away
+        for name, (lineno, ctor) in bindings.items():
+            if name in safe:
+                continue
+            last_finish = plain_finish.get(name)
+            if last_finish is None:
+                self.findings.append(Finding(
+                    self.path, lineno, "R5",
+                    f"span {name!r} from {ctor}() is never finished or "
+                    f"handed off in this function — it will record "
+                    f"nothing and leak out of the buffer"))
+                continue
+            # straight-line finish: any return/raise between bind and
+            # finish skips it (finish is idempotent — move it to finally)
+            for node in body_nodes:
+                if isinstance(node, (ast.Return, ast.Raise)) \
+                        and lineno < node.lineno < last_finish:
+                    self.findings.append(Finding(
+                        self.path, lineno, "R5",
+                        f"span {name!r} has a return/raise path (line "
+                        f"{node.lineno}) that skips its finish() on line "
+                        f"{last_finish} — finish() is idempotent, move "
+                        f"it into a finally block"))
+                    break
+
+
+# ---------------------------------------------------------------------------
+# R6: config-knob consistency (cross-file)
+# ---------------------------------------------------------------------------
+
+_CONFIG_IMPORT_RE = re.compile(
+    r"from\s+(?:ray_tpu\.core\.config|\.+core\.config|\.config)\s+import\s+"
+    r"[^\n]*\bconfig\b")
+_CONFIG_METHODS = {"get", "reset", "apply_overrides"}
+
+
+def _collect_declares(tree: ast.Module) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if name == "declare" and node.args and isinstance(
+                    node.args[0], ast.Constant):
+                out.append((node.args[0].value, node.lineno))
+    return out
+
+
+class _ConfigReadVisitor(ast.NodeVisitor):
+    """config.<flag> / config.get("<flag>") reads, skipping scopes where
+    `config` is rebound (a parameter or local assignment shadows the
+    module import)."""
+
+    def __init__(self) -> None:
+        self.reads: List[Tuple[str, int]] = []
+        self._shadow_depth = 0
+
+    def _visit_func(self, node) -> None:
+        args = node.args
+        names = {a.arg for a in args.args + args.kwonlyargs
+                 + args.posonlyargs}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        shadows = "config" in names or any(
+            isinstance(t, ast.Name) and t.id == "config"
+            for sub in ast.walk(node) if isinstance(sub, ast.Assign)
+            for t in sub.targets)
+        self._shadow_depth += 1 if shadows else 0
+        self.generic_visit(node)
+        self._shadow_depth -= 1 if shadows else 0
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (self._shadow_depth == 0
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "config"
+                and not node.attr.startswith("_")
+                and node.attr not in _CONFIG_METHODS):
+            self.reads.append((node.attr, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (self._shadow_depth == 0
+                and isinstance(fn, ast.Attribute) and fn.attr == "get"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "config"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            self.reads.append((node.args[0].value, node.lineno))
+        self.generic_visit(node)
+
+
+def _check_config_knobs(files: Dict[str, Tuple[str, ast.Module]],
+                        pragmas: Dict[str, Dict[int, Set[str]]],
+                        findings: List[Finding]) -> None:
+    declares: Dict[str, Tuple[str, int]] = {}
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    for path, (source, tree) in files.items():
+        for name, lineno in _collect_declares(tree):
+            declares.setdefault(name, (path, lineno))
+        if not _CONFIG_IMPORT_RE.search(source):
+            continue
+        visitor = _ConfigReadVisitor()
+        visitor.visit(tree)
+        for name, lineno in visitor.reads:
+            reads.setdefault(name, []).append((path, lineno))
+    if not declares:
+        return  # not linting the real tree (fixture runs)
+    for name, sites in sorted(reads.items()):
+        if name in declares:
+            continue
+        for path, lineno in sites:
+            if _suppressed(pragmas.get(path, {}), lineno, "R6"):
+                continue
+            findings.append(Finding(
+                path, lineno, "R6",
+                f"config.{name} is not declared in the flag registry "
+                f"(core/config.py declare()): this read raises "
+                f"AttributeError/KeyError at runtime"))
+    for name, (path, lineno) in sorted(declares.items()):
+        if name in reads:
+            continue
+        if _suppressed(pragmas.get(path, {}), lineno, "R6"):
+            continue
+        findings.append(Finding(
+            path, lineno, "R6",
+            f"config flag {name!r} is declared but never read via "
+            f"config.{name} / config.get({name!r}) anywhere in the tree "
+            f"— a dead knob gates nothing; remove it or suppress with a "
+            f"justification"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_SKIP_PARTS = {"__pycache__", ".git", "protos"}
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in _SKIP_PARTS]
+            for name in sorted(names):
+                if name.endswith(".py") and not name.endswith("_pb2.py"):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def lint_sources(file_map: Dict[str, str],
+                 rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint in-memory {path: source}. Per-file rules run on every file;
+    R3 runs on paths ending in core/rpc.py; R6 correlates across the
+    whole map (skipped when the map declares no flags)."""
+    rules = rules or set(RULES)
+    findings: List[Finding] = []
+    parsed: Dict[str, Tuple[str, ast.Module]] = {}
+    pragmas: Dict[str, Dict[int, Set[str]]] = {}
+    for path, source in sorted(file_map.items()):
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 1, "R3",
+                                    f"syntax error: {e.msg}"))
+            continue
+        parsed[path] = (source, tree)
+        pragmas[path] = _collect_pragmas(source)
+    for path, (source, tree) in parsed.items():
+        per_file: List[Finding] = []
+        if "R1" in rules:
+            _R1Visitor(per_file, path).visit(tree)
+        if "R2" in rules:
+            _R2Visitor(per_file, path).visit(tree)
+        if "R3" in rules and path.replace(os.sep, "/").endswith(
+                "core/rpc.py"):
+            _check_rpc_registry(path, tree, per_file)
+        if "R4" in rules:
+            _R4Visitor(per_file, path, tree).visit(tree)
+        if "R5" in rules:
+            _R5Visitor(per_file, path).visit(tree)
+        findings.extend(
+            f for f in per_file
+            if not _suppressed(pragmas[path], f.line, f.rule))
+    if "R6" in rules:
+        _check_config_knobs(parsed, pragmas, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Set[str]] = None) -> List[Finding]:
+    file_map: Dict[str, str] = {}
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                file_map[path] = f.read()
+        except OSError:
+            continue
+    return lint_sources(file_map, rules)
+
+
+def default_paths() -> List[str]:
+    """ray_tpu/ + tests/ relative to the repo root (two levels up)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = []
+    for name in ("ray_tpu", "tests"):
+        p = os.path.join(root, name)
+        if os.path.isdir(p):
+            out.append(p)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="raylint",
+        description="AST linter for ray_tpu's recurring bug classes")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: ray_tpu + tests)")
+    parser.add_argument("--rule", action="append", default=[],
+                        help="run only these rules (id or slug; repeatable)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rid, slug in RULES.items():
+            print(f"{rid}  {slug}")
+        return 0
+    rules: Optional[Set[str]] = None
+    if args.rule:
+        rules = set()
+        for r in args.rule:
+            rid = r if r in RULES else _SLUG_TO_ID.get(r)
+            if rid is None:
+                parser.error(f"unknown rule {r!r}")
+            rules.add(rid)
+    findings = lint_paths(args.paths or default_paths(), rules)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"raylint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("raylint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

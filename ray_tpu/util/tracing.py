@@ -35,6 +35,14 @@ Usage:
     tree = tracing.get_trace(...)     # incl. the task's execute span
                                       # (same trace_id, parented here)
 
+Span lifecycle invariant (machine-enforced by `ray_tpu.tools.raylint`
+rule R5): a span bound manually — `maybe_begin(...)` / `Span(...)`
+instead of the `start_span` context manager — must reach `finish()` on
+every path, i.e. in a `finally` or via an owner that finishes it later;
+a return/raise edge that skips `finish()` leaks the span out of the
+telemetry flush. `finish()` is idempotent, so the fix is mechanical:
+wrap the body in try/finally.
+
 Propagation is on only while a span is active — zero overhead otherwise
 (the spec field stays None). Serve entry points additionally open root
 spans for a `config.trace_sample_rate` fraction of requests (default 0:

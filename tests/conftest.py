@@ -137,3 +137,30 @@ def _test_watchdog(request):
     t.start()
     yield
     t.cancel()
+
+
+# --------------------------------------------------------------------------
+# Thread-leak guard (util/sanitizer.py): a test that leaves a non-daemon
+# thread running would hang the interpreter at exit; a test that nets
+# dozens of daemon threads indicates an unbounded spawn pattern. Opt out
+# with @pytest.mark.thread_leak_ok for tests that intentionally leak.
+# --------------------------------------------------------------------------
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "thread_leak_ok: skip the sanitizer thread-leak guard for this test")
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    from ray_tpu.util import sanitizer
+
+    before = sanitizer.thread_snapshot()
+    yield
+    if request.node.get_closest_marker("thread_leak_ok"):
+        return
+    problems = sanitizer.check_thread_leaks(before)
+    if problems:
+        pytest.fail("thread-leak guard: " + "; ".join(problems))

@@ -66,7 +66,7 @@ class TestConfig:
         with pytest.raises(KeyError):
             config.apply_overrides({"not_a_flag": 1})
         with pytest.raises(KeyError):
-            config.get("nope")
+            config.get("nope")  # raylint: disable=R6 — the unknown flag IS the test
 
     def test_bool_parsing(self, monkeypatch):
         monkeypatch.setenv("RAY_TPU_LOG_TO_DRIVER", "false")

@@ -1,0 +1,230 @@
+"""Rule tests for ray_tpu.tools.raylint: one known-bad and one known-good
+fixture per rule (R1-R6), pragma suppression, and the shipped tree staying
+clean."""
+
+import pytest
+
+from ray_tpu.tools import raylint
+
+# --------------------------------------------------------------------------
+# fixture snippets: rule -> (path, known_bad, known_good)
+# --------------------------------------------------------------------------
+
+_R1_BAD = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conn = None
+
+    def conn(self):
+        if self._conn is None:
+            self._conn = object()
+        return self._conn
+"""
+
+_R1_GOOD = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conn = None
+
+    def conn(self):
+        if self._conn is None:
+            with self._lock:
+                if self._conn is None:
+                    self._conn = object()
+        return self._conn
+"""
+
+_R2_BAD = """
+import threading
+from ray_tpu import api
+
+class Proxy:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fetch(self, ref):
+        with self._lock:
+            return api.get(ref)
+"""
+
+_R2_GOOD = """
+import threading
+from ray_tpu import api
+
+class Proxy:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fetch(self, ref):
+        with self._lock:
+            pending = ref
+        return api.get(pending)
+"""
+
+_R3_BAD = """
+_ALLOWED_METHODS = {"heartbeat", "get_node"}
+_IDEMPOTENT_METHODS = {"heartbeat", "subscribe"}
+"""
+
+_R3_GOOD = """
+_ALLOWED_METHODS = {"heartbeat", "get_node", "subscribe"}
+_IDEMPOTENT_METHODS = {"heartbeat", "subscribe"}
+"""
+
+_R4_BAD = """
+import threading
+
+def spawn(work):
+    t = threading.Thread(target=work)
+    t.start()
+"""
+
+_R4_GOOD = """
+import threading
+
+def spawn(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+"""
+
+_R5_BAD = """
+from ray_tpu.util import tracing
+
+def handle(cond):
+    span = tracing.maybe_begin("op")
+    if cond:
+        return None
+    span.finish()
+"""
+
+_R5_GOOD = """
+from ray_tpu.util import tracing
+
+def handle(cond):
+    span = tracing.maybe_begin("op")
+    try:
+        if cond:
+            return None
+    finally:
+        span.finish()
+"""
+
+_R6_CONFIG = """
+def declare(name, default, doc):
+    pass
+
+declare("used_flag", 1, "read below")
+declare("dead_flag", 2, "read nowhere")
+"""
+
+_R6_BAD_READER = """
+from ray_tpu.core.config import config
+
+def f():
+    return config.used_flag + config.missing_flag
+"""
+
+_R6_GOOD_READER = """
+from ray_tpu.core.config import config
+
+def f():
+    return config.used_flag + config.dead_flag
+"""
+
+
+def _rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+class TestRules:
+    @pytest.mark.parametrize("rule,bad,good", [
+        ("R1", _R1_BAD, _R1_GOOD),
+        ("R2", _R2_BAD, _R2_GOOD),
+        ("R4", _R4_BAD, _R4_GOOD),
+        ("R5", _R5_BAD, _R5_GOOD),
+    ])
+    def test_per_file_rule(self, rule, bad, good):
+        hits = raylint.lint_sources({"pkg/mod.py": bad}, rules={rule})
+        assert _rules_fired(hits) == {rule}, hits
+        assert raylint.lint_sources({"pkg/mod.py": good}, rules={rule}) == []
+
+    def test_r3_registry(self):
+        hits = raylint.lint_sources({"pkg/core/rpc.py": _R3_BAD},
+                                    rules={"R3"})
+        assert _rules_fired(hits) == {"R3"}
+        assert any("subscribe" in f.message for f in hits)
+        assert raylint.lint_sources({"pkg/core/rpc.py": _R3_GOOD},
+                                    rules={"R3"}) == []
+        # R3 only applies to core/rpc.py — same source elsewhere is ignored
+        assert raylint.lint_sources({"pkg/other.py": _R3_BAD},
+                                    rules={"R3"}) == []
+
+    def test_r6_knobs(self):
+        bad = raylint.lint_sources(
+            {"pkg/core/config.py": _R6_CONFIG, "pkg/user.py": _R6_BAD_READER},
+            rules={"R6"})
+        assert _rules_fired(bad) == {"R6"}
+        msgs = " | ".join(f.message for f in bad)
+        assert "missing_flag" in msgs       # undeclared read
+        assert "dead_flag" in msgs          # declared, never read
+        good = raylint.lint_sources(
+            {"pkg/core/config.py": _R6_CONFIG, "pkg/user.py": _R6_GOOD_READER},
+            rules={"R6"})
+        assert good == []
+
+
+class TestPragmas:
+    def test_inline_disable_suppresses_one_rule(self):
+        src = _R2_BAD.replace("return api.get(ref)",
+                              "return api.get(ref)  # raylint: disable=R2")
+        assert raylint.lint_sources({"pkg/mod.py": src}, rules={"R2"}) == []
+
+    def test_disable_is_rule_specific(self):
+        src = _R2_BAD.replace("return api.get(ref)",
+                              "return api.get(ref)  # raylint: disable=R5")
+        assert raylint.lint_sources({"pkg/mod.py": src}, rules={"R2"}) != []
+
+    def test_disable_all(self):
+        src = _R4_BAD.replace("t.start()", "t.start()").replace(
+            "t = threading.Thread(target=work)",
+            "t = threading.Thread(target=work)  # raylint: disable=all")
+        assert raylint.lint_sources({"pkg/mod.py": src}, rules={"R4"}) == []
+
+
+class TestDoubleCheckedVariants:
+    def test_assign_under_lock_in_same_branch_is_clean(self):
+        # lock taken around the whole test-and-set is also fine
+        src = _R1_GOOD.replace(
+            "if self._conn is None:\n            with self._lock:",
+            "with self._lock:\n            if self._conn is None:")
+        assert raylint.lint_sources({"pkg/mod.py": src}, rules={"R1"}) == []
+
+    def test_pooled_threads_joined_via_collection(self):
+        src = """
+import threading
+
+def fan_out(work):
+    threads = [threading.Thread(target=work) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+"""
+        assert raylint.lint_sources({"pkg/mod.py": src}, rules={"R4"}) == []
+
+
+def test_tree_is_clean():
+    """The shipped tree lints clean — `make lint` gate, as a test."""
+    findings = raylint.lint_paths(raylint.default_paths())
+    assert findings == [], "\n".join(map(str, findings))
+
+
+def test_cli_exit_codes():
+    assert raylint.main([]) == 0
+    assert raylint.main(["--list-rules"]) == 0
