@@ -19,7 +19,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_actor_process.py tests/test_async_actors.py \
 	tests/test_streaming_returns.py tests/test_rpc.py \
 	tests/test_persistence.py tests/test_object_transfer.py \
-	tests/test_object_plane.py \
+	tests/test_object_plane.py tests/test_broadcast.py \
 	tests/test_cross_host.py tests/test_fault_tolerance.py \
 	tests/test_sched.py tests/test_dag.py tests/test_collectives.py \
 	tests/test_runtime_env.py tests/test_autoscaler.py \
@@ -40,7 +40,7 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	tsan shm lint \
+	broadcast tsan shm lint \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
 	bench-health bench-pipeline bench-profile bench-sanitize
 
@@ -163,6 +163,13 @@ profile:
 memory:
 	@echo "== object plane tier =="
 	$(PYTEST) -m objects tests/
+
+# collective-broadcast tier (relay trees, partial hygiene, zero-socket
+# shm handoff, api.broadcast e2e) for iterating on dissemination work;
+# the fast subset also runs inside check via CORE_TESTS
+broadcast:
+	@echo "== broadcast tier =="
+	$(PYTEST) -m broadcast tests/
 
 check-all: check check-slow
 

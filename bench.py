@@ -968,14 +968,22 @@ def bench_data() -> None:
 
 
 def bench_objects() -> None:
-    """Host object plane (BASELINE.md object-plane row): broadcast one
-    large object from a single origin to M pullers over the real transfer
-    plane with pull-through caching — each successful pull advertises a
-    new replica, so later pullers spread across earlier ones instead of
-    hammering the origin. Then repeat gets measure the cache-hit rate.
+    """Host object plane (BASELINE.md object-plane row): disseminate one
+    large object from a single origin to M pullers through the collective
+    relay tree — concurrent pullers claim tree slots, stream each other's
+    committed prefixes mid-transfer, and the origin only ever feeds
+    `object_broadcast_fanout` children directly. Alternating fan-out
+    4 / fan-out 8 arms, a fresh object per round (cold every time),
+    per-arm medians. The flow matrix is the built-in verifier: each
+    round's edge deltas must shape an actual tree (origin out-degree
+    below the fan-out), and the per-edge byte sums must reconcile with
+    the pull counters exactly. Then repeat gets measure the cache-hit
+    rate and alternating on/off pulls price the ledger.
 
     Env knobs: RAY_TPU_BENCH_OBJECT_MB (default 64),
-    RAY_TPU_BENCH_OBJECT_PULLERS (default 4),
+    RAY_TPU_BENCH_OBJECT_PULLERS (default 4, the headline fan-out),
+    RAY_TPU_BENCH_OBJECT_PULLERS8 (default 8, the wide arm),
+    RAY_TPU_BENCH_OBJECT_REPS (rounds per arm, default 3),
     RAY_TPU_BENCH_OBJECT_ROUNDS (repeat-get rounds, default 2)."""
     import threading
 
@@ -994,26 +1002,34 @@ def bench_objects() -> None:
         _cache_misses,
         _pulled_bytes,
         pull_from_any,
+        purge_relay_claims,
     )
 
     size_mb = int(os.environ.get("RAY_TPU_BENCH_OBJECT_MB", "64"))
-    n_pullers = int(os.environ.get("RAY_TPU_BENCH_OBJECT_PULLERS", "4"))
+    fan_small = int(os.environ.get("RAY_TPU_BENCH_OBJECT_PULLERS", "4"))
+    fan_large = int(os.environ.get("RAY_TPU_BENCH_OBJECT_PULLERS8", "8"))
+    reps = int(os.environ.get("RAY_TPU_BENCH_OBJECT_REPS", "5"))
     repeat_rounds = int(os.environ.get("RAY_TPU_BENCH_OBJECT_ROUNDS", "2"))
     nbytes = size_mb << 20
+    n_pullers = max(fan_small, fan_large)
+
+    # every bench "node" shares this host, so the same-host fd handoff
+    # would zero out the socket path entirely; disable it to exercise the
+    # relay tree the way cross-host pullers would
+    shm_was = bool(_config.object_transfer_shm_handoff)
+    _config.apply_overrides({"object_transfer_shm_handoff": False})
 
     cp = ControlPlane()
     origin_store = MemoryObjectStore(capacity_bytes=4 * nbytes)
     origin = ObjectTransferServer(origin_store)
     cp.kv_put(KV_PREFIX + "origin", origin.address)
     origin.start_load_gossip(cp, "origin")
-    oid = ObjectID.for_task_return(TaskID.of(), 0)
-    origin_store.put(oid, np.arange(nbytes // 8, dtype=np.float64))
+    arr = np.arange(nbytes // 8, dtype=np.float64)
 
     pullers = []  # (store, server, client)
     for i in range(n_pullers):
         store = MemoryObjectStore(capacity_bytes=4 * nbytes)
         server = ObjectTransferServer(store)
-        server.start_load_gossip(cp, f"puller{i}")
         client = ObjectTransferClient()
         # distinct dst labels so the flow matrix's per-edge sums can be
         # reconciled against object_pull_bytes for THESE pulls alone
@@ -1024,55 +1040,137 @@ def bench_objects() -> None:
     hits0, misses0 = _cache_hits.get(), _cache_misses.get()
     pulled0 = _pulled_bytes.get()
 
-    def cached_get(i: int) -> None:
-        """The worker-side get path: local replica first, else pull from
-        any advertised holder and become a holder ourselves."""
-        store, server, client = pullers[i]
-        if store.contains(oid):
-            _cache_hits.inc()
-            store.get(oid, timeout=0)
-            return
-        _cache_misses.inc()
-        pull_from_any(
-            cp, oid, client=client, cache_store=store,
-            on_cached=lambda _o: cp.kv_put(
-                KV_PREFIX + f"puller{i}", server.address))
+    def flow_snapshot() -> dict:
+        return {(e["src"], e["dst"], e["path"]): e["bytes"]
+                for e in object_ledger.collect_flows()["edges"]}
 
-    def run_round() -> float:
+    def relay_round(fan: int, keep: bool = False):
+        """One cold dissemination: a fresh object, `fan` concurrent
+        pullers self-organizing into the relay tree. The origin's wire
+        blob is staged outside the clock (a one-time pickling cost that
+        every fan-out shares), so the metric is dissemination throughput.
+        Returns (wall_s, per-edge flow deltas, oid); keep=True skips the
+        replica cleanup so cache-hit rounds can follow."""
+        oid = ObjectID.for_task_return(TaskID.of(), 0)
+        oid_hex = oid.hex()
+        origin_store.put(oid, arr)
+        pullers[0][2]._call(origin.address, "stage", oid_hex, True)
+        before = flow_snapshot()
         errors: list = []
 
         def work(i):
+            store, server, client = pullers[i]
             try:
-                cached_get(i)
+                pull_from_any(cp, oid, client=client, cache_store=store,
+                              relay_server=server,
+                              node_hex=client.local_node)
             except Exception as e:  # noqa: BLE001 — surfaced after join
                 errors.append(e)
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=work, args=(i,))
-                   for i in range(n_pullers)]
+                   for i in range(fan)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        wall = time.perf_counter() - t0
         if errors:
             raise RuntimeError(f"object bench pull failed: {errors[0]!r}")
-        return time.perf_counter() - t0
+        after = flow_snapshot()
+        edges = {k: v - before.get(k, 0) for k, v in after.items()
+                 if v > before.get(k, 0)}
+        if not keep:
+            for store, server, _client in pullers:
+                store.delete(oid)
+                server.drop_cached(oid_hex)
+            origin_store.delete(oid)
+            origin.drop_cached(oid_hex)
+        purge_relay_claims(oid_hex, cp)
+        return wall, edges, oid
+
+    def tree_shape(edges: dict):
+        """-> (origin out-degree, tree depth) of one round's edge set."""
+        children: dict = {}
+        for (src, dst, _path) in edges:
+            children.setdefault(src, set()).add(dst)
+        depth, frontier, seen = 0, {"origin"}, {"origin"}
+        while True:
+            nxt = set()
+            for n in frontier:
+                nxt |= children.get(n, set())
+            nxt -= seen
+            if not nxt:
+                break
+            seen |= nxt
+            frontier = nxt
+            depth += 1
+        return len(children.get("origin", ())), depth
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
 
     try:
-        wall = run_round()  # cold broadcast: every puller crosses the wire
-        for _ in range(repeat_rounds):
-            run_round()  # warm: local replicas serve
-        hits = _cache_hits.get() - hits0
-        misses = _cache_misses.get() - misses0
-        hit_rate = hits / max(hits + misses, 1)
-        gbps = n_pullers * nbytes / wall / 1e9
+        relay_round(n_pullers)  # warm-up: buffer pool, connections
+        walls: dict = {fan_small: [], fan_large: []}
+        depths: list = []
+        for _rep in range(reps):
+            for fan in (fan_small, fan_large):  # alternating arms
+                wall, edges, _oid = relay_round(fan)
+                out_deg, depth = tree_shape(edges)
+                if out_deg >= fan:
+                    raise RuntimeError(
+                        f"relay tree did not form at fan-out {fan}: origin "
+                        f"fed {out_deg} pullers directly (flat broadcast)")
+                walls[fan].append(wall)
+                if fan == fan_large:
+                    depths.append(depth)
+        w4, w8 = median(walls[fan_small]), median(walls[fan_large])
+        gbps = fan_small * nbytes / w4 / 1e9
+        gbps8 = fan_large * nbytes / w8 / 1e9
         print(
-            f"# objects: size={size_mb}MB pullers={n_pullers} "
-            f"broadcast_wall={wall:.3f}s hits={hits} misses={misses}",
+            f"# objects: size={size_mb}MB relay fan{fan_small} "
+            f"wall={w4:.3f}s fan{fan_large} wall={w8:.3f}s "
+            f"tree_depth={median(depths)}",
             file=sys.stderr,
         )
         _emit("object_broadcast_gbps", gbps, "GB/s",
               "object_broadcast_anchor")
+        _emit("object_broadcast_fanout8_gbps", gbps8, "GB/s",
+              "object_broadcast_fanout8_anchor")
+        _emit("object_broadcast_tree_depth", float(median(depths)), "hops",
+              "object_broadcast_tree_depth_anchor", lower_is_better=True)
+
+        # cache-hit rate: one cold dissemination through the worker-side
+        # get path (local replica first, else pull and become a holder),
+        # then repeat gets served from the pullers' own replicas
+        oid = ObjectID.for_task_return(TaskID.of(), 0)
+        origin_store.put(oid, arr)
+        pullers[0][2]._call(origin.address, "stage", oid.hex(), True)
+
+        def cached_get(i: int) -> None:
+            store, server, client = pullers[i]
+            if store.contains(oid):
+                _cache_hits.inc()
+                store.get(oid, timeout=0)
+                return
+            _cache_misses.inc()
+            pull_from_any(cp, oid, client=client, cache_store=store,
+                          relay_server=server, node_hex=client.local_node)
+
+        for _ in range(repeat_rounds + 1):  # first round cold, rest local
+            threads = [threading.Thread(target=cached_get, args=(i,))
+                       for i in range(fan_small)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        purge_relay_claims(oid.hex(), cp)
+        hits = _cache_hits.get() - hits0
+        misses = _cache_misses.get() - misses0
+        hit_rate = hits / max(hits + misses, 1)
+        print(f"# objects: hits={hits} misses={misses}", file=sys.stderr)
         _emit("object_cache_hit_rate", hit_rate, "ratio",
               "object_cache_hit_anchor")
 
@@ -1114,10 +1212,6 @@ def bench_objects() -> None:
             _config.apply_overrides({"object_ledger": True})
             object_ledger.reload_enabled()
 
-        def median(xs):
-            xs = sorted(xs)
-            return xs[len(xs) // 2]
-
         overhead_pct = ((median(on_walls) - median(off_walls))
                         / median(off_walls) * 100.0)
         print(f"# objects: ledger_on={median(on_walls):.4f}s "
@@ -1126,6 +1220,7 @@ def bench_objects() -> None:
         _emit("object_ledger_overhead_pct", overhead_pct, "%",
               "object_ledger_overhead_anchor", lower_is_better=True)
     finally:
+        _config.apply_overrides({"object_transfer_shm_handoff": shm_was})
         for _, server, client in pullers:
             client.close()
             server.stop()

@@ -15,6 +15,7 @@ from .api import (  # noqa: F401
     RayActorError,
     RayTaskError,
     available_resources,
+    broadcast,
     cluster_resources,
     get,
     get_actor,

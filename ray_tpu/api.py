@@ -44,6 +44,7 @@ __all__ = [
     "get",
     "put",
     "wait",
+    "broadcast",
     "kill",
     "get_actor",
     "cluster_resources",
@@ -493,6 +494,19 @@ def wait(
 ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
     rt = _auto_init()
     return rt.wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def broadcast(ref: ObjectRef, *, nodes: Optional[Sequence[Any]] = None,
+              timeout: float = 120.0) -> dict:
+    """Push one object to every node (or a `nodes` subset) ahead of
+    demand, through the collective relay tree: pullers in each wave
+    stream from each other's committed prefixes instead of all hammering
+    the origin. Use before fan-out consumption — weight deployment,
+    checkpoint restore, large shared inputs. Returns a summary dict with
+    "warmed" (node id hexes now holding a replica) and "failed"
+    ((node_hex, reason) pairs — per-node failures never raise)."""
+    rt = _auto_init()
+    return rt.broadcast(ref, nodes=nodes, timeout=timeout)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:

@@ -118,6 +118,50 @@ declare(
     "from this runtime (objects are immutable once sealed, so replicas "
     "never go stale).",
 )
+declare(
+    "object_transfer_buffer_pool_bytes", 512 * 1024 * 1024,
+    "Retained-bytes bound for the transfer receive-buffer pool. Large "
+    "receive buffers are recycled across pulls (refcount-gated, so a "
+    "buffer still referenced by zero-copy views is never reused) to "
+    "avoid a full page-fault pass per large transfer; 0 disables "
+    "pooling.",
+)
+declare(
+    "object_transfer_max_stripes", 4,
+    "Upper bound on concurrent stripe lanes a single chunked pull spreads "
+    "across distinct sealed holders (diminishing returns past a few "
+    "stripes on one NIC).",
+)
+declare(
+    "object_transfer_shm_handoff", True,
+    "Same-host pulls attach the holder's staging arena by name and map "
+    "the blob zero-copy over /dev/shm instead of copying bytes through a "
+    "loopback socket (detected via a boot-id host token).",
+)
+declare(
+    "object_broadcast_relay", True,
+    "Pullers of the same object self-organize into a chunk-pipelined "
+    "relay tree: each claims a tree slot in the KV, pulls from its "
+    "parent's committed prefix mid-transfer, and serves downstream "
+    "pullers from its own partial. Off = every puller hits the sealed "
+    "holders directly (flat fan-out).",
+)
+declare(
+    "object_broadcast_fanout", 2,
+    "Branching factor of the relay tree (out-degree per node, including "
+    "the origin). Slot k's parent is slot (k - fanout) // fanout.",
+)
+declare(
+    "object_relay_min_bytes", 4 * 1024 * 1024,
+    "Objects below this size skip relay-tree formation; tree setup "
+    "(claims + partial registration) costs more than a flat pull wins.",
+)
+declare(
+    "object_relay_timeout_s", 30.0,
+    "How long a chunk request parks on a relay holder's partial waiting "
+    "for the byte range to land before the server fails the read and the "
+    "puller falls back to another holder.",
+)
 
 # Object-plane observability (core/object_ledger.py)
 declare(

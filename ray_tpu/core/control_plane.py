@@ -154,8 +154,18 @@ class ControlPlane:
             # spelled out here to avoid import cycles)
             hexid = node_id.hex()
             for prefix in ("object_transfer/", "object_transfer_load/",
+                           "object_transfer_host/",
                            "node_service/", "channel_service/"):
                 self._kv.pop(prefix + hexid, None)
+            # relay claims record "address|flow_label|node_hex"; a dead
+            # relay must not stay in any broadcast tree — children time
+            # out on its partial and fall back, but new pulls ranking by
+            # claim slot would keep dialing the corpse
+            for key in [k for k in self._kv
+                        if k.startswith("object_transfer_relay/")]:
+                val = self._kv.get(key)
+                if isinstance(val, str) and val.rsplit("|", 1)[-1] == hexid:
+                    self._kv.pop(key, None)
             # and its last telemetry snapshot: a dead node's metrics and
             # digests must not haunt the merged dashboard/health view
             self._telemetry.pop(hexid, None)

@@ -47,10 +47,13 @@ from .logging import get_logger
 from .node_agent import NodeAgent, TaskResult, WorkerCrashedError
 from .object_store import ObjectLostError
 from .object_transfer import (
+    HOST_PREFIX,
     KV_PREFIX,
     ObjectPullError,
     ObjectTransferClient,
     ObjectTransferServer,
+    _host_token,
+    pull_from_any,
 )
 from .rpc import ControlPlaneUnavailable, RemoteControlPlane
 from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
@@ -332,9 +335,11 @@ class HeadService:
         return _dumps(value)
 
     # -- directory ops (worker -> head) ------------------------------------
-    def dir_add_location(self, oid_hex: str, node_id_hex: str) -> bool:
+    def dir_add_location(self, oid_hex: str, node_id_hex: str,
+                         bytes_available: Optional[int] = None) -> bool:
         self._runtime.directory.add_location(
-            ObjectID.from_hex(oid_hex), NodeID.from_hex(node_id_hex)
+            ObjectID.from_hex(oid_hex), NodeID.from_hex(node_id_hex),
+            bytes_available=bytes_available,
         )
         return True
 
@@ -719,6 +724,13 @@ class RemoteNodeAgent:
         except (WorkerCrashedError, RuntimeError):
             return False
 
+    def prefetch_object(self, oid_hex: str, timeout: float = 120.0) -> bool:
+        """Ask the worker host to pull one object into its local store
+        (broadcast fan-out). Synchronous: returns once the replica is
+        sealed and its location registered, raising on pull failure."""
+        return bool(self._call("prefetch_object", timeout=timeout,
+                               oid_hex=oid_hex))
+
     def submit_direct(self, actor_id: ActorID, fn) -> None:
         self.submit_direct_blob(actor_id, _dumps(fn))
 
@@ -890,6 +902,10 @@ class RemoteDirectoryClient:
         # but a per-locate alive_nodes RPC would double every pull's RTT
         self._alive_hexes: Optional[set] = None
         self._alive_at = 0.0
+        # host tokens are immutable per boot: cache them forever so the
+        # prefer_local ranking in locate() costs one KV round-trip per
+        # holder total, not per pull
+        self._host_tokens: Dict[str, str] = {}
         # waiter callbacks run OFF the control-plane read loop: they issue
         # blocking RPCs (dir_locations, kv_get) on the SAME connection whose
         # read loop delivers the replies — firing inline would deadlock the
@@ -924,8 +940,10 @@ class RemoteDirectoryClient:
             self._last_fire[oid_hex] = time.monotonic()
             self._fire(oid_hex)
 
-    def add_location(self, object_id: ObjectID, node_id: NodeID) -> None:
-        self._cp.dir_add_location(object_id.hex(), node_id.hex())
+    def add_location(self, object_id: ObjectID, node_id: NodeID,
+                     bytes_available: Optional[int] = None) -> None:
+        self._cp.dir_add_location(object_id.hex(), node_id.hex(),
+                                  bytes_available=bytes_available)
 
     def remove_location(self, object_id: ObjectID, node_id: NodeID) -> None:
         self._cp.dir_remove_location(object_id.hex(), node_id.hex())
@@ -944,8 +962,25 @@ class RemoteDirectoryClient:
                 self._alive_at = now
         return self._alive_hexes
 
-    def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None):
+    def _host_token_of(self, hexid: str) -> str:
+        token = self._host_tokens.get(hexid)
+        if token is None:
+            try:
+                raw = self._cp.kv_get(HOST_PREFIX + hexid)
+            except Exception:  # noqa: BLE001 — tokens are advisory
+                raw = None
+            token = raw.decode() if isinstance(raw, bytes) else (raw or "")
+            self._host_tokens[hexid] = token
+        return token
+
+    def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None,
+               prefer_local: bool = False):
+        """First live holder. With prefer_local, holders whose advertised
+        host token matches this process rank first — a same-host pull
+        short-circuits to the shm fd handoff in ObjectTransferClient.pull
+        instead of copying the payload through a loopback socket."""
         alive = self._alive()
+        candidates = []
         for hexid in self._cp.dir_locations(object_id.hex()):
             node_id = NodeID.from_hex(hexid)
             if node_id == exclude:
@@ -956,9 +991,17 @@ class RemoteDirectoryClient:
             if not addr:
                 continue
             addr = addr.decode() if isinstance(addr, bytes) else addr
-            object_ledger.note_peer(addr, hexid)
-            return _PullHolder(addr, self._transfer, node_id)
-        return None
+            if not prefer_local:
+                object_ledger.note_peer(addr, hexid)
+                return _PullHolder(addr, self._transfer, node_id)
+            candidates.append((hexid, node_id, addr))
+        if not candidates:
+            return None
+        local = _host_token()
+        candidates.sort(key=lambda c: self._host_token_of(c[0]) != local)
+        hexid, node_id, addr = candidates[0]
+        object_ledger.note_peer(addr, hexid)
+        return _PullHolder(addr, self._transfer, node_id)
 
     def subscribe_once(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
         oid_hex = object_id.hex()
@@ -1085,6 +1128,22 @@ class _WorkerDispatchHandler(socketserver.BaseRequestHandler):
         elif method == "store_delete":
             agent.store.delete(ObjectID.from_hex(req["oid_hex"]))
             reply({"id": req_id, "ok": True, "value": True})
+        elif method == "prefetch_object":
+            # broadcast fan-out: pull the object into THIS host's store
+            # (joining the relay tree if one is forming). Off the read
+            # loop — a 1GB pull must not stall unrelated dispatches.
+            def _prefetch():
+                try:
+                    rt = getattr(server, "runtime", None)
+                    if rt is None:
+                        raise RuntimeError("worker runtime not attached")
+                    rt.prefetch_object(req["oid_hex"])
+                    reply({"id": req_id, "ok": True, "value": True})
+                except Exception as e:  # noqa: BLE001 — serialized to caller
+                    reply({"id": req_id, "ok": False, "error": repr(e)})
+
+            threading.Thread(target=_prefetch, daemon=True,
+                             name="dispatch-prefetch").start()
         elif method == "try_acquire":
             # placement-group bundle reservation on THIS node's ledger
             ok = agent.resources.try_acquire(req["demand"])
@@ -1139,6 +1198,9 @@ class WorkerNodeServer(socketserver.ThreadingTCPServer):
     def __init__(self, agent: NodeAgent, host: str = "127.0.0.1", port: int = 0):
         super().__init__((host, port), _WorkerDispatchHandler)
         self.agent = agent
+        # back-reference set by WorkerRuntime: prefetch_object needs the
+        # runtime's transfer client/server, not just the agent
+        self.runtime: Optional["WorkerRuntime"] = None
         self.owner_requested_stop = threading.Event()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True, name="worker-dispatch"
@@ -1197,6 +1259,7 @@ class WorkerRuntime:
         self.directory = RemoteDirectoryClient(self.control_plane, self.node_id)
         self.agent = NodeAgent(self.info, self.control_plane, self.directory)
         self.dispatch_server = WorkerNodeServer(self.agent, host=node_host)
+        self.dispatch_server.runtime = self
         self.transfer_server = ObjectTransferServer(self.agent.store, host=node_host)
         self._stopped = threading.Event()
         # advertise BEFORE registering: the head resolves both addresses
@@ -1206,6 +1269,8 @@ class WorkerRuntime:
             NODE_SERVICE_PREFIX + self.node_id.hex(), self.dispatch_server.address)
         self.control_plane.kv_put(
             KV_PREFIX + self.node_id.hex(), self.transfer_server.address)
+        self.control_plane.kv_put(
+            HOST_PREFIX + self.node_id.hex(), _host_token())
         # compiled-graph channels homed here (consumer-side queues) are
         # reachable through this process's channel service
         from .channels import KV_CHANNEL_PREFIX, ensure_service
@@ -1254,6 +1319,27 @@ class WorkerRuntime:
                 )
             return self._api_client
 
+    def prefetch_object(self, oid_hex: str) -> bool:
+        """Pull one object into this host's store (broadcast fan-out
+        target). Joins the collective relay tree when one is forming:
+        this host serves its committed prefix to later pullers while its
+        own pull is still streaming. Raises ObjectPullError if no holder
+        can serve the object."""
+        oid = ObjectID.from_hex(oid_hex)
+        if self.agent.store.contains(oid):
+            return True
+        nid = self.node_id.hex()
+        pull_from_any(
+            self.control_plane, oid,
+            client=self.directory._transfer,
+            cache_store=self.agent.store,
+            on_cached=lambda o: self.control_plane.dir_add_location(
+                o.hex(), nid),
+            relay_server=self.transfer_server,
+            node_hex=nid,
+        )
+        return True
+
     def _rejoin(self) -> None:
         """Re-introduce this host to a restarted head: the snapshot restores
         KV/jobs/named actors but deliberately NOT the node table or object
@@ -1273,6 +1359,7 @@ class WorkerRuntime:
                 NODE_SERVICE_PREFIX + nid, self.dispatch_server.address)
             self.control_plane.kv_put(
                 KV_PREFIX + nid, self.transfer_server.address)
+            self.control_plane.kv_put(HOST_PREFIX + nid, _host_token())
             self.control_plane.kv_put(
                 KV_CHANNEL_PREFIX + nid, ensure_service(self._node_host))
             held = self.agent.store.list_objects()

@@ -1094,6 +1094,11 @@ class ObjectDirectory:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._locations: Dict[ObjectID, List[NodeID]] = {}
+        # relay pullers mid-transfer: node -> bytes committed so far.
+        # Partial holders never satisfy locate()/locations()/waiters —
+        # they exist so the broadcast planner and the ledger can see
+        # in-flight replicas, and so hygiene code can purge them.
+        self._partials: Dict[ObjectID, Dict[NodeID, int]] = {}
         self._agents: Dict[NodeID, NodeAgent] = {}
         self._waiters: Dict[ObjectID, List[Callable[[], None]]] = {}
         # cross-host hook: every add_location also notifies joined worker
@@ -1117,12 +1122,30 @@ class ObjectDirectory:
                     self._locations[oid] = locs
                 else:
                     del self._locations[oid]
+            for oid in list(self._partials):
+                self._partials[oid].pop(node_id, None)
+                if not self._partials[oid]:
+                    del self._partials[oid]
 
-    def add_location(self, object_id: ObjectID, node_id: NodeID) -> None:
+    def add_location(self, object_id: ObjectID, node_id: NodeID,
+                     bytes_available: Optional[int] = None) -> None:
+        """Register a holder. With bytes_available, the node is a PARTIAL
+        holder (a relay mid-transfer): recorded for observability but
+        invisible to locate()/locations()/waiters until the full add
+        arrives, which promotes it (drops the partial entry)."""
+        if bytes_available is not None:
+            with self._lock:
+                self._partials.setdefault(object_id, {})[node_id] = int(bytes_available)
+            return
         with self._lock:
             locs = self._locations.setdefault(object_id, [])
             if node_id not in locs:
                 locs.append(node_id)
+            partials = self._partials.get(object_id)
+            if partials is not None:
+                partials.pop(node_id, None)
+                if not partials:
+                    del self._partials[object_id]
             callbacks = self._waiters.pop(object_id, [])
         for cb in callbacks:
             cb()
@@ -1136,6 +1159,16 @@ class ObjectDirectory:
                 locs.remove(node_id)
                 if not locs:
                     del self._locations[object_id]
+            partials = self._partials.get(object_id)
+            if partials is not None:
+                partials.pop(node_id, None)
+                if not partials:
+                    del self._partials[object_id]
+
+    def partial_locations(self, object_id: ObjectID) -> Dict[NodeID, int]:
+        """Snapshot of in-flight relay holders: node -> bytes committed."""
+        with self._lock:
+            return dict(self._partials.get(object_id, {}))
 
     def locations(self, object_id: ObjectID) -> List[NodeID]:
         with self._lock:
@@ -1149,12 +1182,14 @@ class ObjectDirectory:
     def locate(self, object_id: ObjectID, exclude: Optional[NodeID] = None,
                prefer_local: bool = False) -> Optional[NodeAgent]:
         """First live holder, in registration order. With prefer_local,
-        in-process agents rank ahead of cross-host proxies (is_remote
-        agents), so a pull-through replica short-circuits future network
-        pulls; a remote holder is still returned when it's the only one."""
+        holders rank local-shm < local-memory < remote (is_remote
+        cross-host proxies): a same-host shm replica is a zero-copy map,
+        a same-host memory replica is an in-process reference, and only
+        when neither exists does the pull go over a socket."""
         alive_check = self.alive_check
         with self._lock:
-            remote_fallback = None
+            best = None
+            best_tier = 3
             for node_id in self._locations.get(object_id, []):
                 if node_id == exclude:
                     continue
@@ -1163,12 +1198,19 @@ class ObjectDirectory:
                     continue
                 if alive_check is not None and not alive_check(node_id):
                     continue
-                if prefer_local and getattr(agent, "is_remote", False):
-                    if remote_fallback is None:
-                        remote_fallback = agent
-                    continue
-                return agent
-            return remote_fallback
+                if not prefer_local:
+                    return agent
+                if getattr(agent, "is_remote", False):
+                    tier = 2
+                elif getattr(agent.store, "kind", "memory") == "shm":
+                    tier = 0
+                else:
+                    tier = 1
+                if tier == 0:
+                    return agent
+                if tier < best_tier:
+                    best, best_tier = agent, tier
+            return best
 
     def subscribe_once(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
         with self._lock:

@@ -59,6 +59,20 @@ class SealedBytes:
             return pickle.loads(self.payload, buffers=self.buffers)
         return pickle.loads(self.payload)
 
+    def __reduce_ex__(self, protocol):
+        # payload/buffers may be memoryviews after a zero-copy wire decode
+        # (object_transfer._decode_blob); PickleBuffer keeps them picklable
+        # either way — inline when no buffer_callback is active, out-of-band
+        # (no copy) when the dumper collects buffers (protocol 5).
+        if protocol >= 5:
+            return (
+                SealedBytes,
+                (pickle.PickleBuffer(self.payload),
+                 tuple(pickle.PickleBuffer(b) for b in self.buffers)),
+            )
+        return (SealedBytes, (bytes(self.payload),
+                              tuple(bytes(b) for b in self.buffers)))
+
 
 def _has_device_leaves(value: Any) -> bool:
     """True if the value's pytree contains jax.Arrays (checked lazily — if

@@ -23,9 +23,9 @@ import os
 import pickle
 import socket
 import socketserver
+import struct
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import object_ledger
@@ -34,7 +34,8 @@ from .ids import ObjectID
 from .logging import get_logger
 from .metrics import MICRO_BUCKETS, Counter, Gauge, Histogram
 from .object_store import SealedBytes
-from .wire import MSG_REQUEST, MSG_RESPONSE, WireError, recv_msg, send_msg
+from .wire import (MSG_BLOB, MSG_REQUEST, MSG_RESPONSE, WireError,
+                   recv_frame_into, recv_msg, send_blob, send_msg)
 
 logger = get_logger("object_transfer")
 
@@ -43,6 +44,13 @@ DEFAULT_CHUNK_BYTES = 1 << 20  # ~1MB, the reference's chunk size
 KV_PREFIX = "object_transfer/"  # control-plane KV key prefix for addresses
 # holder-side outstanding-pull load, gossiped so pullers can rank holders
 LOAD_PREFIX = "object_transfer_load/"
+# per-node host identity token (hostname + boot id), advertised so pullers
+# can recognize a same-host holder and rank it first / attach its arena
+HOST_PREFIX = "object_transfer_host/"
+# relay-tree slot claims: object_transfer_relay/{oid_hex}/{slot:06d} ->
+# "address|flow_label|node_hex". Claimed atomically (kv_put overwrite=False)
+# by pullers joining a broadcast; slot k's parent is slot (k-fanout)//fanout
+RELAY_PREFIX = "object_transfer_relay/"
 
 # Native fast path (_shm/transfer.cc): the holder stages the serialized
 # blob in a shm arena once, a C++ thread streams it zero-copy, and the
@@ -110,6 +118,8 @@ class ObjectPullConnectionError(ObjectPullError):
 
 
 _NATIVE_MISS = object()  # sentinel: native path unavailable, use chunks
+_SHM_MISS = object()  # sentinel: same-host arena handoff unavailable
+_RELAY_MISS = object()  # sentinel: relay tree not joined, flat pull
 
 
 def _make_client_native():
@@ -126,14 +136,136 @@ def _make_client_native():
     return staging, native, lambda n: n.close()
 
 
-def _serialize_for_wire(value: Any) -> bytes:
-    """One flat payload per object; cloudpickle for closures/lambdas."""
+def _raw_alloc(n: int):
+    """Uninitialized receive buffer. bytearray(n) zero-fills — a full
+    extra memory pass that roughly doubles the cost of landing a large
+    blob; np.empty is a bare malloc. Every byte is overwritten by
+    recv_into before anything reads it."""
     try:
-        return pickle.dumps(value, protocol=5)
-    except Exception:
+        import numpy as np
+
+        return np.empty(n, dtype=np.uint8)
+    except Exception:  # noqa: BLE001 — numpy-less install
+        return bytearray(n)
+
+
+class _BufferPool:
+    """Recycles large transfer receive buffers across pulls.
+
+    glibc mmaps every allocation above its threshold cap (32MB), so a
+    fresh multi-MB buffer pays a full page-fault pass per pull and is
+    munmapped on free — the kernel-side cost dominates large transfers.
+    The pool keeps recent buffers mapped and hands one back only when
+    nothing outside the pool references it (sys.getrefcount: zero-copy
+    decode hands out memoryviews that hold refs, so an in-use buffer can
+    never be recycled under its consumers). Total retained bytes are
+    bounded by object_transfer_buffer_pool_bytes, evicting idle-largest
+    first; 0 disables pooling entirely."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bufs: List[Any] = []  # LRU order: oldest first
+
+    def take(self, n: int):
+        import sys
+
+        cap = int(config.object_transfer_buffer_pool_bytes)
+        if cap <= 0 or n > cap:
+            return _raw_alloc(n)
+        with self._lock:
+            for i in range(len(self._bufs)):
+                a = self._bufs[i]
+                # 3 == the pool's list slot + loop local + getrefcount arg
+                if len(a) == n and sys.getrefcount(a) == 3:
+                    del self._bufs[i]
+                    self._bufs.append(a)  # most-recently-used
+                    return a
+            buf = _raw_alloc(n)
+            self._bufs.append(buf)
+            total = sum(len(a) for a in self._bufs)
+            while total > cap and len(self._bufs) > 1:
+                # drop the oldest pool ref: an idle buffer unmaps now, an
+                # in-use one when its consumers drop — either way it stops
+                # counting against the retained bound
+                total -= len(self._bufs.pop(0))
+            return buf
+
+
+_buffer_pool = _BufferPool()
+
+
+def _alloc_buf(n: int):
+    return _buffer_pool.take(n)
+
+
+_host_token_cache: Optional[str] = None
+
+
+def _host_token() -> str:
+    """Stable identity of THIS host across processes: hostname + boot id.
+    Two runtimes with equal tokens share /dev/shm, so a pull between them
+    can attach the holder's staging arena instead of copying over a
+    socket. The boot id guards against recycled hostnames in containers
+    that still don't share a shm namespace-worth of trust — equal boot
+    ids on one kernel are the practical same-machine signal."""
+    global _host_token_cache
+    if _host_token_cache is None:
+        boot = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                boot = f.read().strip()
+        except OSError:
+            pass
+        _host_token_cache = f"{socket.gethostname()}|{boot}"
+    return _host_token_cache
+
+
+# v2 wire blob: out-of-band buffers ride as raw trailing bytes so the
+# receiving side can reconstruct the value ZERO-COPY over its receive
+# buffer (pickle protocol 5 `buffers=`), instead of paying a full-blob
+# pickle.loads memcpy per puller. The magic cannot collide with a plain
+# pickle (protocol>=2 starts b"\x80"); unmagiced blobs decode as v1.
+_BLOB_MAGIC = b"\x93RTB"
+_U32 = struct.Struct(">I")
+
+
+def _encode_blob(value: Any) -> bytes:
+    """[magic][u32 meta_len][meta][head][raw buffers...]; meta = pickled
+    (head_len, [buffer lengths]). Falls back to an unmagiced flat pickle
+    whenever out-of-band extraction can't work (exotic buffers,
+    cloudpickle-only values)."""
+    bufs: List[pickle.PickleBuffer] = []
+    try:
+        head = pickle.dumps(value, protocol=5, buffer_callback=bufs.append)
+        raws = [b.raw() for b in bufs]
+    except Exception:  # noqa: BLE001 — non-contiguous buffer / closure
         import cloudpickle
 
         return cloudpickle.dumps(value, protocol=5)
+    meta = pickle.dumps((len(head), [len(r) for r in raws]), protocol=2)
+    return b"".join([_BLOB_MAGIC, _U32.pack(len(meta)), meta, head, *raws])
+
+
+def _decode_blob(blob, zero_copy: bool = True) -> Any:
+    """Inverse of _encode_blob. zero_copy=True reconstructs buffer-backed
+    leaves as read-only views over `blob` (the views keep it alive) — use
+    when the caller owns the bytes. zero_copy=False materializes copies —
+    required when `blob` is a borrowed mapping (shm arena view) that may
+    be released/unmapped after decode."""
+    mv = memoryview(blob)
+    if mv.nbytes < 8 or bytes(mv[:4]) != _BLOB_MAGIC:
+        return pickle.loads(mv)  # v1 flat pickle
+    (meta_len,) = _U32.unpack(mv[4:8])
+    off = 8 + meta_len
+    head_len, buf_lens = pickle.loads(mv[8:off])
+    head = mv[off:off + head_len]
+    off += head_len
+    buffers = []
+    for n in buf_lens:
+        b = mv[off:off + n]
+        off += n
+        buffers.append(b.toreadonly() if zero_copy else bytes(b))
+    return pickle.loads(head, buffers=buffers)
 
 
 class _TransferHandler(socketserver.BaseRequestHandler):
@@ -146,6 +278,20 @@ class _TransferHandler(socketserver.BaseRequestHandler):
                 msg_type, req = recv_msg(sock)
                 if msg_type != MSG_REQUEST:
                     raise WireError(f"unexpected message type {msg_type}")
+                if req.get("method") == "chunk_stream":
+                    # zero-copy lane: ONE request streams a whole byte
+                    # range as MSG_BLOB frames (header + memoryview
+                    # scatter-gather per chunk, no per-chunk pickling on
+                    # either side), then a RESPONSE closes the stream.
+                    # An app-level error mid-stream also arrives as a
+                    # RESPONSE — the connection stays in sync either way
+                    server._load_add(1)
+                    try:
+                        resp = self._stream_chunks(server, sock, req)
+                    finally:
+                        server._load_add(-1)
+                    send_msg(sock, MSG_RESPONSE, resp)
+                    continue
                 server._load_add(1)
                 try:
                     resp = self._dispatch(server, req)
@@ -157,6 +303,31 @@ class _TransferHandler(socketserver.BaseRequestHandler):
         except (WireError, OSError):
             pass  # puller disconnected
 
+    def _stream_chunks(self, server: "ObjectTransferServer",
+                       sock: socket.socket, req: dict) -> dict:
+        """Push blob frames for [start, end) in `step`-sized chunks. On a
+        relay node each _read_range parks until its range commits, so the
+        stream is naturally paced by the upstream pull (chunk-pipelined
+        dissemination). Returns the closing response; transport errors
+        propagate and kill the connection (the puller sees them as a
+        connection failure and retries elsewhere)."""
+        try:
+            oid_hex, start, end, step, *rest = req["args"]
+            raw = bool(rest and rest[0])
+            off = int(start)
+            end = int(end)
+            step = max(1, int(step))
+            while off < end:
+                n = min(step, end - off)
+                view = server._read_range(oid_hex, raw, off, n)
+                send_blob(sock, req["id"], off, view)
+                off += n
+        except (WireError, OSError):
+            raise
+        except Exception as e:  # noqa: BLE001 — serialized to caller
+            return {"id": req.get("id"), "ok": False, "error": repr(e)}
+        return {"id": req["id"], "ok": True, "value": None}
+
     def _dispatch(self, server: "ObjectTransferServer", req: dict) -> dict:
         method = req.get("method")
         # args may carry a trailing raw flag: raw=True ships the SEALED
@@ -164,18 +335,22 @@ class _TransferHandler(socketserver.BaseRequestHandler):
         # (store.get_raw parity for cross-runtime pulls)
         if method == "meta":
             oid_hex, *rest = req["args"]
-            blob = server._blob_for(oid_hex, raw=bool(rest and rest[0]))
-            return {"id": req["id"], "ok": True, "value": len(blob)}
+            raw = bool(rest and rest[0])
+            partial = server._partial_for(oid_hex, raw)
+            size = partial.total if partial is not None else \
+                len(server._blob_for(oid_hex, raw=raw))
+            return {"id": req["id"], "ok": True, "value": size}
         if method == "stage":
             oid_hex, raw = req["args"]
-            size, native_port = server._stage(oid_hex, bool(raw))
+            size, native_port, shm = server._stage(oid_hex, bool(raw))
             return {"id": req["id"], "ok": True,
-                    "value": {"size": size, "native_port": native_port}}
+                    "value": {"size": size, "native_port": native_port,
+                              "shm": shm}}
         if method == "chunk":
             oid_hex, offset, length, *rest = req["args"]
-            blob = server._blob_for(oid_hex, raw=bool(rest and rest[0]))
-            return {"id": req["id"], "ok": True,
-                    "value": bytes(blob[offset:offset + length])}
+            view = server._read_range(oid_hex, bool(rest and rest[0]),
+                                      int(offset), int(length))
+            return {"id": req["id"], "ok": True, "value": bytes(view)}
         if method == "contains":
             (oid_hex,) = req["args"]
             oid = ObjectID.from_hex(oid_hex)
@@ -273,12 +448,38 @@ class _NativePlane:
             staging.close()
 
 
+class _Partial:
+    """A blob mid-arrival on a relay node: the receive buffer doubles as
+    the serving source. The puller commits each landed chunk (a strictly
+    growing byte prefix); downstream chunk requests for a not-yet-landed
+    range park on `cond` until the range commits, the upstream pull fails,
+    or the relay timeout expires."""
+
+    __slots__ = ("buf", "total", "committed", "cond", "failed", "done")
+
+    def __init__(self, total: int):
+        self.buf = _alloc_buf(total)
+        self.total = total
+        self.committed = 0
+        self.cond = threading.Condition()
+        self.failed: Optional[str] = None
+        self.done = False
+
+    def commit(self, upto: int) -> None:
+        with self.cond:
+            if upto > self.committed:
+                self.committed = upto
+                self.cond.notify_all()
+
+
 class ObjectTransferServer(socketserver.ThreadingTCPServer):
     """Serves one runtime's object store for remote pulls.
 
     The serialized blob for an object is cached per object id while any
     pull is in flight (pulls are chunked across many requests), and
-    dropped once the store drops the object."""
+    dropped once the store drops the object. A relay pull additionally
+    registers a _Partial here, so the node serves committed byte ranges
+    to downstream pullers while its own pull is still in flight."""
 
     daemon_threads = True
     allow_reuse_address = True
@@ -287,6 +488,7 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         super().__init__((host, port), _TransferHandler)
         self._store = store
         self._blob_cache: Dict[Tuple[str, bool], bytes] = {}
+        self._partials: Dict[Tuple[str, bool], _Partial] = {}
         self._cache_lock = threading.Lock()
         # outstanding-pull load: requests currently being served. Gossiped
         # to the control-plane KV (start_load_gossip) so pullers rank
@@ -349,9 +551,17 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         logger.info("native transfer plane on port %d", native.port)
         return staging, native, lambda n: n.stop()
 
-    def _stage(self, oid_hex: str, raw: bool) -> Tuple[int, Optional[int]]:
+    def _stage(self, oid_hex: str, raw: bool) \
+            -> Tuple[int, Optional[int], Optional[dict]]:
         """Ensure the blob for (oid, raw) sits in the staging arena; ->
-        (size, native_port). native_port None = use the chunked path."""
+        (size, native_port, shm). native_port None = use the chunked
+        path. `shm` carries the arena name + host token once the blob is
+        staged, so a same-host puller can map it directly (zero-copy
+        handoff) instead of copying over any socket."""
+        partial = self._partial_for(oid_hex, raw)
+        if partial is not None and not partial.done:
+            # mid-relay: serve the committed prefix over the chunk lane
+            return partial.total, None, None
         try:
             sid = _stage_id(ObjectID.from_hex(oid_hex).binary(), raw)
         except (ValueError, TypeError):
@@ -359,29 +569,119 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
         native, staging = self._plane.acquire() if sid is not None \
             else (None, None)
         if native is None:
-            return len(self._blob_for(oid_hex, raw=raw)), None
+            return len(self._blob_for(oid_hex, raw=raw)), None, None
+        shm_info = {"arena": staging.name, "token": _host_token()}
         try:
             view = staging.get_view(sid)
             if view is not None:  # already staged: size from the arena,
                 try:              # no re-pickle of the value
-                    return len(view), native.port
+                    return len(view), native.port, shm_info
                 finally:
                     staging.release(sid)
             blob = self._blob_for(oid_hex, raw=raw)
             if len(blob) > (STAGING_BYTES * 3) // 4:
-                return len(blob), None
+                return len(blob), None, None
             try:
                 staging.put(sid, blob)
             except Exception:  # noqa: BLE001 — races/arena pressure
                 if not staging.contains(sid):
-                    return len(blob), None  # cannot stage: chunked fallback
+                    return len(blob), None, None  # cannot stage: chunked
             # the arena copy now serves all pulls; dropping the byte-cache
             # entry halves holder-side residency for large objects
             with self._cache_lock:
                 self._blob_cache.pop((oid_hex, raw), None)
-            return len(blob), native.port
+            return len(blob), native.port, shm_info
         finally:
             self._plane.release()
+
+    # -- relay partials -----------------------------------------------------
+
+    def _partial_for(self, oid_hex: str, raw: bool) -> Optional[_Partial]:
+        with self._cache_lock:
+            return self._partials.get((oid_hex, raw))
+
+    def begin_partial(self, oid_hex: str, raw: bool,
+                      total: int) -> Optional[_Partial]:
+        """Register a partial for an inbound relay pull. The returned
+        _Partial's buf IS the receive buffer: commit() after each landed
+        chunk publishes the prefix to downstream pullers. Returns None if
+        a partial already exists — exactly one pull per node feeds it."""
+        with self._cache_lock:
+            if (oid_hex, raw) in self._partials:
+                return None
+            p = _Partial(total)
+            self._partials[(oid_hex, raw)] = p
+            return p
+
+    def finish_partial(self, oid_hex: str, raw: bool) -> None:
+        """Promote a completed partial into the blob cache. The filled
+        bytearray moves as-is — late chunk requests see byte-identical
+        data whether they hit the partial or the cache."""
+        with self._cache_lock:
+            p = self._partials.pop((oid_hex, raw), None)
+            if p is None:
+                return
+            if len(self._blob_cache) >= 64:
+                self._blob_cache.pop(next(iter(self._blob_cache)))
+            self._blob_cache[(oid_hex, raw)] = p.buf
+        with p.cond:
+            p.committed = p.total
+            p.done = True
+            p.cond.notify_all()
+
+    def fail_partial(self, oid_hex: str, raw: bool, error: str) -> None:
+        """The inbound relay pull died: wake every parked reader with an
+        application-level error so downstream pullers fall back to a
+        surviving holder instead of hanging."""
+        with self._cache_lock:
+            p = self._partials.pop((oid_hex, raw), None)
+        if p is None:
+            return
+        with p.cond:
+            p.failed = error or "relay source failed"
+            p.cond.notify_all()
+
+    def drop_cached(self, oid_hex: str) -> None:
+        """Drop any cached wire blobs and partials for an object (both raw
+        flavors); benches/teardown use it to bound holder residency."""
+        for raw in (False, True):
+            with self._cache_lock:
+                self._blob_cache.pop((oid_hex, raw), None)
+                p = self._partials.pop((oid_hex, raw), None)
+            if p is not None:
+                with p.cond:
+                    p.failed = "partial dropped"
+                    p.cond.notify_all()
+
+    def _read_range(self, oid_hex: str, raw: bool, offset: int,
+                    length: int) -> memoryview:
+        """Byte range [offset, offset+length) of the wire blob, as a view
+        (no copy). On a relay node with the blob mid-arrival, the read
+        parks until the range commits; a dead upstream or an expired
+        object_relay_timeout_s surfaces as an app-level error (KeyError),
+        which tells the puller to fall back to another holder."""
+        p = self._partial_for(oid_hex, raw)
+        if p is None:
+            blob = self._blob_for(oid_hex, raw=raw)
+            return memoryview(blob)[offset:offset + length]
+        end = min(offset + length, p.total)
+        deadline = time.monotonic() + float(config.object_relay_timeout_s)
+        with p.cond:
+            while p.committed < end and p.failed is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise KeyError(
+                        f"relay range [{offset}, {end}) of {oid_hex[:16]} "
+                        f"not committed within "
+                        f"{config.object_relay_timeout_s}s "
+                        f"(have {p.committed}/{p.total})")
+                p.cond.wait(min(left, 0.5))  # raylint: disable=R2 — parked reader wakes on commit/fail notify; the timeout re-check bounds the wait
+            if p.committed >= end:
+                # p.buf stays valid after finish_partial (the bytearray
+                # itself is promoted into the blob cache, never copied)
+                return memoryview(p.buf)[offset:end]
+            raise KeyError(f"relay source for {oid_hex[:16]} failed: "
+                           f"{p.failed}")
 
     @property
     def address(self) -> str:
@@ -401,7 +701,7 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
             value = self._store.get_raw(oid, timeout=0.0)
         else:
             value = self._store.get(oid, timeout=0.0)
-        blob = _serialize_for_wire(value)
+        blob = _encode_blob(value)
         with self._cache_lock:
             # bound the cache: drop the oldest entries past 64
             if len(self._blob_cache) >= 64:
@@ -411,6 +711,13 @@ class ObjectTransferServer(socketserver.ThreadingTCPServer):
 
     def stop(self) -> None:
         self._gossip_stop.set()
+        with self._cache_lock:
+            partials = list(self._partials.values())
+            self._partials.clear()
+        for p in partials:  # wake parked relay readers before the sockets go
+            with p.cond:
+                p.failed = "transfer server stopped"
+                p.cond.notify_all()
         self.shutdown()
         self.server_close()
         self._plane.teardown()
@@ -559,6 +866,10 @@ class ObjectTransferClient:
                                    _make_client_native)
         self._inflight: set = set()  # sids being pulled by THIS client
         self._inflight_lock = threading.Lock()
+        # same-host staging arenas attached by name (zero-copy handoff);
+        # None marks an arena that failed to attach, so we don't re-dial it
+        self._arenas: Dict[str, Any] = {}
+        self._arena_lock = threading.Lock()
         # flow-accounting identity of the pulling side; empty means the
         # process-wide node id (set per-client in tests/benches that run
         # several logical pullers in one process)
@@ -637,15 +948,28 @@ class ObjectTransferClient:
         src_node = src_node or object_ledger.peer_node(address)
         t0 = time.monotonic()
         with _pull_inflight.track():
+            shm = None
             try:
                 staged = self._call(address, "stage", oid_hex, raw)
                 total, native_port = staged["size"], staged["native_port"]
+                shm = staged.get("shm")
             except ObjectPullError as e:
                 if "unknown method" not in str(e):
                     raise
                 # holder predates the staged protocol: chunked via "meta"
                 total, native_port = self._call(address, "meta", oid_hex,
                                                 raw), None
+            if (shm is not None and config.object_transfer_shm_handoff
+                    and shm.get("token") == _host_token()):
+                # same host: map the holder's staging arena and decode in
+                # place — zero socket bytes, so none of the transfer
+                # counters/flow edges move (the flow matrix showing no
+                # self-edge traffic is the regression-tested contract)
+                value = self._pull_shm(shm.get("arena"), oid_hex, raw)
+                if value is not _SHM_MISS:
+                    _pull_seconds.observe(time.monotonic() - t0,
+                                          {"path": "shm"})
+                    return value
             if native_port is not None:
                 value = self._pull_native(address, native_port, oid_hex, raw,
                                           total, src_node)
@@ -661,68 +985,152 @@ class ObjectTransferClient:
                 blob = self._pull_chunked(address, oid_hex, raw, 0, total,
                                           src_node=src_node)
             _pull_seconds.observe(time.monotonic() - t0, {"path": "chunked"})
-            return pickle.loads(blob)
+            return _decode_blob(blob)
+
+    def _attach_arena(self, name: str):
+        """Attach (once) a same-host holder's staging arena by name."""
+        from .shm_store import ShmObjectStore
+
+        with self._arena_lock:
+            if name in self._arenas:
+                return self._arenas[name]
+        try:
+            store = ShmObjectStore(name, create=False)
+        except Exception:  # noqa: BLE001 — arena gone/renamed: socket path
+            store = None
+        with self._arena_lock:
+            return self._arenas.setdefault(name, store)
+
+    def _pull_shm(self, arena_name: Optional[str], oid_hex: str,
+                  raw: bool) -> Any:
+        """Zero-socket same-host pull: read the staged blob straight out
+        of the holder's shm arena. Buffers are copied out of the mapping
+        during decode (the arena may evict the entry after release), but
+        no byte ever crosses a socket. Returns _SHM_MISS when the arena
+        or the staged entry is unavailable."""
+        if not arena_name:
+            return _SHM_MISS
+        store = self._attach_arena(arena_name)
+        if store is None:
+            return _SHM_MISS
+        try:
+            sid = _stage_id(ObjectID.from_hex(oid_hex).binary(), raw)
+        except (ValueError, TypeError):
+            return _SHM_MISS
+        try:
+            view = store.get_view(sid)
+        except Exception:  # noqa: BLE001 — holder tore the arena down
+            return _SHM_MISS
+        if view is None:
+            return _SHM_MISS
+        try:
+            return _decode_blob(view, zero_copy=False)
+        finally:
+            try:
+                store.release(sid)
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
 
     def _pull_chunked(self, address: str, oid_hex: str, raw: bool,
                       start: int, end: int, src_node: str = "",
-                      flow_path: str = "chunked") -> bytes:
+                      flow_path: str = "chunked", sink=None, commit=None):
         """Pull bytes [start, end) as pipelined chunk requests: a window of
         chunk_window requests stays outstanding on one exclusively-held
         connection instead of one synchronous round trip per ~1MB. The
         server handles a connection's requests strictly in order, so
-        responses return in request order and match by id."""
+        responses return in request order and match by id.
+
+        Chunks ride the MSG_BLOB lane: ONE chunk_stream request makes the
+        server push the whole range as blob frames (header + memoryview
+        scatter-gather per chunk), each payload recv_into'd straight into
+        the destination buffer — no per-chunk request, pickling, or
+        reassembly copy on either side; TCP flow control paces the
+        stream.
+
+        `sink=(buf, base)` lands blob offset `off` at buf[off - base];
+        relay partials and stripe lanes share one caller-owned buffer
+        this way (default: a fresh buffer covering [start, end)).
+        `commit(upto)` fires after each landed chunk with the contiguous
+        high-water offset — relay holders publish it to parked readers.
+        Returns the destination buffer."""
         pool = self._pool(address)
         slot = pool.checkout()
         dead = True
-        parts: List[bytes] = []
-        pending: "deque[Tuple[int, int, int]]" = deque()  # (req_id, off, len)
-        offset = start
+        if sink is None:
+            buf, base = _alloc_buf(end - start), start
+        else:
+            buf, base = sink
+        mv = memoryview(buf)
         src_node = src_node or object_ledger.peer_node(address)
         flow_dst = self._flow_dst()
+        req_id = self._new_id()
+        expect = start
+
+        def sink_for(rid: int, off: int, n: int) -> memoryview:
+            if rid != req_id or off != expect or \
+                    n != min(self.chunk_bytes, end - off):
+                raise WireError(
+                    f"blob stream out of order from {address}: frame "
+                    f"(id {rid}, [{off}, {off + n})) at offset {expect}")
+            return mv[off - base:off - base + n]
+
+        # flow rows batch across chunks (flushed every flow_every bytes
+        # and at stream end) — one ledger insert per ~8 chunks keeps the
+        # edge-byte sums exact while pricing record_flow out of the
+        # per-chunk hot path
+        flow_pending = 0
+        flow_every = 8 * self.chunk_bytes
         try:
             sock = slot.sock
-            while offset < end or pending:
-                while offset < end and len(pending) < self.chunk_window:
-                    length = min(self.chunk_bytes, end - offset)
-                    req_id = self._new_id()
-                    send_msg(sock, MSG_REQUEST,
-                             {"id": req_id, "method": "chunk",
-                              "args": (oid_hex, offset, length, raw)})
-                    pending.append((req_id, offset, length))
-                    offset += length
-                req_id, off, _length = pending.popleft()
-                msg_type, resp = recv_msg(sock)
-                if msg_type != MSG_RESPONSE or resp.get("id") != req_id:
+            send_msg(sock, MSG_REQUEST,
+                     {"id": req_id, "method": "chunk_stream",
+                      "args": (oid_hex, start, end, self.chunk_bytes, raw)})
+            while True:
+                msg_type, payload = recv_frame_into(sock, sink_for)
+                if msg_type == MSG_RESPONSE:
+                    if payload.get("id") != req_id:
+                        raise ObjectPullConnectionError(
+                            f"bad transfer response from {address}")
+                    if not payload.get("ok"):
+                        # app-level refusal: the stream closed cleanly,
+                        # the connection stays usable
+                        dead = False
+                        raise ObjectPullError(
+                            payload.get("error", "pull failed"))
+                    break
+                if msg_type != MSG_BLOB:
                     raise ObjectPullConnectionError(
                         f"bad transfer response from {address}")
-                if not resp.get("ok"):
-                    raise ObjectPullError(resp.get("error", "pull failed"))
-                chunk = resp["value"]
-                if not chunk:
-                    raise ObjectPullError(
-                        f"short read at {off}/{end} for {oid_hex}")
-                parts.append(chunk)
+                _, off, n = payload
+                expect = off + n
                 _pulled_chunks.inc()
-                _pulled_bytes.inc(len(chunk))
-                _pull_bytes.inc(len(chunk))
-                object_ledger.record_flow(src_node, flow_dst, flow_path,
-                                          len(chunk))
+                _pulled_bytes.inc(n)
+                _pull_bytes.inc(n)
+                flow_pending += n
+                if flow_pending >= flow_every:
+                    object_ledger.record_flow(src_node, flow_dst,
+                                              flow_path, flow_pending)
+                    flow_pending = 0
+                if commit is not None:
+                    commit(expect)
+            if expect != end:
+                raise ObjectPullError(
+                    f"short stream at {expect}/{end} for {oid_hex}")
             dead = False
-            object_ledger.record_flow(src_node, flow_dst, flow_path, 0,
-                                      transfers=1)
+            object_ledger.record_flow(src_node, flow_dst, flow_path,
+                                      flow_pending, transfers=1)
+            flow_pending = 0
         except (WireError, OSError) as e:
             raise ObjectPullConnectionError(
                 f"transfer connection to {address} lost: {e}")
-        except ObjectPullError as e:
-            # app-level refusal mid-stream: responses for the rest of the
-            # window are still queued on the socket — retire it rather
-            # than desync the next caller
-            dead = True if pending else isinstance(
-                e, ObjectPullConnectionError)
-            raise
         finally:
+            if flow_pending:
+                # failed mid-stream: the landed bytes were counted, so
+                # the ledger must see them too (exact conservation)
+                object_ledger.record_flow(src_node, flow_dst, flow_path,
+                                          flow_pending)
             pool.checkin(slot, dead=dead)
-        return b"".join(parts)
+        return buf
 
     def _pull_striped(self, address: str, peers: Sequence[str],
                       oid_hex: str, raw: bool, total: int,
@@ -733,9 +1141,10 @@ class ObjectTransferClient:
         stripe failure also falls back — striping is an optimization,
         never a correctness dependency."""
         holders = [address]
+        max_stripes = max(1, int(config.object_transfer_max_stripes))
         for peer in peers:
-            if len(holders) >= 4:  # diminishing returns past a few stripes
-                break
+            if len(holders) >= max_stripes:
+                break  # diminishing returns past a few stripes
             try:
                 if self._call(peer, "contains", oid_hex):
                     holders.append(peer)
@@ -753,7 +1162,10 @@ class ObjectTransferClient:
                 break
             ranges.append((h, off, min(off + per, total)))
             off += per
-        results: List[Optional[bytes]] = [None] * len(ranges)
+        # every stripe recv_intos its range of ONE shared buffer — the
+        # lanes never overlap, so no reassembly join afterwards
+        buf = _alloc_buf(total)
+        done: List[bool] = [False] * len(ranges)
         errors: List[Optional[BaseException]] = [None] * len(ranges)
 
         def work(i: int, holder: str, lo: int, hi: int) -> None:
@@ -762,9 +1174,10 @@ class ObjectTransferClient:
                 # holder, not from the primary address
                 src = src_node if holder == address else \
                     object_ledger.peer_node(holder)
-                results[i] = self._pull_chunked(holder, oid_hex, raw, lo, hi,
-                                                src_node=src,
-                                                flow_path="stripe")
+                self._pull_chunked(holder, oid_hex, raw, lo, hi,
+                                   src_node=src, flow_path="stripe",
+                                   sink=(buf, 0))
+                done[i] = True
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors[i] = e
 
@@ -775,13 +1188,12 @@ class ObjectTransferClient:
             t.start()
         for t in threads:
             t.join()
-        if any(e is not None for e in errors) or any(
-                r is None for r in results):
-            failed = next(e for e in errors if e is not None)
+        if not all(done):
+            failed = next((e for e in errors if e is not None), None)
             logger.debug("striped pull of %s fell back to one holder: %r",
                          oid_hex[:16], failed)
             return None
-        return b"".join(results)  # type: ignore[arg-type]
+        return buf
 
     def _pull_native(self, address: str, native_port: int, oid_hex: str,
                      raw: bool, total: int, src_node: str = "") -> Any:
@@ -849,7 +1261,7 @@ class ObjectTransferClient:
             if view is None:
                 return _NATIVE_MISS  # evicted locally before the read
             try:
-                value = pickle.loads(view)
+                value = _decode_blob(view, zero_copy=False)
             finally:
                 # release the pin but keep the sealed blob: concurrent and
                 # repeat pulls of the same (immutable) object hit it here,
@@ -884,6 +1296,13 @@ class ObjectTransferClient:
             self._pools.clear()
         for pool in pools:
             pool.close()
+        # arena attachments: DROP the references, never close() them here.
+        # A concurrent _pull_shm may be mid-read of a view into the
+        # mapping; munmapping under it is a segfault. The holder owns the
+        # segment — each attachment unmaps via __del__ once its last
+        # in-flight reader drops the reference.
+        with self._arena_lock:
+            self._arenas.clear()
         self._plane.teardown()
 
 
@@ -898,6 +1317,7 @@ def serve_object_transfer(runtime, host: str = "127.0.0.1",
     object_ledger.note_peer(server.address, node_hex)
     try:
         runtime.control_plane.kv_put(KV_PREFIX + node_hex, server.address)
+        runtime.control_plane.kv_put(HOST_PREFIX + node_hex, _host_token())
     except Exception:  # noqa: BLE001 — advertising is best-effort
         logger.warning("could not advertise transfer address", exc_info=True)
     server.start_load_gossip(runtime.control_plane, node_hex)
@@ -919,12 +1339,39 @@ def _shared_client() -> ObjectTransferClient:
         return _default_client
 
 
-def _ranked_holders(control_plane) -> List[str]:
-    """Advertised transfer addresses, least-loaded first. Load is each
+def _holder_tier(control_plane, node_hex: str, local_token: str,
+                 local_slice) -> int:
+    """Locality tier of a holder: 0 same host (shm distance), 1 same
+    slice/pod (ICI-adjacent hosts), 2 everything else. Missing topology
+    info degrades to tier 2 — ranking is advisory, never correctness."""
+    try:
+        token = control_plane.kv_get(HOST_PREFIX + node_hex)
+        if token and token == local_token:
+            return 0
+    except Exception:  # noqa: BLE001 — tokens are advisory
+        pass
+    if local_slice is not None:
+        try:
+            from .ids import NodeID
+
+            info = control_plane.get_node(NodeID.from_hex(node_hex))
+            if info is not None and info.slice_id == local_slice:
+                return 1
+        except Exception:  # noqa: BLE001 — topology is advisory
+            pass
+    return 2
+
+
+def _ranked_holders(control_plane, local_token: Optional[str] = None,
+                    local_slice=None) -> List[str]:
+    """Advertised transfer addresses, nearest-and-least-loaded first:
+    locality tier (same host < same slice < cross-pod, from the
+    `object_transfer_host/*` tokens and node slice ids) then each
     holder's gossiped outstanding-request count (`object_transfer_load/*`
     KV, published by start_load_gossip); holders that never gossiped rank
     as idle, preserving the old iteration order among ties."""
-    ranked: List[Tuple[float, int, str]] = []
+    token = local_token if local_token is not None else _host_token()
+    ranked: List[Tuple[int, float, int, str]] = []
     for idx, key in enumerate(control_plane.kv_keys(KV_PREFIX)):
         address = control_plane.kv_get(key)
         if not address:
@@ -938,25 +1385,168 @@ def _ranked_holders(control_plane) -> List[str]:
                 load = float(raw)
         except Exception:  # noqa: BLE001 — load is advisory
             pass
-        ranked.append((load, idx, address))
+        tier = _holder_tier(control_plane, node_hex, token, local_slice)
+        ranked.append((tier, load, idx, address))
     ranked.sort()
-    return [addr for _, _, addr in ranked]
+    return [addr for _, _, _, addr in ranked]
+
+
+def _claim_relay_slot(control_plane, oid_hex: str, address: str,
+                      label: str, node_hex: str,
+                      max_slots: int = 4096) -> Optional[int]:
+    """Atomically claim the lowest free relay-tree slot for this puller
+    (kv_put overwrite=False is the compare-and-set). The claim value
+    carries the puller's transfer address (children dial it), its flow
+    label (children attribute the edge), and its node id (mark_node_dead
+    purges a dead node's claims by this suffix)."""
+    value = f"{address}|{label}|{node_hex}"
+    slot = 0
+    while slot < max_slots:
+        key = f"{RELAY_PREFIX}{oid_hex}/{slot:06d}"
+        try:
+            if control_plane.kv_put(key, value, overwrite=False):
+                return slot
+        except TypeError:
+            return None  # control plane without CAS puts: no relay
+        slot += 1
+    return None
+
+
+def _relay_parent(control_plane, oid_hex: str, slot: int,
+                  fanout: int) -> Optional[Tuple[str, str, str]]:
+    """-> (address, flow_label, node_hex) of slot's tree parent, or None
+    for root-tier slots (they pull from the sealed holders) and for
+    purged parents (dead node: the child falls back to sealed holders)."""
+    if slot < fanout:
+        return None
+    parent = (slot - fanout) // fanout
+    try:
+        val = control_plane.kv_get(f"{RELAY_PREFIX}{oid_hex}/{parent:06d}")
+    except Exception:  # noqa: BLE001 — control plane hiccup: no parent
+        return None
+    if not val:
+        return None
+    address, _, rest = str(val).partition("|")
+    label, _, node_hex = rest.partition("|")
+    if not address:
+        return None
+    return address, label, node_hex
+
+
+def purge_relay_claims(oid_hex: str, control_plane) -> None:
+    """Best-effort removal of an object's relay-slot claims (broadcast
+    epilogue / bench round teardown — claims are only needed while late
+    pullers may still resolve their parent)."""
+    try:
+        for key in control_plane.kv_keys(f"{RELAY_PREFIX}{oid_hex}/"):
+            control_plane.kv_del(key)
+    except Exception:  # noqa: BLE001 — stale claims only waste KV bytes
+        pass
+
+
+def _relay_pull(control_plane, client, object_id, holders, relay_server,
+                cache_store, on_cached, node_hex: str = "") -> Any:
+    """Join the object's relay tree: claim a slot, register a _Partial on
+    this node's transfer server (so downstream pullers stream our
+    committed prefix mid-transfer), and pull from the claimed parent —
+    falling back through the sealed holders, resuming from the committed
+    offset, if the parent dies. Returns _RELAY_MISS whenever the relay
+    is not worth it or not possible; the caller runs the flat path."""
+    oid_hex = object_id.hex()
+    if not holders:
+        return _RELAY_MISS
+    try:
+        staged = client._call(holders[0], "stage", oid_hex, True)
+        total = staged["size"]
+        shm = staged.get("shm")
+    except ObjectPullError:
+        return _RELAY_MISS
+    except (KeyError, TypeError):
+        return _RELAY_MISS  # pre-staged-protocol holder
+    if (shm is not None and config.object_transfer_shm_handoff
+            and shm.get("token") == _host_token()):
+        return _RELAY_MISS  # same host: the zero-copy handoff wins
+    if total < int(config.object_relay_min_bytes):
+        return _RELAY_MISS
+    fanout = max(1, int(config.object_broadcast_fanout))
+    label = client._flow_dst()
+    # partial BEFORE claim: the instant the claim lands, children may
+    # dial this node — the partial must already be there to park on
+    partial = relay_server.begin_partial(oid_hex, True, total)
+    if partial is None:
+        return _RELAY_MISS  # another pull on this node already feeds it
+    slot = _claim_relay_slot(control_plane, oid_hex, relay_server.address,
+                             label, node_hex or label)
+    if slot is None:
+        relay_server.fail_partial(oid_hex, True, "no relay slot")
+        return _RELAY_MISS
+    # candidates: tree parent first (its partial streams to us chunk-by-
+    # chunk as it lands), then the sealed holders nearest-first — never
+    # ourselves (a self-pull would park on our own partial forever)
+    candidates: List[Tuple[str, str, str]] = []
+    parent = _relay_parent(control_plane, oid_hex, slot, fanout)
+    if parent is not None and parent[0] != relay_server.address:
+        candidates.append(("relay",) + parent[:2])
+    for addr in holders:
+        if addr != relay_server.address:
+            candidates.append(("chunked", addr, ""))
+    last_error: Optional[BaseException] = None
+    for flow_path, address, src_label in candidates:
+        start = partial.committed  # resume: chunks commit atomically
+        try:
+            client._pull_chunked(
+                address, oid_hex, True, start, total,
+                src_node=src_label or object_ledger.peer_node(address),
+                flow_path=flow_path, sink=(partial.buf, 0),
+                commit=partial.commit)
+        except ObjectPullError as e:
+            last_error = e
+            continue
+        value = _decode_blob(memoryview(partial.buf))
+        relay_server.finish_partial(oid_hex, True)
+        try:
+            cache_store.put(object_id, value)
+            if on_cached is not None:
+                on_cached(object_id)
+        except Exception:  # noqa: BLE001 — caching is best-effort
+            logger.debug("pull-through cache of %s failed", object_id,
+                         exc_info=True)
+        return value.load() if isinstance(value, SealedBytes) else value
+    # every candidate failed: release the slot and wake parked children
+    # with an error so they fall back to surviving holders
+    relay_server.fail_partial(oid_hex, True,
+                              f"relay pull failed: {last_error!r}")
+    try:
+        control_plane.kv_del(f"{RELAY_PREFIX}{oid_hex}/{slot:06d}")
+    except Exception:  # noqa: BLE001 — claim GC is best-effort
+        pass
+    return _RELAY_MISS
 
 
 def pull_from_any(control_plane, object_id,
                   client: Optional[ObjectTransferClient] = None,
-                  cache_store=None, on_cached=None) -> Any:
+                  cache_store=None, on_cached=None,
+                  relay_server: Optional[ObjectTransferServer] = None,
+                  node_hex: str = "") -> Any:
     """Resolve `object_transfer/*` advertisements from the control plane
-    and try holders in ascending gossiped-load order until one serves the
-    object. The unranked remainder is offered to the client as striping
-    peers for large chunked pulls.
+    and try holders nearest-first (same host, then same slice, then by
+    ascending gossiped load) until one serves the object. The unranked
+    remainder is offered to the client as striping peers for large
+    chunked pulls.
 
     With `cache_store`, the pull fetches the sealed payload and seals it
     into that (local) store before returning the loaded value — the
     pull-through replica. `on_cached(object_id)` then fires so the caller
     can register the new location in its directory; both steps are
     best-effort and never fail the get (objects are immutable once sealed,
-    so a cached replica can never go stale)."""
+    so a cached replica can never go stale).
+
+    With `relay_server` (this node's own ObjectTransferServer), large
+    pulls join a collective relay tree: the puller claims a tree slot in
+    the KV, streams from its parent's committed prefix, and serves its
+    own partial to downstream pullers mid-transfer — N concurrent
+    pullers disseminate as a pipelined tree instead of N independent
+    full pulls from one sender."""
     from ..util import tracing
 
     client = client or _shared_client()
@@ -965,6 +1555,13 @@ def pull_from_any(control_plane, object_id,
     with tracing.span_if_traced("object_pull",
                                 {"object_id": object_id.hex()[:16],
                                  "holders": len(holders)}):
+        if (relay_server is not None and want_raw
+                and config.object_broadcast_relay):
+            value = _relay_pull(control_plane, client, object_id, holders,
+                                relay_server, cache_store, on_cached,
+                                node_hex=node_hex)
+            if value is not _RELAY_MISS:
+                return value
         return _pull_from_holders(client, object_id, want_raw, holders,
                                   cache_store, on_cached)
 

@@ -21,6 +21,10 @@ Message types:
     RESPONSE {"id": int, "ok": bool, "value": Any} |
              {"id": int, "ok": False, "error": str, "exc": Exception}
     EVENT    {"channel": str, "message": Any}   (server -> client push)
+    BLOB     [8B req id][8B offset][raw bytes]  (zero-copy chunk lane:
+             the payload is NOT pickled — the sender scatter-gathers a
+             header plus a memoryview, the receiver recv_intos straight
+             into a caller-provided buffer at the carried offset)
 """
 
 from __future__ import annotations
@@ -35,8 +39,10 @@ WIRE_VERSION = 1
 MSG_REQUEST = 1
 MSG_RESPONSE = 2
 MSG_EVENT = 3
+MSG_BLOB = 4
 
 _HEADER = struct.Struct(">IBB")  # length, version, type
+_BLOB_PREFIX = struct.Struct(">QQ")  # request id, byte offset
 _MAX_FRAME = 256 << 20  # 256 MB control message ceiling
 
 
@@ -90,3 +96,73 @@ def recv_msg(sock: socket.socket) -> Tuple[int, Any]:
         raise WireError(f"bad frame length {length}")
     body = _recv_exact(sock, length - 2)
     return msg_type, pickle.loads(body)
+
+
+def send_blob(sock: socket.socket, req_id: int, offset: int,
+              view: "memoryview | bytes | bytearray") -> None:
+    """Send a MSG_BLOB frame without copying or pickling the payload.
+
+    The kernel gathers the 22-byte header and the data view in one
+    sendmsg; on a short write the remainder is completed with sendall
+    over sub-views, still copy-free on the Python side.
+    """
+    inj = _fault_injector
+    if inj is not None:
+        inj(sock, "send")
+    data = memoryview(view)
+    if data.ndim != 1 or data.format != "B":
+        data = data.cast("B")
+    n = len(data)
+    if n + 2 + _BLOB_PREFIX.size > _MAX_FRAME:
+        raise WireError(f"blob frame too large: {n} bytes")
+    hdr = _HEADER.pack(n + 2 + _BLOB_PREFIX.size, WIRE_VERSION, MSG_BLOB)
+    prefix = _BLOB_PREFIX.pack(req_id, offset)
+    sent = sock.sendmsg([hdr, prefix, data])
+    skip = len(hdr) + len(prefix)
+    if sent < skip:
+        sock.sendall((hdr + prefix)[sent:])
+        sent = skip
+    if sent - skip < n:
+        sock.sendall(data[sent - skip:])
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    while view.nbytes:
+        k = sock.recv_into(view, view.nbytes)
+        if not k:
+            raise WireError("connection closed mid-blob")
+        view = view[k:]
+
+
+def recv_frame_into(
+    sock: socket.socket,
+    sink_for: Callable[[int, int, int], memoryview],
+) -> Tuple[int, Any]:
+    """recv_msg variant that lands MSG_BLOB payloads in caller memory.
+
+    Non-blob frames behave exactly like recv_msg. For a blob frame the
+    caller's `sink_for(req_id, offset, nbytes)` must return a writable
+    memoryview of exactly `nbytes`; the payload is recv_into'd there and
+    the return value is (MSG_BLOB, (req_id, offset, nbytes)).
+    """
+    inj = _fault_injector
+    if inj is not None:
+        inj(sock, "recv")
+    header = _recv_exact(sock, _HEADER.size)
+    length, version, msg_type = _HEADER.unpack(header)
+    if version != WIRE_VERSION:
+        raise WireError(f"wire version {version} != {WIRE_VERSION}")
+    if length < 2 or length > _MAX_FRAME:
+        raise WireError(f"bad frame length {length}")
+    if msg_type != MSG_BLOB:
+        body = _recv_exact(sock, length - 2)
+        return msg_type, pickle.loads(body)
+    if length < 2 + _BLOB_PREFIX.size:
+        raise WireError(f"short blob frame: {length}")
+    req_id, offset = _BLOB_PREFIX.unpack(_recv_exact(sock, _BLOB_PREFIX.size))
+    nbytes = length - 2 - _BLOB_PREFIX.size
+    sink = sink_for(req_id, offset, nbytes)
+    if sink.nbytes != nbytes:
+        raise WireError(f"blob sink mismatch: {sink.nbytes} != {nbytes}")
+    _recv_exact_into(sock, sink)
+    return MSG_BLOB, (req_id, offset, nbytes)

@@ -357,7 +357,10 @@ class WorkerAPIClient:
             # replica is as good as the origin)
             _cache_hits.inc()
             return self._local_store.get(oid, timeout=10.0), True
-        holder = self._directory.locate(oid)
+        # prefer_local: a holder sharing this boot's host token serves
+        # over the shm fd handoff (zero socket bytes) instead of a
+        # loopback copy — ranked ahead of genuinely remote holders
+        holder = self._directory.locate(oid, prefer_local=True)
         if holder is None:
             # ready but no location: sealed value lost (holder died) or
             # the dir write is in flight — give the directory two beats,

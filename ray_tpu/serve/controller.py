@@ -281,6 +281,14 @@ class ServeController:
                     # respawn replicas onto an orphaned state object.
                     self._drain(state)
                     continue
+            if len(state.replicas) < state.target:
+                # weight deployment: ObjectRef init args (model weights,
+                # tokenizer blobs) are about to be pulled by every new
+                # replica at once — pre-seed them through the collective
+                # relay tree so replicas pull from each other's hosts
+                # instead of all hammering the driver. Best-effort: a
+                # failed broadcast just means replicas pull on demand.
+                self._broadcast_init_refs(state)
             while len(state.replicas) < state.target:
                 changed = True
                 opts = dict(state.config.ray_actor_options)
@@ -314,6 +322,29 @@ class ServeController:
             if changed:
                 with self._lock:
                     state.membership += 1
+
+    def _broadcast_init_refs(self, state: _DeploymentState) -> None:
+        """Pre-seed ObjectRef init args cluster-wide before a scale-up
+        wave (api.broadcast relay tree). Broadcast each distinct ref at
+        most once per deployment generation — weights don't change under
+        one state object."""
+        from ..api import ObjectRef
+
+        seeded = getattr(state, "_broadcast_seeded", None)
+        if seeded is None:
+            seeded = state._broadcast_seeded = set()
+        refs = [v for v in (*state.init_args,
+                            *state.init_kwargs.values())
+                if isinstance(v, ObjectRef)]
+        for ref in refs:
+            if ref.object_id in seeded:
+                continue
+            try:
+                api.broadcast(ref, timeout=60.0)
+                seeded.add(ref.object_id)
+            except Exception:  # noqa: BLE001 — pre-seeding is best-effort
+                logger.debug("init-arg broadcast failed for %s",
+                             state.name, exc_info=True)
 
     def _autoscale(self, state: _DeploymentState) -> None:
         cfg: Optional[AutoscalingConfig] = state.config.autoscaling_config

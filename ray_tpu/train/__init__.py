@@ -16,7 +16,9 @@ from .checkpoint import (  # noqa: F401
     AsyncCheckpointWriter,
     Checkpoint,
     CheckpointManager,
+    broadcast_checkpoint,
     load_pytree,
+    restore_checkpoint,
     save_pytree,
 )
 from .config import (  # noqa: F401

@@ -38,6 +38,20 @@ from ray_tpu.core.object_transfer import (
 
 pytestmark = pytest.mark.objects
 
+
+@pytest.fixture
+def socket_pull_path():
+    """Force pulls over the socket: the same-host shm handoff is a
+    ZERO-socket path that records no flow edges by contract (see
+    test_broadcast.py::TestSameHostHandoff), and these tests assert on
+    the socket path's flow accounting."""
+    from ray_tpu.core.config import config
+
+    was = bool(config.object_transfer_shm_handoff)
+    config.apply_overrides({"object_transfer_shm_handoff": False})
+    yield
+    config.apply_overrides({"object_transfer_shm_handoff": was})
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -467,7 +481,8 @@ class TestCacheEvictionAccounting:
 
 
 class TestFlowAccounting:
-    def test_pull_flows_conserve_pull_bytes(self, runtime):
+    def test_pull_flows_conserve_pull_bytes(self, runtime,
+                                            socket_pull_path):
         """Acceptance criterion: per-edge flow sums reconcile with
         object_pull_bytes — record_flow sits at the same increment
         sites, so the deltas must match exactly for a quiet edge."""
@@ -495,7 +510,8 @@ class TestFlowAccounting:
             assert e["src"] == src_hex[:12]
             assert e["path"] in ("native", "chunked", "stripe")
 
-    def test_window_bandwidth_gauge_populates(self, runtime):
+    def test_window_bandwidth_gauge_populates(self, runtime,
+                                              socket_pull_path):
         ref = ray_tpu.put(b"W" * (256 << 10))
         server = ObjectTransferServer(runtime.driver_agent.store)
         client = ObjectTransferClient()
@@ -686,7 +702,8 @@ class TestFederatedObjectPlane:
         assert any("channels" in rec and "channels" in rec["channels"]
                    for rec in telem.values())
 
-    def test_cross_host_pull_records_flow_edge(self, head_with_worker):
+    def test_cross_host_pull_records_flow_edge(self, head_with_worker,
+                                               socket_pull_path):
         """A real worker->head pull lands a labeled flow edge whose src
         is the worker node and whose dst is the head node."""
         rt, _proc = head_with_worker

@@ -157,6 +157,20 @@ class TestNativePath:
     the plane up in the background (a cold environment may have to build
     the library), so tests wait for readiness before asserting on it."""
 
+    @pytest.fixture(autouse=True)
+    def _socket_pull_path(self):
+        """Both ends of these tests share a host, so the zero-copy shm
+        handoff would satisfy the pull before the native plane ever
+        engages (that contract is tested in
+        test_broadcast.py::TestSameHostHandoff). Force the socket path
+        so the plane under test actually carries the bytes."""
+        from ray_tpu.core.config import config
+
+        was = bool(config.object_transfer_shm_handoff)
+        config.apply_overrides({"object_transfer_shm_handoff": False})
+        yield
+        config.apply_overrides({"object_transfer_shm_handoff": was})
+
     @staticmethod
     def _wait_native(obj, timeout=10.0):
         deadline = time.monotonic() + timeout
