@@ -31,7 +31,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
 	tests/test_serve_cross_host.py tests/test_disagg.py \
-	tests/test_dashboard.py \
+	tests/test_fleet.py tests/test_dashboard.py \
 	tests/test_integrations.py tests/test_platform.py \
 	tests/test_microbenchmark.py tests/test_pipeline_trainer.py
 
@@ -40,9 +40,9 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	broadcast tsan shm lint \
+	broadcast fleet tsan shm lint \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
-	bench-health bench-pipeline bench-profile bench-sanitize
+	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -97,6 +97,12 @@ bench-profile:
 # merged into BENCH_SUMMARY.json
 bench-sanitize:
 	env RAY_TPU_BENCH_SUITE=sanitize python bench.py
+
+# fleet chaos loop: streaming burst with a decode replica killed every
+# few seconds — live resume must hold serve_fleet_failed_requests at 0
+# with p95 TTFT within 2x steady-state, merged into BENCH_SUMMARY.json
+bench-fleet:
+	env RAY_TPU_BENCH_SUITE=fleet python bench.py
 
 # cluster health at a glance (alerts, SLO digests, node liveness) from
 # the in-process health plane; DASH=host:port reads a running head
@@ -170,6 +176,13 @@ memory:
 broadcast:
 	@echo "== broadcast tier =="
 	$(PYTEST) -m broadcast tests/
+
+# fleet actuation tier (autoscale policy convergence, kill-resume chaos,
+# adapter hot-swap, remediation pipeline) for iterating on fleet work;
+# the fast subset also runs inside check via LIB_TESTS
+fleet:
+	@echo "== fleet tier =="
+	$(PYTEST) -m fleet tests/
 
 check-all: check check-slow
 

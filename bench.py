@@ -1560,6 +1560,192 @@ def bench_grpo() -> None:
     _emit("grpo_samples_per_sec", samples_per_sec, "samples/s", "grpo_anchor")
 
 
+def bench_fleet(model: str) -> None:
+    """Fleet chaos gate: the SAME streaming burst twice through a
+    prefill + 2-decode disagg fleet — once untouched (steady-state),
+    once with decode replicas killed mid-burst (every in-flight stream
+    on the victim dies on its next pull, the in-process equivalent of a
+    SIGKILL). Live resume (serve/fleet.py + disagg open_stream) must
+    hold failed requests at ZERO, with chaos p95 TTFT within 2x of
+    steady-state — the acceptance rows the driver checks:
+
+      * serve_fleet_failed_requests (must be 0)
+      * serve_fleet_chaos_p95_ttft / serve_fleet_steady_p95_ttft and
+        their ratio serve_fleet_chaos_vs_steady_p95_ttft (<= 2.0)
+      * serve_fleet_resume_ms (mean re-open latency per death)
+
+    The run refuses to report if no replica actually died or no stream
+    actually resumed — a chaos bench that didn't inject chaos is lying.
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.core.metrics import registry
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config(model)
+    rng = np.random.default_rng(17)
+    # shape the burst so a resume continuation (original prompt + every
+    # committed token replayed as the new prompt) still fits the model's
+    # position table: prompt + max_tokens <= cfg.max_seq_len
+    prompt_len, max_tokens, n_req = 48, 32, 16
+    if prompt_len + max_tokens > cfg.max_seq_len:
+        raise RuntimeError(
+            f"fleet bench shape {prompt_len}+{max_tokens} exceeds "
+            f"{model} max_seq_len={cfg.max_seq_len}")
+
+    class _Mortal(EngineWorker):
+        def __init__(self, engine, name):
+            super().__init__(engine, name)
+            self.killed = threading.Event()
+            self.deaths = 0
+
+        def decode_stream(self, request):
+            inner = super().decode_stream(request)
+
+            def gen():
+                for item in inner:
+                    if self.killed.is_set():
+                        self.deaths += 1
+                        raise RuntimeError(f"{self.name} SIGKILLed")
+                    yield item
+
+            return gen()
+
+    def make_engine():
+        ecfg = EngineConfig(max_batch_size=16, max_seq_len=cfg.max_seq_len,
+                            prefill_batch_size=8, busy_span=4)
+        e = InferenceEngine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                            ecfg)
+        # warm both the fresh-prompt bucket and the (longer) resume-
+        # continuation bucket: a mid-chaos jit would bill compilation
+        # to the resume blip being measured
+        e.warmup(buckets=[prompt_len, prompt_len + max_tokens])
+        return e
+
+    engines = [make_engine() for _ in range(4)]
+    pe, d0e, d1e, d2e = engines
+    d0 = _Mortal(d0e, "decode0")
+    d1 = _Mortal(d1e, "decode1")
+    spare = EngineWorker(d2e, "decode2")
+    co = DisaggCoordinator([EngineWorker(pe, "prefill0")], [d0, d1],
+                           {"small_blob_bytes": 0})
+    co.generate(list(rng.integers(1, cfg.vocab_size, prompt_len)),
+                max_tokens=4)  # warm export/import programs
+
+    def stream_burst(prompts, progress=None):
+        results: list = [None] * len(prompts)
+        errors: list = [None] * len(prompts)
+
+        def worker(i):
+            t0 = time.perf_counter()
+            try:
+                ds = co.open_stream(prompts[i], max_tokens=max_tokens)
+                ttft, n_tok = None, 0
+                for _tok in ds.tokens():
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    n_tok += 1
+                    if progress is not None:
+                        progress[0] += 1
+                results[i] = {"ttft_s": ttft, "tokens": n_tok}
+            except Exception as e:  # noqa: BLE001 — counted after join
+                errors[i] = e
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results, errors, time.perf_counter() - t0
+
+    def fresh_prompts():
+        # fresh prompts per pass so prefix routing never short-circuits
+        # the prefill+migration path being stressed
+        return [list(rng.integers(1, cfg.vocab_size, prompt_len))
+                for _ in range(n_req)]
+
+    def p95(xs):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+    # pass 1: steady state, nobody dies
+    steady, steady_errs, steady_wall = stream_burst(fresh_prompts())
+    if any(steady_errs):
+        raise RuntimeError(f"steady-state burst failed: "
+                           f"{[e for e in steady_errs if e][0]!r}")
+    steady_p95 = p95([r["ttft_s"] for r in steady])
+
+    # pass 2: chaos — kill the busiest decode replica partway in, join
+    # the spare, then kill the next busiest survivor
+    resumes = registry.get("serve_fleet_resumes")
+    resume_s = registry.get("serve_fleet_resume_seconds")
+    r0, rs0, rc0 = resumes.get(), resume_s.sum(), resume_s.count()
+    progress = [0]
+    total_toks = n_req * max_tokens
+
+    def killer():
+        # fire on burst *progress*, not wall clock: prefill dominates the
+        # burst's opening phase, so a timed kill can land when no decode
+        # stream is in flight and the chaos pass injects nothing
+        for frac, joiner in ((0.25, spare), (0.55, None)):
+            deadline = time.perf_counter() + 120.0
+            while (progress[0] < frac * total_toks
+                   and time.perf_counter() < deadline):
+                time.sleep(0.002)
+            cand = [w for w in co.workers("decode")
+                    if isinstance(w, _Mortal) and not w.killed.is_set()]
+            if not cand:
+                return
+            if joiner is not None:
+                co.add_worker("decode", joiner)
+            max(cand, key=lambda w: w.load()).killed.set()
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    chaos, chaos_errs, chaos_wall = stream_burst(fresh_prompts(),
+                                                 progress=progress)
+    kt.join(timeout=30.0)
+    for e in engines:
+        e.stop()
+
+    failed = [e for e in chaos_errs if e is not None]
+    deaths = d0.deaths + d1.deaths
+    n_resumes = int(resumes.get() - r0)
+    if deaths == 0 or n_resumes == 0:
+        raise RuntimeError(
+            f"fleet chaos bench injected no chaos (deaths={deaths}, "
+            f"resumes={n_resumes}) — rows would be meaningless")
+    chaos_p95 = p95([r["ttft_s"] for r in chaos if r])
+    resume_ms = 1e3 * (resume_s.sum() - rs0) / max(
+        resume_s.count() - rc0, 1)
+    short = [r for r in chaos if r and r["tokens"] != max_tokens]
+    print(
+        f"# fleet-chaos: model={model} n_req={n_req} deaths={deaths} "
+        f"resumes={n_resumes} failed={len(failed)} truncated={len(short)} "
+        f"steady={steady_wall:.2f}s chaos={chaos_wall:.2f}s",
+        file=sys.stderr,
+    )
+    mname = model.replace("-", "_")
+    _emit("serve_fleet_failed_requests", float(len(failed)), "requests",
+          "fleet_failed_anchor", lower_is_better=True)
+    _emit(f"serve_fleet_steady_p95_ttft_{mname}", steady_p95, "s",
+          "fleet_steady_ttft_anchor", lower_is_better=True)
+    _emit(f"serve_fleet_chaos_p95_ttft_{mname}", chaos_p95, "s",
+          "fleet_chaos_ttft_anchor", lower_is_better=True)
+    _emit("serve_fleet_chaos_vs_steady_p95_ttft",
+          chaos_p95 / max(steady_p95, 1e-9), "ratio",
+          "fleet_ttft_ratio_anchor", lower_is_better=True)
+    _emit("serve_fleet_resume_ms", resume_ms, "ms",
+          "fleet_resume_anchor", lower_is_better=True)
+
+
 def main() -> None:
     suite = os.environ.get(
         "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo")
@@ -1594,6 +1780,11 @@ def main() -> None:
         # concurrency-sanitizer overhead: tracked-locks vs stock-locks
         # serve burst. Latency-sensitive like trace/health/profile.
         bench_sanitize(model)
+    if "fleet" in wanted:
+        # fleet chaos gate: decode replicas killed mid-burst — live
+        # resume must hold failed requests at 0 with chaos p95 TTFT
+        # within 2x steady-state. Latency-sensitive like serve.
+        bench_fleet(model)
     if "grpo" in wanted:
         # rollout generate pays per-TOKEN dispatches — as latency-bound
         # as serve TTFT, and equally poisoned by the HBM churn the train/

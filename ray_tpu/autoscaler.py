@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .core.config import config
 from .core.logging import get_logger
 
 logger = get_logger("autoscaler")
@@ -338,6 +339,11 @@ class Autoscaler:
         # a joiner that never arrives is eventually retried.
         self.launch_grace_s = 30.0
         self._launching: List[tuple] = []  # (monotonic_ts, remaining_cap)
+        # hysteresis: launches only happen outside the cooldown window
+        # that the previous scale-up wave opened, and one pass may take
+        # at most autoscale_step_max launch actions — so a burst of
+        # alerts produces ONE bounded wave, not one node per alert
+        self._last_wave_ts = float("-inf")
 
     # -- demand → decisions --------------------------------------------------
 
@@ -389,6 +395,10 @@ class Autoscaler:
         pending_caps: List[Dict[str, float]] = [
             dict(cap) for _ts, cap, _known in self._launching
         ]
+        cooldown_s = float(config.get("autoscale_cooldown_s"))
+        step_max = max(1, int(config.get("autoscale_step_max")))
+        in_cooldown = now - self._last_wave_ts < cooldown_s
+        steps = deferred = 0
         for demand in demands:
             absorbed = False
             for cap in pending_caps:
@@ -399,6 +409,9 @@ class Autoscaler:
                     break
             if absorbed:
                 continue
+            if in_cooldown or steps >= step_max:
+                deferred += 1
+                continue
             for t in self.node_types.values():
                 existing = sum(1 for v in by_type.values() if v == t.name)
                 if existing >= t.max_workers:
@@ -406,6 +419,7 @@ class Autoscaler:
                 if self._fits(demand, t.resources):
                     self.provider.create_nodes(t, 1)
                     launched[t.name] = launched.get(t.name, 0) + 1
+                    steps += 1
                     by_type[f"_pending{len(by_type)}"] = t.name
                     cap = dict(t.resources)
                     for k, v in demand.items():
@@ -413,6 +427,13 @@ class Autoscaler:
                     pending_caps.append(cap)
                     self._launching.append((now, dict(t.resources), set(alive_ids)))
                     break
+        if steps:
+            self._last_wave_ts = now
+        if deferred:
+            logger.info(
+                "deferred %d unabsorbed demand(s): %s", deferred,
+                "inside autoscale_cooldown_s window" if in_cooldown
+                else "autoscale_step_max reached this pass")
         self._scale_down()
         return launched
 

@@ -270,6 +270,20 @@ declare(
     "quarantines a degraded replica before sending one probe request.",
 )
 declare(
+    "autoscale_cooldown_s", 15.0,
+    "Minimum gap between scale-up waves (autoscaler.py node launches and "
+    "serve/fleet.py replica-target bumps). Demand arriving inside the "
+    "cooldown is absorbed by the in-flight wave instead of launching "
+    "more capacity, so one alert burst cannot flap the fleet.",
+)
+declare(
+    "autoscale_step_max", 2,
+    "Cap on how many scale-up actions one evaluation pass may take "
+    "(node launches per Autoscaler.update, replica-target delta per "
+    "FleetController period). Bounds the blast radius of a noisy "
+    "demand signal.",
+)
+declare(
     "flight_recorder_entries", 256,
     "Per-process flight-recorder ring size (recent spans + log lines + "
     "events, util/flight_recorder.py) flushed into a postmortem artifact "

@@ -129,7 +129,12 @@ def default_rules() -> List[Rule]:
     rules = [
         Rule("queue_depth",
              f"serve_disagg_queue_depth > {int(config.get('health_queue_depth_max'))} for 2",
-             group_by=("role",)),
+             group_by=("role",),
+             # sustained backlog asks the autoscaler for another serving
+             # node before the scheduler's pending queue ever backs up;
+             # serve/fleet.py reads the same firing alert for replica
+             # targets, so both actuation paths see one signal
+             demand={"CPU": 1.0}),
         Rule("memory_pressure",
              f"host_memory_used_fraction > {float(config.get('health_memory_fraction_max'))} for 2",
              severity="critical", group_by=("node_id",)),

@@ -65,6 +65,9 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
     # pure stdlib); without this a dashboard export from a process that
     # never profiled would reference unregistered series
     from .util import profiler  # noqa: F401
+    # likewise the fleet board's series live in serve/fleet.py (which
+    # pulls in disagg's resume metrics)
+    from .serve import fleet  # noqa: F401
     core = _dashboard("raytpu-core", "ray_tpu / core", [
         _panel("Tasks finished (rate)", "rate(ray_tpu_tasks_finished[1m])",
                0, 0, legend="{{outcome}}"),
@@ -187,8 +190,32 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         _panel("Leaked bytes by kind", "object_leaked_bytes", 5, 16,
                unit="bytes", legend="{{kind}}"),
     ])
+    fleet = _dashboard("raytpu-fleet", "ray_tpu / fleet actuation", [
+        _panel("Target replicas vs demand", "serve_fleet_target_replicas",
+               0, 0, legend="target {{role}}"),
+        _panel("Demand signal", "serve_fleet_demand", 1, 0,
+               legend="demand {{role}}"),
+        _panel("Live resumes (rate)", "rate(serve_fleet_resumes[5m])",
+               2, 8, legend="resumes"),
+        _panel("Resume latency p95",
+               "histogram_quantile(0.95, "
+               "rate(serve_fleet_resume_seconds_bucket[5m]))",
+               3, 8, unit="s", legend="p95"),
+        _panel("Adapter residency", "serve_fleet_adapter_residency",
+               4, 16, legend="{{adapter}}"),
+        _panel("Remediation actions (rate)",
+               "rate(serve_fleet_remediations[5m])", 5, 16,
+               legend="{{stage}}"),
+    ])
+    # demand overlaid on the target panel: convergence at a glance
+    fleet["panels"][0]["targets"].append({
+        "expr": "serve_fleet_demand",
+        "legendFormat": "demand {{role}}",
+        "refId": "B",
+    })
     return {"core": core, "serve": serve, "data": data, "disagg": disagg,
-            "health": health, "profiling": profiling, "objects": objects}
+            "health": health, "profiling": profiling, "objects": objects,
+            "fleet": fleet}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:

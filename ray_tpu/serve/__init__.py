@@ -32,6 +32,7 @@ from .disagg import (  # noqa: F401
     deploy_disagg,
 )
 from .engine import EngineConfig, InferenceEngine, Request  # noqa: F401
+from .fleet import FleetConfig, FleetController  # noqa: F401
 from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from .llm import LLMServer  # noqa: F401
 from .openai_api import (  # noqa: F401

@@ -139,6 +139,21 @@ class DisaggConfig:
     prefix_routing: bool = True
     prefix_route_min_tokens: int = 32
     prefix_gossip_s: float = 2.0
+    # live request resume (serve/fleet.py story): a decode replica dying
+    # mid-stream re-runs the request's remaining tokens on a healthy peer
+    # (prompt + committed tokens replayed as the continuation prompt) and
+    # the client stream continues from the last committed token — a
+    # latency blip, never a failed request. resume_max_attempts bounds
+    # how many distinct replica deaths ONE stream survives.
+    live_resume: bool = True
+    resume_max_attempts: int = 2
+    # adapter-residency gossip: how often the coordinator refreshes each
+    # decode replica's loaded-LoRA set for adapter-aware routing
+    adapter_gossip_s: float = 5.0
+    # graceful scale-down: a replica removed from membership keeps
+    # serving its in-flight streams for up to this long before the
+    # coordinator drops its routing state
+    drain_grace_s: float = 30.0
 
     TRANSFERS = ("object", "channel", "stream")
 
@@ -175,6 +190,16 @@ class DisaggConfig:
         if float(self.prefix_gossip_s) < 0:
             raise ValueError(
                 f"prefix_gossip_s must be >= 0, got {self.prefix_gossip_s}")
+        if int(self.resume_max_attempts) < 0:
+            raise ValueError(
+                f"resume_max_attempts must be >= 0, "
+                f"got {self.resume_max_attempts}")
+        if float(self.adapter_gossip_s) < 0:
+            raise ValueError(
+                f"adapter_gossip_s must be >= 0, got {self.adapter_gossip_s}")
+        if float(self.drain_grace_s) < 0:
+            raise ValueError(
+                f"drain_grace_s must be >= 0, got {self.drain_grace_s}")
 
     @classmethod
     def parse(cls, value) -> "DisaggConfig":

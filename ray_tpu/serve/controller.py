@@ -129,6 +129,30 @@ class ServeController:
         with self._lock:
             return dict(self._routes)
 
+    def set_target(self, name: str, target: int) -> bool:
+        """External actuation (serve/fleet.py policy engine): set a
+        deployment's target replica count directly. Clamped to the
+        deployment's autoscaling bounds when it has any, so the fleet
+        policy and the internal load-based autoscaler can't fight over
+        out-of-bounds targets; the delay clocks are touched so the
+        internal policy doesn't immediately revert the decision."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return False
+            target = max(0, int(target))
+            cfg = state.config.autoscaling_config
+            if cfg is not None:
+                target = min(max(target, cfg.min_replicas), cfg.max_replicas)
+            now = time.monotonic()
+            if target > state.target:
+                state._last_scale_up = now
+            elif target < state.target:
+                state._last_scale_down = now
+            state.target = target
+        self._reconcile_once()
+        return True
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             return {

@@ -55,7 +55,7 @@ from ..core.metrics import MICRO_BUCKETS, Counter, Gauge, Histogram
 from ..util import slo, tracing
 from .config import DisaggConfig
 from .engine import InferenceEngine, Request, prompt_page_fingerprints
-from .router import _replica_key, pow2_choice
+from .router import _replica_key, pick_resident, pow2_choice
 
 logger = get_logger("serve.disagg")
 
@@ -75,6 +75,15 @@ _m_queue_depth = Gauge(
 _m_inflight = Gauge(
     "serve_disagg_inflight",
     "requests currently executing on a role's replica, by role",
+)
+_m_resumes = Counter(
+    "serve_fleet_resumes",
+    "mid-stream replica deaths survived by live request resume",
+)
+_m_resume_s = Histogram(
+    "serve_fleet_resume_seconds",
+    "stall a client stream sees while its request resumes on a peer",
+    buckets=MICRO_BUCKETS,
 )
 
 
@@ -103,6 +112,14 @@ class KvMigrationError(RuntimeError):
     The import is torn down cleanly (pages freed, inbox evicted) before
     this raises — the disagg analogue of the pipeline trainer's
     PipelineStallError."""
+
+
+class _StreamDied(ValueError):
+    """Internal: a decode-side stream reported a terminal error in its
+    trailing summary dict — converted to an exception so the live-resume
+    loop treats it exactly like a raised mid-stream death. Subclasses
+    ValueError so exhausted-resume propagation matches what
+    DisaggStream.tokens() historically raised for summary errors."""
 
 
 class KvInbox:
@@ -664,6 +681,8 @@ class EngineWorker(_LoadTracker):
         self.key = f"engine-worker-{id(self)}"
         self._inbox: Optional[KvInbox] = None
         self._inbox_lock = threading.Lock()
+        self._adapters: Dict[str, Any] = {}  # LoRA id -> resolved weights
+        self._adapter_lock = threading.Lock()
 
     def kv_dest(self, ttl_s: Optional[float] = None):
         with self._inbox_lock:
@@ -675,6 +694,41 @@ class EngineWorker(_LoadTracker):
     def prefix_digest(self) -> Dict[str, Any]:
         return self.engine.prefix_digest()
 
+    def load_adapter(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Pin a LoRA adapter resident: weights inline, or an ObjectRef
+        pulled through the object plane (the broadcast relay tree has
+        usually pre-seeded it host-local by the time this runs)."""
+        adapter_id = str(request["adapter_id"])
+        weights = request.get("weights")
+        if weights is None and request.get("ref") is not None:
+            weights = api.get(request["ref"],
+                              timeout=float(request.get("timeout_s", 60.0)))
+        with self._adapter_lock:
+            self._adapters[adapter_id] = weights
+        return {"adapter_id": adapter_id, "resident": True}
+
+    def list_adapters(self) -> List[str]:
+        with self._adapter_lock:
+            return sorted(self._adapters)
+
+    def _ensure_adapter(self, request: Dict[str, Any]) -> None:
+        """Adapter-aware admission: a request naming a non-resident
+        adapter pulls it lazily via its adapter_ref (residency routing
+        makes this the cold-start path, not the common one)."""
+        adapter_id = request.get("adapter_id")
+        if not adapter_id:
+            return
+        with self._adapter_lock:
+            if adapter_id in self._adapters:
+                return
+        if request.get("adapter_ref") is None:
+            raise ValueError(
+                f"adapter {adapter_id!r} not resident on {self.name} and "
+                f"the request carries no adapter_ref to pull it from")
+        self.load_adapter({"adapter_id": adapter_id,
+                           "ref": request["adapter_ref"],
+                           "timeout_s": request.get("timeout_s", 60.0)})
+
     def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
         try:
@@ -685,6 +739,7 @@ class EngineWorker(_LoadTracker):
     def decode_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
         try:
+            self._ensure_adapter(request)
             return replica_decode(self.engine, request, self._inbox)
         finally:
             self._end()
@@ -692,6 +747,11 @@ class EngineWorker(_LoadTracker):
     def decode_stream(self, request: Dict[str, Any]):
         # load accounting brackets the whole stream, not just the call
         self._begin()
+        try:
+            self._ensure_adapter(request)
+        except BaseException:
+            self._end()
+            raise
 
         def gen():
             try:
@@ -705,12 +765,18 @@ class EngineWorker(_LoadTracker):
     def generate_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
         try:
+            self._ensure_adapter(request)
             return replica_generate(self.engine, request)
         finally:
             self._end()
 
     def generate_stream(self, request: Dict[str, Any]):
         self._begin()
+        try:
+            self._ensure_adapter(request)
+        except BaseException:
+            self._end()
+            raise
 
         def gen():
             try:
@@ -759,6 +825,13 @@ class ReplicaWorker(_LoadTracker):
 
     def prefix_digest(self) -> Dict[str, Any]:
         return self._call("prefix_digest", {}, 30.0)
+
+    def load_adapter(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self._call("load_adapter", request,
+                          float(request.get("timeout_s", 60.0)) + 30.0)
+
+    def list_adapters(self) -> List[str]:
+        return self._call("list_adapters", {}, 30.0)
 
     def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
@@ -852,11 +925,20 @@ class DisaggStream:
                 self.migration_bytes = item.get("migration_bytes")
                 break
             yield item
+        # the summary break leaves the pipeline suspended at its final
+        # yield — close it so the finallys (replica load accounting,
+        # inflight gauge, _live entry) unwind NOW rather than at GC;
+        # fleet scale-down reads replica load and a lingering count
+        # would pin the fleet "busy"
+        self._raw.close()
         if self.error:
             raise ValueError(self.error)
 
     def cancel(self) -> None:
         self._co.cancel(self.request_id)
+        # unwind the stream's finallys NOW (inflight gauge, _live entry)
+        # rather than whenever the abandoned generator gets collected
+        self._raw.close()
 
 
 class DisaggCoordinator:
@@ -881,6 +963,17 @@ class DisaggCoordinator:
         # every prefix_gossip_s)
         self._kv_dest_cache: Dict[Any, Any] = {}
         self._prefix_digests: Dict[Any, Tuple[float, Any]] = {}
+        # gossiped LoRA residency per decode replica (refreshed every
+        # adapter_gossip_s): adapter-aware routing prefers replicas that
+        # already hold the request's adapter
+        self._adapter_residency: Dict[Any, Tuple[float, frozenset]] = {}
+        # graceful scale-down: replicas removed from membership but still
+        # carrying in-flight streams park here (key -> (deadline, worker))
+        # with their caches intact until drained or past drain_grace_s
+        self._draining: Dict[Any, Tuple[float, Any]] = {}
+        # live resume bookkeeping: original request_id -> the request_id
+        # currently running on a replica (changes on each resume attempt)
+        self._resumed: Dict[str, str] = {}
         # serve mode (from_deployments): re-synced against the controller
         self._deployments: Optional[Dict[str, str]] = None
         self._controller = None
@@ -953,13 +1046,46 @@ class DisaggCoordinator:
                     cur.get(_replica_key(r)) or ReplicaWorker(r)
                     for r in replicas
                 ]
-                # drop per-identity caches for replicas that went away —
-                # a replaced replica gets a fresh kv_dest / digest on its
-                # next use instead of a stale channel to a dead process
+                # replicas that went away: a removed replica still
+                # carrying in-flight streams is DRAINED, not dropped —
+                # it leaves the pick set now (it's no longer in
+                # _workers) but keeps its kv_dest/digest caches so its
+                # live streams finish; caches drop once its load hits
+                # zero or drain_grace_s expires. Idle removals drop
+                # immediately — a replaced replica gets a fresh kv_dest
+                # on next use instead of a stale channel to a dead
+                # process.
                 gone = set(cur) - {w.key for w in self._workers[role]}
                 for key in gone:
-                    self._kv_dest_cache.pop(key, None)
-                    self._prefix_digests.pop(key, None)
+                    w = cur[key]
+                    try:
+                        busy = w.load() > 0
+                    except Exception:  # noqa: BLE001 — treat as idle
+                        busy = False
+                    if busy and self.cfg.drain_grace_s > 0:
+                        self._draining.setdefault(
+                            key, (now + self.cfg.drain_grace_s, w))
+                        continue
+                    self._drop_worker_state(key)
+                self._sweep_draining(now)
+
+    def _sweep_draining(self, now: float) -> None:
+        # caller holds self._lock: draining replicas whose last stream
+        # finished (or whose grace expired) finally drop their caches
+        for key, (dl, w) in list(self._draining.items()):
+            try:
+                drained = w.load() <= 0
+            except Exception:  # noqa: BLE001
+                drained = True
+            if drained or now > dl:
+                self._draining.pop(key, None)
+                self._drop_worker_state(key)
+
+    def _drop_worker_state(self, key) -> None:
+        # caller holds self._lock
+        self._kv_dest_cache.pop(key, None)
+        self._prefix_digests.pop(key, None)
+        self._adapter_residency.pop(key, None)
 
     # -------------------------------------------------------------- picks
 
@@ -1017,6 +1143,44 @@ class DisaggCoordinator:
             self._prefix_digests[worker.key] = (now, digest)
         return digest
 
+    def _adapter_residency_for(self, worker) -> frozenset:
+        """The decode replica's resident-LoRA set, refreshed at most
+        every adapter_gossip_s (0 = every request). A failed fetch
+        gossips empty — the replica just stops attracting adapter
+        routes until the next refresh."""
+        now = time.monotonic()
+        with self._lock:
+            hit = self._adapter_residency.get(worker.key)
+        if hit is not None and (self.cfg.adapter_gossip_s > 0
+                                and now - hit[0] < self.cfg.adapter_gossip_s):
+            return hit[1]
+        try:
+            resident = frozenset(worker.list_adapters())
+        except Exception:  # noqa: BLE001 — replica mid-death; skip it
+            resident = frozenset()
+        with self._lock:
+            self._adapter_residency[worker.key] = (now, resident)
+        return resident
+
+    def _pick_decode(self, base: Dict[str, Any], deadline: float):
+        """Decode pick, adapter-aware: a request naming a LoRA adapter
+        prefers replicas gossiping it resident (pow2 among them); when
+        none do, the normal pick stands and the chosen replica pulls
+        the adapter lazily via adapter_ref."""
+        adapter_id = base.get("adapter_id")
+        if adapter_id:
+            with self._lock:
+                workers = list(self._workers["decode"])
+            elig = self.health.eligible([w.key for w in workers])
+            cand = [w for w in workers if w.key in elig] or workers
+            resident = [w for w in cand
+                        if adapter_id in self._adapter_residency_for(w)]
+            if resident:
+                return pick_resident(
+                    cand, resident,
+                    lambda w: w.load() + self.health.penalty(w.key))
+        return self._pick("decode", deadline)
+
     def _prefix_route(self, base: Dict[str, Any]):
         """Prefix-aware role routing: if some decode replica already
         holds the request's leading prompt pages warm (per its gossiped
@@ -1055,8 +1219,9 @@ class DisaggCoordinator:
         return None
 
     def _base_request(self, prompt, max_tokens, temperature, top_p, top_k,
-                      stop, request_id, timeout_s) -> Dict[str, Any]:
-        return {
+                      stop, request_id, timeout_s, adapter_id=None,
+                      adapter_ref=None) -> Dict[str, Any]:
+        base = {
             "prompt_ids": list(prompt),
             "max_tokens": int(max_tokens),
             "temperature": float(temperature),
@@ -1073,6 +1238,10 @@ class DisaggCoordinator:
             # None when untraced: replicas skip all span work on that path
             "trace_ctx": tracing.current_context(),
         }
+        if adapter_id:
+            base["adapter_id"] = str(adapter_id)
+            base["adapter_ref"] = adapter_ref
+        return base
 
     def _run_prefill(self, base: Dict[str, Any], deadline: float,
                      dworker) -> Dict[str, Any]:
@@ -1178,10 +1347,13 @@ class DisaggCoordinator:
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, stop: Optional[List[List[int]]] = None,
                  request_id: Optional[str] = None,
-                 timeout_s: float = 600.0) -> Dict[str, Any]:
+                 timeout_s: float = 600.0,
+                 adapter_id: Optional[str] = None,
+                 adapter_ref: Any = None) -> Dict[str, Any]:
         with tracing.span_if_traced("disagg.admit", {"kind": "generate"}):
             base = self._base_request(prompt, max_tokens, temperature, top_p,
-                                      top_k, stop, request_id, timeout_s)
+                                      top_k, stop, request_id, timeout_s,
+                                      adapter_id, adapter_ref)
             t0 = time.monotonic()
             deadline = t0 + timeout_s
             routed = self._prefix_route(base)
@@ -1201,7 +1373,7 @@ class DisaggCoordinator:
                         "kv_transport": "skipped",
                         "prefix_warm_tokens": warm,
                     }
-                dworker = self._pick("decode", deadline)
+                dworker = self._pick_decode(base, deadline)
                 if self.cfg.kv_transfer == "stream":
                     dres, pres = self._generate_streamed(
                         base, deadline, dworker)
@@ -1232,69 +1404,175 @@ class DisaggCoordinator:
 
     # --------------------------------------------------------- streaming
 
+    def _open_raw(self, base: Dict[str, Any], deadline: float):
+        """Open ONE decode-side token stream for `base` — prefix-routed,
+        streamed, or prefill-then-decode — and return (raw_gen, dworker).
+        This is the unit the live-resume loop re-enters: a continuation
+        request goes through exactly the same path selection (including
+        re-export on a prefill replica + re-import on the new decode
+        peer) as a fresh one."""
+        routed = self._prefix_route(base)
+        dworker = None
+        try:
+            if routed is not None:
+                dworker, warm = routed
+                self._live[base["request_id"]] = (dworker,)
+                with tracing.span_if_traced(
+                        "disagg.route",
+                        {"prefix_warm_tokens": warm,
+                         "replica": str(dworker.key)}):
+                    raw = dworker.generate_stream(base)
+            elif self.cfg.kv_transfer == "stream":
+                dworker = self._pick_decode(base, deadline)
+                kv_dest = self._kv_dest_for(dworker)
+                pt, pbox = self._spawn_prefill(
+                    base, deadline, dworker, kv_dest)
+                try:
+                    raw = dworker.decode_stream(
+                        {**base, "kv": {"kind": "stream"}})
+                except BaseException as e:
+                    pt.join(timeout=30.0)
+                    if "err" in pbox:
+                        raise pbox["err"] from e
+                    raise
+            else:
+                dworker = self._pick_decode(base, deadline)
+                pres = self._run_prefill(base, deadline, dworker)
+                raw = dworker.decode_stream({**base, "kv": pres["kv"]})
+        except BaseException:
+            if dworker is not None:
+                self.health.record_error(dworker.key)
+            self._live.pop(base["request_id"], None)
+            raise
+        return raw, dworker
+
+    def _resume_stream(self, base: Dict[str, Any], committed: List[int],
+                       deadline: float, dead_worker, attempt: int):
+        """Live request resume: mint the continuation request (original
+        prompt + committed tokens replayed as the new prompt, max_tokens
+        reduced by what the client already has) and open it through the
+        normal pipeline on a healthy peer — the continuation's first
+        output token is exactly the next token of the logical stream.
+        Token-identical continuation assumes deterministic sampling
+        (temperature 0): the new prefill recomputes KV for the replayed
+        tokens, so greedy decoding continues the identical sequence."""
+        rid = base["request_id"]
+        self.health.quarantine(dead_worker.key, reason="stream-died")
+        try:
+            dead_worker.cancel(self._resumed.get(rid, rid))
+        except Exception:  # noqa: BLE001 — replica likely already dead
+            pass
+        cont = dict(base)
+        cont["prompt_ids"] = (list(base["prompt_ids"])
+                              + [int(t) for t in committed])
+        cont["max_tokens"] = int(base["max_tokens"]) - len(committed)
+        cont["request_id"] = f"{rid}-r{attempt}"
+        raw, dworker = self._open_raw(cont, deadline)
+        with self._lock:
+            # client-facing identity stays the ORIGINAL request_id:
+            # cancel() follows _resumed to reach the live engine request
+            self._resumed[rid] = cont["request_id"]
+            workers = self._live.pop(cont["request_id"], None)
+            if workers is not None:
+                self._live[rid] = workers
+        return raw, dworker
+
     def open_stream(self, prompt: List[int], max_tokens: int = 32,
                     temperature: float = 0.0, top_p: float = 1.0,
                     top_k: int = 0, stop: Optional[List[List[int]]] = None,
                     request_id: Optional[str] = None,
-                    timeout_s: float = 600.0) -> DisaggStream:
+                    timeout_s: float = 600.0,
+                    adapter_id: Optional[str] = None,
+                    adapter_ref: Any = None) -> DisaggStream:
         """Run the prefill leg (TTFT is paid here — concurrently with
         the eager import under the stream transport, synchronously
         otherwise), then return a stream over the decode replica's
         tokens — the seeded first token arrives as the stream's first
-        item. A prefix-routed request skips the prefill leg entirely."""
+        item. A prefix-routed request skips the prefill leg entirely.
+
+        With live_resume on (the default), a replica dying MID-STREAM
+        quarantines it and re-opens the request's remaining tokens on a
+        healthy peer (up to resume_max_attempts deaths per stream): the
+        client sees a latency blip, never a failed request."""
         with tracing.span_if_traced("disagg.admit", {"kind": "stream"}):
             base = self._base_request(prompt, max_tokens, temperature, top_p,
-                                      top_k, stop, request_id, timeout_s)
+                                      top_k, stop, request_id, timeout_s,
+                                      adapter_id, adapter_ref)
             deadline = time.monotonic() + timeout_s
-            routed = self._prefix_route(base)
-            dworker = None
-            try:
-                if routed is not None:
-                    dworker, warm = routed
-                    self._live[base["request_id"]] = (dworker,)
-                    with tracing.span_if_traced(
-                            "disagg.route",
-                            {"prefix_warm_tokens": warm,
-                             "replica": str(dworker.key)}):
-                        raw = dworker.generate_stream(base)
-                elif self.cfg.kv_transfer == "stream":
-                    dworker = self._pick("decode", deadline)
-                    kv_dest = self._kv_dest_for(dworker)
-                    pt, pbox = self._spawn_prefill(
-                        base, deadline, dworker, kv_dest)
-                    try:
-                        raw = dworker.decode_stream(
-                            {**base, "kv": {"kind": "stream"}})
-                    except BaseException as e:
-                        pt.join(timeout=30.0)
-                        if "err" in pbox:
-                            raise pbox["err"] from e
-                        raise
-                else:
-                    dworker = self._pick("decode", deadline)
-                    pres = self._run_prefill(base, deadline, dworker)
-                    raw = dworker.decode_stream({**base, "kv": pres["kv"]})
-            except BaseException:
-                if dworker is not None:
-                    self.health.record_error(dworker.key)
-                self._live.pop(base["request_id"], None)
-                raise
+            raw, dworker = self._open_raw(base, deadline)
+        rid = base["request_id"]
 
         def finishing():
-            t0 = time.monotonic()
+            nonlocal raw, dworker
+            committed: List[int] = []
+            attempts = 0
+            _m_inflight.add(1, tags={"role": "decode"})
             try:
-                yield from raw
-            except BaseException as e:
-                if not isinstance(e, GeneratorExit):
-                    self.health.record_error(dworker.key)
-                raise
-            else:
-                self.health.observe(dworker.key, time.monotonic() - t0,
+                while True:
+                    t0 = time.monotonic()
+                    try:
+                        for item in raw:
+                            if isinstance(item, dict):
+                                if item.get("error"):
+                                    # terminal error in the trailing
+                                    # summary: same resume treatment as
+                                    # a raised mid-stream death
+                                    raise _StreamDied(item["error"])
+                                self.health.observe(
+                                    dworker.key, time.monotonic() - t0,
                                     role="decode")
+                                yield item
+                                return
+                            committed.append(item)
+                            yield item
+                        return  # defensive: raw ended without a summary
+                    except GeneratorExit:
+                        raise
+                    except BaseException as e:
+                        self.health.record_error(dworker.key)
+                        attempts += 1
+                        if (not self.cfg.live_resume
+                                or attempts > self.cfg.resume_max_attempts
+                                or time.monotonic() > deadline):
+                            raise
+                        remaining = int(base["max_tokens"]) - len(committed)
+                        if remaining <= 0:
+                            # every token was already committed: the
+                            # stream is logically complete
+                            yield {"finish_reason": "length", "error": None,
+                                   "migration_s": 0.0, "migration_bytes": 0,
+                                   "kv_transport": "resumed"}
+                            return
+                        tr = time.monotonic()
+                        try:
+                            raw, dworker = self._resume_stream(
+                                base, committed, deadline, dworker, attempts)
+                        except BaseException:
+                            logger.warning("live resume of %s failed", rid,
+                                           exc_info=True)
+                            raise e  # surface the original death
+                        _m_resumes.inc()
+                        _m_resume_s.observe(time.monotonic() - tr)
+                        logger.info(
+                            "resumed %s on %s after %d committed tokens "
+                            "(attempt %d)", rid, dworker.key,
+                            len(committed), attempts)
             finally:
-                self._live.pop(base["request_id"], None)
+                _m_inflight.add(-1, tags={"role": "decode"})
+                # the normal exit leaves raw suspended just past its
+                # trailing summary yield — close it so the replica-side
+                # finallys (load accounting) run NOW, not at GC; fleet
+                # scale-down reads w.load() and a leaked count pins the
+                # replica "busy" forever
+                try:
+                    raw.close()
+                except Exception:  # noqa: BLE001 — replica already dead
+                    pass
+                with self._lock:
+                    self._live.pop(rid, None)
+                    self._resumed.pop(rid, None)
 
-        return DisaggStream(base["request_id"], finishing(), self)
+        return DisaggStream(rid, finishing(), self)
 
     def generate_stream(self, prompt: List[int], **kw):
         return self.open_stream(prompt, **kw).tokens()
@@ -1302,19 +1580,80 @@ class DisaggCoordinator:
     # ------------------------------------------------------------- admin
 
     def cancel(self, request_id: str) -> bool:
-        workers = self._live.get(request_id)
+        with self._lock:
+            # pop the routing state NOW: an abandoned/cancelled request
+            # must not linger in _live (and its queue-depth / inflight
+            # gauge contributions unwind via the pick/stream finallys)
+            workers = self._live.pop(request_id, None)
+            live_rid = self._resumed.pop(request_id, request_id)
         if workers is None:
             return False
         hit = False
         for w in workers:
-            try:
-                hit = w.cancel(request_id) or hit
-            except Exception:  # noqa: BLE001 — best-effort
-                pass
+            # a resumed request runs under its continuation id on the
+            # replica — cancel both identities, best-effort
+            for rid in {request_id, live_rid}:
+                try:
+                    hit = w.cancel(rid) or hit
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
         return hit
+
+    def workers(self, role: str) -> List[Any]:
+        """Current pick-set snapshot for a role (fleet actuation reads
+        this to address replicas directly, e.g. adapter distribution)."""
+        with self._lock:
+            return list(self._workers[role])
+
+    def add_worker(self, role: str, worker) -> None:
+        """Fleet actuation (in-process fleets): join a replica to the
+        role's pick set. Serve-mode coordinators scale through the
+        controller's set_target instead — _sync picks the change up."""
+        with self._lock:
+            self._workers[role].append(worker)
+
+    def remove_worker(self, role: str, key=None):
+        """Fleet actuation: remove one replica from the role's pick set
+        GRACEFULLY — it stops receiving new requests now, but a busy
+        replica parks in the draining set (caches intact) until its
+        in-flight streams finish or drain_grace_s expires. key=None
+        removes the least-loaded replica. Returns the removed worker
+        (None when the role is empty / key unknown)."""
+        now = time.monotonic()
+        with self._lock:
+            ws = self._workers[role]
+            if key is None:
+                idx = min(range(len(ws)), key=lambda i: ws[i].load()) \
+                    if ws else None
+            else:
+                idx = next((i for i, w in enumerate(ws) if w.key == key),
+                           None)
+            if idx is None:
+                return None
+            w = ws.pop(idx)
+            try:
+                busy = w.load() > 0
+            except Exception:  # noqa: BLE001 — treat as idle
+                busy = False
+            if busy and self.cfg.drain_grace_s > 0:
+                self._draining.setdefault(
+                    w.key, (now + self.cfg.drain_grace_s, w))
+            else:
+                self._drop_worker_state(w.key)
+            # in-process fleets have no _sync heartbeat, so removals are
+            # also the drain sweep's tick
+            self._sweep_draining(now)
+            return w
+
+    def adapter_residency(self) -> Dict[str, List[str]]:
+        """Gossiped LoRA residency: replica key -> sorted adapter ids."""
+        with self._lock:
+            return {str(k): sorted(res)
+                    for k, (_ts, res) in self._adapter_residency.items()}
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            self._sweep_draining(time.monotonic())
             return {
                 "prefill_replicas": len(self._workers["prefill"]),
                 "decode_replicas": len(self._workers["decode"]),
@@ -1327,6 +1666,8 @@ class DisaggCoordinator:
                 "kv_migrations": sum(
                     _m_migration_s.count(tags={"transport": t})
                     for t in ("object", "channel", "stream")),
+                "draining": sorted(str(k) for k in self._draining),
+                "resumes": int(_m_resumes.get()),
             }
 
     def close(self) -> None:

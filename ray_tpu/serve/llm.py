@@ -7,6 +7,7 @@ requests over replicas, the engine continuously batches within a replica.
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -59,6 +60,15 @@ class LLMServer:
         self.role = role
         self._kv_inbox = None  # decode role: created on first kv_ingest
         self._kv_inbox_lock = threading.Lock()
+        # multi-model LoRA hot-swap: resident adapter weights, small LRU
+        # (move-to-end on touch, evict-oldest past capacity); the fleet
+        # distributes adapters over the broadcast relay tree and requests
+        # naming a non-resident adapter pull it lazily via adapter_ref
+        self._adapters: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._adapter_capacity = 8
+        self._adapter_lock = threading.Lock()
+        self._adapter_hits: Dict[str, int] = {}
         if params_fn is not None:
             params, cfg = params_fn()
         else:
@@ -137,22 +147,75 @@ class LLMServer:
     def decode_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         from .disagg import replica_decode
 
+        self._ensure_adapter(request)
         return replica_decode(self.engine, request, self._kv_inbox)
 
     def decode_stream(self, request: Dict[str, Any]):
         from .disagg import replica_decode_stream
 
+        self._ensure_adapter(request)
         return replica_decode_stream(self.engine, request, self._kv_inbox)
 
     def generate_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         from .disagg import replica_generate
 
+        self._ensure_adapter(request)
         return replica_generate(self.engine, request)
 
     def generate_stream(self, request: Dict[str, Any]):
         from .disagg import replica_generate_stream
 
+        self._ensure_adapter(request)
         return replica_generate_stream(self.engine, request)
+
+    # --------------------------------------------------- LoRA hot-swap
+
+    def load_adapter(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Pin a LoRA adapter resident: {"adapter_id", "weights"|"ref"}.
+        An ObjectRef resolves through the object plane's pull-through
+        GET — host-local when the broadcast relay tree pre-seeded it."""
+        from .. import api
+
+        adapter_id = str(request["adapter_id"])
+        weights = request.get("weights")
+        if weights is None and request.get("ref") is not None:
+            weights = api.get(request["ref"],
+                              timeout=float(request.get("timeout_s", 60.0)))
+        with self._adapter_lock:
+            self._adapters[adapter_id] = weights
+            self._adapters.move_to_end(adapter_id)
+            evicted = []
+            while len(self._adapters) > self._adapter_capacity:
+                old, _w = self._adapters.popitem(last=False)
+                self._adapter_hits.pop(old, None)
+                evicted.append(old)
+        return {"adapter_id": adapter_id, "resident": True,
+                "evicted": evicted}
+
+    def list_adapters(self, _request: Any = None) -> List[str]:
+        with self._adapter_lock:
+            return sorted(self._adapters)
+
+    def _ensure_adapter(self, request: Dict[str, Any]) -> None:
+        adapter_id = request.get("adapter_id")
+        if not adapter_id:
+            return
+        with self._adapter_lock:
+            if adapter_id in self._adapters:
+                self._adapters.move_to_end(adapter_id)
+                self._adapter_hits[adapter_id] = \
+                    self._adapter_hits.get(adapter_id, 0) + 1
+                return
+        if request.get("adapter_ref") is None:
+            raise ValueError(
+                f"adapter {adapter_id!r} not resident and the request "
+                f"carries no adapter_ref to pull it from")
+        self.load_adapter({"adapter_id": adapter_id,
+                           "ref": request["adapter_ref"],
+                           "timeout_s": request.get("timeout_s", 60.0)})
+        with self._adapter_lock:
+            self._adapter_hits[adapter_id] = \
+                self._adapter_hits.get(adapter_id, 0) + 1
 
     def prefix_digest(self, _request: Any = None) -> Dict[str, Any]:
         """Compact prefix-cache fingerprint for the coordinator's
@@ -184,6 +247,9 @@ class LLMServer:
     def stats(self, _request: Any = None) -> Dict[str, Any]:
         out = self.engine.stats()
         out["role"] = self.role
+        with self._adapter_lock:
+            out["adapters"] = sorted(self._adapters)
+            out["adapter_requests"] = dict(self._adapter_hits)
         return out
 
     def check_health(self) -> None:

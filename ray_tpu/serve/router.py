@@ -28,6 +28,17 @@ def pow2_choice(n: int, load_fn: Callable[[int], int]) -> int:
     return a if load_fn(a) <= load_fn(b) else b
 
 
+def pick_resident(candidates: List[Any], resident: List[Any],
+                  load_fn: Callable[[Any], int]) -> Any:
+    """Residency-preferring pick shared by multiplexed routing shapes
+    (Pow2Router model affinity, disagg adapter routing): pow-2 among the
+    candidates that already hold the artifact when any do, pow-2 over
+    the full set otherwise — so residency wins without ever starving
+    the request when nothing is warm."""
+    pool = [c for c in candidates if c in resident] or list(candidates)
+    return pool[pow2_choice(len(pool), lambda i: load_fn(pool[i]))]
+
+
 def _replica_key(replica: Any) -> Any:
     """Stable identity for a replica across update_replicas calls.
     ActorHandles are re-created per controller sync, so object identity

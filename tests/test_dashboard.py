@@ -195,7 +195,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 7  # core, data, serve, disagg, health, profiling, objects
+        assert len(jsons) == 8  # core, data, serve, disagg, health, profiling, objects, fleet
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p
