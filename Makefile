@@ -31,7 +31,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
 	tests/test_serve_cross_host.py tests/test_disagg.py \
-	tests/test_fleet.py tests/test_dashboard.py \
+	tests/test_fleet.py tests/test_rl_online.py tests/test_dashboard.py \
 	tests/test_integrations.py tests/test_platform.py \
 	tests/test_microbenchmark.py tests/test_pipeline_trainer.py
 
@@ -40,9 +40,10 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	broadcast fleet tsan shm lint \
+	broadcast fleet rl tsan shm lint \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
-	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet
+	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet \
+	bench-rl
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -103,6 +104,14 @@ bench-sanitize:
 # with p95 TTFT within 2x steady-state, merged into BENCH_SUMMARY.json
 bench-fleet:
 	env RAY_TPU_BENCH_SUITE=fleet python bench.py
+
+# online RL loop gate: multi-iteration rollout->reward->train->sync on
+# the serve fleet — reward must improve (rl_reward_delta), no-drain
+# weight re-sync must cost <5%% of loop wall (rl_sync_stall_pct) and hold
+# unrelated serve p95 TTFT within 1.2x (rl_serve_p95_ttft_ratio), merged
+# into BENCH_SUMMARY.json
+bench-rl:
+	env RAY_TPU_BENCH_SUITE=rl python bench.py
 
 # cluster health at a glance (alerts, SLO digests, node liveness) from
 # the in-process health plane; DASH=host:port reads a running head
@@ -183,6 +192,13 @@ broadcast:
 fleet:
 	@echo "== fleet tier =="
 	$(PYTEST) -m fleet tests/
+
+# online-RL tier (fleet rollouts with logprobs, staleness bounds,
+# no-drain weight re-sync, loop stop hygiene) for iterating on rl/online
+# work; the fast subset also runs inside check via LIB_TESTS
+rl:
+	@echo "== online RL tier =="
+	$(PYTEST) -m rl tests/
 
 check-all: check check-slow
 

@@ -100,7 +100,7 @@ def _write_summary() -> None:
     doc = {
         "meta": {
             "suite": os.environ.get(
-                "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo"),
+                "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo,rl"),
             "model": os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m"),
             "backend": jax.default_backend(),
             "spec_bench": os.environ.get("RAY_TPU_BENCH_SPEC", "0"),
@@ -1746,9 +1746,186 @@ def bench_fleet(model: str) -> None:
           "fleet_resume_anchor", lower_is_better=True)
 
 
+def bench_rl() -> None:
+    """Online RL post-training gate (rl/online.py): the serve fleet IS
+    the rollout fleet. Three acceptance rows:
+
+      * rl_reward_delta — mean reward over the last 3 loop iterations
+        minus the first 3 on a deterministic token-preference reward:
+        the rollout→reward→train→sync loop must actually LEARN
+        (positive delta).
+      * rl_sync_stall_pct — mean rl-ledger sync-stall fraction across
+        iterations, as %: the no-drain weight re-sync must cost < 5%
+        of loop wall time.
+      * rl_serve_p95_ttft_ratio — p95 TTFT of an unrelated serve burst
+        WHILE a background trainer re-syncs weights into the same fleet,
+        over the steady-state p95 (alternating arms, same fleet): the
+        live in-place swap must hold it <= 1.2x.
+
+    Model pinned to tiny-llama: the gate is the loop's mechanics
+    (learning signal, stall share, swap latency) — model-scale rollout
+    throughput is the grpo suite's row."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.rl.grpo import GRPOConfig
+    from ray_tpu.rl.online import OnlineRLConfig, OnlineRLLoop
+    from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+    from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+    from ray_tpu.serve.fleet import FleetController
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+    cfg = get_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def make_engine():
+        ecfg = EngineConfig(max_batch_size=8, page_size=8, max_pages=128,
+                            max_seq_len=96, prefill_buckets=(16, 32),
+                            busy_span=4)
+        e = InferenceEngine(params, cfg, ecfg)
+        e.warmup(buckets=[16, 32])
+        return e
+
+    engines = [make_engine() for _ in range(3)]
+    pe, d0e, d1e = engines
+    co = DisaggCoordinator(
+        [EngineWorker(pe, "prefill0")],
+        [EngineWorker(d0e, "decode0"), EngineWorker(d1e, "decode1")],
+        {"small_blob_bytes": 0})
+    fleet = FleetController(co)
+    half = cfg.vocab_size // 2
+
+    def reward(prompt_ids, completion_ids) -> float:
+        # deterministic preference: fraction of sampled tokens in the
+        # lower vocab half — trainable signal, no model judge needed
+        return float(np.mean([t < half for t in completion_ids])) \
+            if completion_ids else 0.0
+
+    iters = int(os.environ.get("RAY_TPU_BENCH_RL_ITERS", "20"))
+    loop = OnlineRLLoop(
+        params, cfg, reward, fleet, prompts=[[1, 2, 3]],
+        config_=OnlineRLConfig(
+            grpo=GRPOConfig(group_size=16, max_new_tokens=16,
+                            temperature=1.0, lr=5e-3, kl_coef=0.0),
+            rollout_concurrency=8))
+    t0 = time.perf_counter()
+    history = loop.run(iters)
+    loop_wall = time.perf_counter() - t0
+    loop.stop()
+
+    rewards = [m["reward_mean"] for m in history
+               if "reward_mean" in m and not np.isnan(m["reward_mean"])]
+    stalls = [m["ledger_sync_stall_fraction"] for m in history
+              if "ledger_sync_stall_fraction" in m]
+    if len(rewards) < 10:
+        raise RuntimeError(
+            f"rl bench: only {len(rewards)}/{iters} iterations produced "
+            "a usable reward — delta would be meaningless")
+    # 5-iteration windows: sampling is deliberately unseeded (the engine
+    # draws a fresh base key per process), so single-iteration endpoints
+    # are too noisy to gate on
+    reward_delta = float(np.mean(rewards[-5:]) - np.mean(rewards[:5]))
+    stall_pct = 100.0 * float(np.mean(stalls)) if stalls else 0.0
+
+    # TTFT arms on the SAME fleet the loop just trained: alternating
+    # steady/sync-churn bursts so clock drift cancels. The churn arm
+    # re-syncs full weight sets at 10 Hz — several times denser than the
+    # loop's real once-per-iteration cadence (measured ~0.6s/iter here),
+    # but paced: zero-gap syncs just measure CPU starvation on the
+    # 1-core bench box, not the live-swap stall the gate is about.
+    rng = np.random.default_rng(23)
+
+    def burst(n_req=8, max_tokens=16):
+        ttfts: list = [None] * n_req
+        errs: list = [None] * n_req
+        prompts = [list(rng.integers(1, cfg.vocab_size, 8))
+                   for _ in range(n_req)]
+
+        def worker(i):
+            t0 = time.perf_counter()
+            try:
+                ds = co.open_stream(prompts[i], max_tokens=max_tokens)
+                for _tok in ds.tokens():
+                    if ttfts[i] is None:
+                        ttfts[i] = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — counted after join
+                errs[i] = e
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if any(errs):
+            raise RuntimeError(f"rl ttft burst failed: "
+                               f"{[e for e in errs if e][0]!r}")
+        return [t for t in ttfts if t is not None]
+
+    def p95(xs):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+    def median(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    burst()  # warm the burst shape before either timed arm
+    steady_p95s: list = []
+    churn_p95s: list = []
+    syncs = [0]
+    for _round in range(5):
+        steady_p95s.append(p95(burst()))
+        stop_evt = threading.Event()
+
+        def churner():
+            v = 10_000 + syncs[0]
+            while not stop_evt.is_set():
+                fleet.sync_weights(weights=loop.grpo.params, version=v)
+                v += 1
+                syncs[0] += 1
+                stop_evt.wait(0.1)
+
+        ct = threading.Thread(target=churner, daemon=True)
+        ct.start()
+        try:
+            churn_p95s.append(p95(burst()))
+        finally:
+            stop_evt.set()
+            ct.join(timeout=30.0)
+    if syncs[0] == 0:
+        raise RuntimeError("rl bench: churn arm completed zero weight "
+                           "syncs — the ratio would be meaningless")
+
+    # per-round p95, median across rounds (the disagg suite's recipe):
+    # one slow outlier round must not own the gate on a shared CPU box
+    steady_p95 = float(median(steady_p95s))
+    churn_p95 = float(median(churn_p95s))
+    ttft_ratio = churn_p95 / max(steady_p95, 1e-9)
+    for e in engines:
+        e.stop()
+    print(
+        f"# rl: iters={len(history)} wall={loop_wall:.1f}s "
+        f"rewards={rewards[0]:.3f}->{rewards[-1]:.3f} "
+        f"stall={stall_pct:.2f}% syncs={syncs[0]} "
+        f"ttft p95 steady={steady_p95 * 1e3:.1f}ms "
+        f"churn={churn_p95 * 1e3:.1f}ms",
+        file=sys.stderr,
+    )
+    _emit("rl_reward_delta", reward_delta, "reward", "rl_reward_anchor")
+    _emit("rl_sync_stall_pct", stall_pct, "%", "rl_stall_anchor",
+          lower_is_better=True)
+    _emit("rl_serve_p95_ttft_ratio", ttft_ratio, "ratio",
+          "rl_ttft_ratio_anchor", lower_is_better=True)
+
+
 def main() -> None:
     suite = os.environ.get(
-        "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo")
+        "RAY_TPU_BENCH_SUITE", "train,train2b,pipeline,serve,data,images,moe,grpo,rl")
     wanted = {s.strip() for s in suite.split(",") if s.strip()}
     model = os.environ.get("RAY_TPU_BENCH_MODEL", "llama-600m")
     # Ordering is deliberate: serve FIRST — its p50-TTFT criterion is
@@ -1791,6 +1968,11 @@ def main() -> None:
         # moe suites leave behind (measured 10x: 15 -> 1.4 samples/s when
         # run last). Latency-sensitive gates run before throughput gates.
         bench_grpo()
+    if "rl" in wanted:
+        # online RL loop gate: learning signal + sync-stall share +
+        # live-swap TTFT ratio. The TTFT arms are latency-sensitive,
+        # so it stays in the early block with serve/fleet/grpo.
+        bench_rl()
     if "data" in wanted:
         bench_data()
     if "object" in wanted:

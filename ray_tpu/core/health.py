@@ -148,6 +148,13 @@ def default_rules() -> List[Rule]:
              "delta(data_stage_stall_seconds) > 1.0 for 2",
              group_by=("stage",)),
     ]
+    stall_pct = float(config.get("rl_sync_stall_max_pct"))
+    if stall_pct > 0:
+        # the <5% sync-stall claim as an alert: rl/online.py publishes
+        # the measured weight_sync share of each loop iteration
+        rules.append(Rule(
+            "rl_sync_stall",
+            f"rl_sync_stall_fraction > {stall_pct / 100.0} for 2"))
     slo_ttft_ms = float(config.get("slo_ttft_ms"))
     if slo_ttft_ms > 0:
         rules.insert(0, Rule(

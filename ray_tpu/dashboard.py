@@ -213,9 +213,33 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "demand {{role}}",
         "refId": "B",
     })
+    rl = _dashboard("raytpu-rl", "ray_tpu / online RL", [
+        _panel("Reward curve", "rl_reward_mean", 0, 0, legend="reward"),
+        _panel("Rollout throughput (tok/s)",
+               "rate(rl_rollout_tokens[5m])", 1, 0, legend="tokens/s"),
+        _panel("Weight-version skew", "rl_weights_version_skew", 2, 8,
+               legend="fleet skew"),
+        _panel("Sync stall fraction", "rl_sync_stall_fraction", 3, 8,
+               unit="percentunit", legend="weight_sync / wall"),
+        _panel("Loop phase time (rate)", "rate(rl_phase_seconds[5m])",
+               4, 16, unit="s", legend="{{phase}}"),
+        _panel("Stale / dropped trajectories (rate)",
+               "rate(rl_stale_trajectories[5m])", 5, 16,
+               legend="stale {{policy}}"),
+        _panel("Replica weights version", "serve_weights_version", 6, 24,
+               legend="{{role}}"),
+        _panel("Trajectories in flight", "rl_trajectories_inflight",
+               7, 24, legend="inflight"),
+    ])
+    # dropped overlaid on the stale panel: one funnel, one glance
+    rl["panels"][5]["targets"].append({
+        "expr": "rate(rl_dropped_trajectories[5m])",
+        "legendFormat": "dropped {{reason}}",
+        "refId": "B",
+    })
     return {"core": core, "serve": serve, "data": data, "disagg": disagg,
             "health": health, "profiling": profiling, "objects": objects,
-            "fleet": fleet}
+            "fleet": fleet, "rl": rl}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:

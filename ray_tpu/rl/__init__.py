@@ -7,6 +7,7 @@ from .dqn import DQN, DQNConfig  # noqa: F401
 from .env import CartPole, Env, GymWrapper  # noqa: F401
 from .env_runner import EnvRunner, EnvRunnerGroup, VectorEnvRunner  # noqa: F401
 from .grpo import GRPO, GRPOConfig  # noqa: F401
+from .online import OnlineRLConfig, OnlineRLLoop, Trajectory  # noqa: F401
 from .impala import IMPALA, IMPALAConfig, vtrace_targets  # noqa: F401
 from .module import init_mlp_module, mlp_forward, mlp_forward_np  # noqa: F401
 from .multi_agent import (  # noqa: F401

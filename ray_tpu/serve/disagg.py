@@ -468,7 +468,8 @@ def _import_streamed(engine: InferenceEngine, request: Dict[str, Any],
                 mspan.attrs.update(bytes=total, frames=frames)
             with tracing.span_if_traced("disagg.kv_import"):
                 ta = time.monotonic()
-                engine.finish_kv_import(req, first)
+                engine.finish_kv_import(
+                    req, first, first_logprob=frame.get("first_logprob"))
                 active += time.monotonic() - ta
     except BaseException as e:
         inbox.cancel(rid)
@@ -534,6 +535,8 @@ def replica_decode(engine: InferenceEngine, request: Dict[str, Any],
     return {
         "request_id": req.request_id,
         "token_ids": list(req.output),
+        "logprobs": list(req.output_logprobs),
+        "weights_version": req.weights_version,
         "finish_reason": req.finish_reason,
         "migration_s": req._migration_s,
         "migration_bytes": int(request["kv"].get("bytes", 0)),
@@ -574,6 +577,8 @@ def replica_decode_stream(engine: InferenceEngine, request: Dict[str, Any],
             yield {
                 "finish_reason": req.finish_reason,
                 "error": req.error,
+                "logprobs": list(req.output_logprobs),
+                "weights_version": req.weights_version,
                 "migration_s": req._migration_s,
                 "migration_bytes": int(request["kv"].get("bytes", 0)),
                 "kv_transport": request["kv"]["kind"],
@@ -637,6 +642,8 @@ def replica_generate_stream(engine: InferenceEngine,
             yield {
                 "finish_reason": req.finish_reason,
                 "error": err or req.error,
+                "logprobs": list(req.output_logprobs),
+                "weights_version": req.weights_version,
                 "migration_s": 0.0,
                 "migration_bytes": 0,
                 "kv_transport": "skipped",
@@ -710,6 +717,23 @@ class EngineWorker(_LoadTracker):
     def list_adapters(self) -> List[str]:
         with self._adapter_lock:
             return sorted(self._adapters)
+
+    def update_weights(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Live base-weight swap (no drain): {"weights"|"ref", "version"?}.
+        The fleet's sync_weights seeds the ref over the broadcast relay
+        tree first, so the GET here is usually host-local."""
+        weights = request.get("weights")
+        if weights is None and request.get("ref") is not None:
+            weights = api.get(request["ref"],
+                              timeout=float(request.get("timeout_s", 60.0)))
+        if weights is None:
+            raise ValueError("update_weights needs 'weights' or 'ref'")
+        v = self.engine.update_params(weights,
+                                      version=request.get("version"))
+        return {"weights_version": v}
+
+    def weights_version(self) -> int:
+        return self.engine.weights_version
 
     def _ensure_adapter(self, request: Dict[str, Any]) -> None:
         """Adapter-aware admission: a request naming a non-resident
@@ -833,6 +857,13 @@ class ReplicaWorker(_LoadTracker):
     def list_adapters(self) -> List[str]:
         return self._call("list_adapters", {}, 30.0)
 
+    def update_weights(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self._call("update_weights", request,
+                          float(request.get("timeout_s", 60.0)) + 30.0)
+
+    def weights_version(self) -> int:
+        return self._call("weights_version", {}, 30.0)
+
     def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
         self._begin()
         try:
@@ -913,8 +944,22 @@ class DisaggStream:
         self.error: Optional[str] = None
         self.migration_s: Optional[float] = None
         self.migration_bytes: Optional[int] = None
+        # per-token sampled logprobs + the generation (weights) version
+        # the tokens were sampled under — populated from the trailing
+        # summary once the stream is exhausted (a resumed stream carries
+        # None for tokens committed before the resume: the dead replica's
+        # logprobs died with it)
+        self.logprobs: Optional[List[Optional[float]]] = None
+        self.weights_version: Optional[int] = None
         self._raw = raw_gen
         self._co = coordinator
+
+    def logprob_at(self, i: int) -> Optional[float]:
+        """Logprob of the i-th streamed token, if known yet (summaries
+        arrive at stream end, so this is None while still streaming)."""
+        if self.logprobs is not None and 0 <= i < len(self.logprobs):
+            return self.logprobs[i]
+        return None
 
     def tokens(self):
         for item in self._raw:
@@ -923,6 +968,8 @@ class DisaggStream:
                 self.error = item.get("error")
                 self.migration_s = item.get("migration_s")
                 self.migration_bytes = item.get("migration_bytes")
+                self.logprobs = item.get("logprobs")
+                self.weights_version = item.get("weights_version")
                 break
             yield item
         # the summary break leaves the pipeline suspended at its final
@@ -967,6 +1014,10 @@ class DisaggCoordinator:
         # adapter_gossip_s): adapter-aware routing prefers replicas that
         # already hold the request's adapter
         self._adapter_residency: Dict[Any, Tuple[float, frozenset]] = {}
+        # gossiped weights generation per replica (same cadence as the
+        # adapter gossip): routers and the RL trainer read fleet skew
+        # from here without a per-request round trip
+        self._weights_gossip: Dict[Any, Tuple[float, Optional[int]]] = {}
         # graceful scale-down: replicas removed from membership but still
         # carrying in-flight streams park here (key -> (deadline, worker))
         # with their caches intact until drained or past drain_grace_s
@@ -1086,6 +1137,7 @@ class DisaggCoordinator:
         self._kv_dest_cache.pop(key, None)
         self._prefix_digests.pop(key, None)
         self._adapter_residency.pop(key, None)
+        self._weights_gossip.pop(key, None)
 
     # -------------------------------------------------------------- picks
 
@@ -1161,6 +1213,32 @@ class DisaggCoordinator:
         with self._lock:
             self._adapter_residency[worker.key] = (now, resident)
         return resident
+
+    def _weights_version_for(self, worker) -> Optional[int]:
+        """The replica's gossiped weights generation, refreshed at most
+        every adapter_gossip_s (0 = every call). A failed fetch gossips
+        None — unknown, not version zero."""
+        now = time.monotonic()
+        with self._lock:
+            hit = self._weights_gossip.get(worker.key)
+        if hit is not None and (self.cfg.adapter_gossip_s > 0
+                                and now - hit[0] < self.cfg.adapter_gossip_s):
+            return hit[1]
+        try:
+            version = int(worker.weights_version())
+        except Exception:  # noqa: BLE001 — replica mid-death; skip it
+            version = None
+        with self._lock:
+            self._weights_gossip[worker.key] = (now, version)
+        return version
+
+    def weights_versions(self) -> Dict[str, Optional[int]]:
+        """Fleet weight-generation skew map: replica key -> gossiped
+        weights_version (None = unknown/unreachable), both roles."""
+        with self._lock:
+            workers = (list(self._workers["prefill"])
+                       + list(self._workers["decode"]))
+        return {str(w.key): self._weights_version_for(w) for w in workers}
 
     def _pick_decode(self, base: Dict[str, Any], deadline: float):
         """Decode pick, adapter-aware: a request naming a LoRA adapter
@@ -1365,6 +1443,8 @@ class DisaggCoordinator:
                     return {
                         "request_id": base["request_id"],
                         "token_ids": dres["token_ids"],
+                        "logprobs": dres.get("logprobs"),
+                        "weights_version": dres.get("weights_version"),
                         "finish_reason": dres["finish_reason"],
                         "ttft_s": dres.get("ttft_s", 0.0),
                         "latency_s": time.monotonic() - t0,
@@ -1394,6 +1474,8 @@ class DisaggCoordinator:
         return {
             "request_id": base["request_id"],
             "token_ids": dres["token_ids"],
+            "logprobs": dres.get("logprobs"),
+            "weights_version": dres.get("weights_version"),
             "finish_reason": dres["finish_reason"],
             "ttft_s": pres["ttft_s"],
             "latency_s": time.monotonic() - t0,
@@ -1506,6 +1588,7 @@ class DisaggCoordinator:
             nonlocal raw, dworker
             committed: List[int] = []
             attempts = 0
+            prior = 0  # tokens committed before the CURRENT raw opened
             _m_inflight.add(1, tags={"role": "decode"})
             try:
                 while True:
@@ -1518,6 +1601,13 @@ class DisaggCoordinator:
                                     # summary: same resume treatment as
                                     # a raised mid-stream death
                                     raise _StreamDied(item["error"])
+                                if prior:
+                                    # resumed: the summary's logprobs
+                                    # cover only the continuation — pad
+                                    # for the dead replica's tokens
+                                    item["logprobs"] = (
+                                        [None] * prior
+                                        + list(item.get("logprobs") or []))
                                 self.health.observe(
                                     dworker.key, time.monotonic() - t0,
                                     role="decode")
@@ -1540,6 +1630,8 @@ class DisaggCoordinator:
                             # every token was already committed: the
                             # stream is logically complete
                             yield {"finish_reason": "length", "error": None,
+                                   "logprobs": [None] * len(committed),
+                                   "weights_version": None,
                                    "migration_s": 0.0, "migration_bytes": 0,
                                    "kv_transport": "resumed"}
                             return
@@ -1547,6 +1639,7 @@ class DisaggCoordinator:
                         try:
                             raw, dworker = self._resume_stream(
                                 base, committed, deadline, dworker, attempts)
+                            prior = len(committed)
                         except BaseException:
                             logger.warning("live resume of %s failed", rid,
                                            exc_info=True)

@@ -93,6 +93,10 @@ _m_step_phase = Histogram(
 _m_tokens_per_step = Gauge(
     "serve_tokens_per_decode_step",
     "Cumulative committed tokens per slot-step of decode participation.")
+_m_weights_version = Gauge(
+    "serve_weights_version",
+    "Monotonic generation stamp of the weights an engine is serving "
+    "(bumped by update_params live swaps), by role.")
 
 
 @dataclasses.dataclass
@@ -226,6 +230,19 @@ class Request:
     _held: List[int] = dataclasses.field(default_factory=list)
     # filled by the engine
     output: List[int] = dataclasses.field(default_factory=list)
+    # per-token logprob of each OUTPUT token under the raw model
+    # distribution (log_softmax of the unscaled logits — temperature/
+    # top-p/top-k shape what gets SAMPLED, not what gets REPORTED, which
+    # is what both the OpenAI `logprobs` field and RL importance ratios
+    # need). Aligned 1:1 with `output`, stripped in lockstep when eos or
+    # a stop suffix is removed. None entries mark tokens whose logits
+    # were unavailable (speculative commits, migration-seeded tokens
+    # from pre-logprob exports).
+    output_logprobs: List[Optional[float]] = dataclasses.field(
+        default_factory=list)
+    # generation stamp for online RL staleness accounting: the engine's
+    # weights_version when this request's first token was sampled
+    weights_version: Optional[int] = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     error: Optional[str] = None
     finish_reason: Optional[str] = None  # "stop" (eos) | "length"
@@ -463,6 +480,10 @@ class InferenceEngine:
         self.slots = [_Slot() for _ in range(B)]
         self.pending: "queue.Queue[Request]" = queue.Queue()
         self._step_count = 0
+        # monotonic generation stamp of the served weights; bumped by
+        # update_params (online RL weight re-sync) and stamped onto every
+        # request at first-token time
+        self.weights_version = 0
         # Fresh sampling stream per engine instance: a fixed base key would
         # replay identical temperature>0 outputs across restarts.
         self._base_key = jax.random.PRNGKey(
@@ -595,7 +616,13 @@ class InferenceEngine:
                 scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
                 sampled = jax.random.categorical(key, scaled, axis=-1)
                 toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            return toks, new_k, new_v
+            # logprob of the sampled token under the RAW distribution
+            # (negligible next to the lm_head matmul, so it is computed
+            # unconditionally rather than doubling the program cache)
+            logps = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1),
+                toks[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            return toks, logps, new_k, new_v
 
         def decode_span(params, k_pages, v_pages, tokens, positions,
                         page_tables, temps, top_ps, top_ks, key, n_steps,
@@ -603,16 +630,16 @@ class InferenceEngine:
             def sub(carry, i):
                 toks_in, pos, kp, vp = carry
                 ki = jax.random.fold_in(key, i)
-                toks, kp, vp = decode(
+                toks, lps, kp, vp = decode(
                     params, kp, vp, toks_in, pos, page_tables, temps, ki,
                     top_ps, top_ks, advanced,
                 )
-                return (toks, pos + 1, kp, vp), toks
+                return (toks, pos + 1, kp, vp), (toks, lps)
 
-            (_, _, kp, vp), seq = jax.lax.scan(
+            (_, _, kp, vp), (seq, logps) = jax.lax.scan(
                 sub, (tokens, positions, k_pages, v_pages), jnp.arange(n_steps)
             )
-            return seq, kp, vp  # seq [n_steps, B]
+            return seq, logps, kp, vp  # seq/logps [n_steps, B]
 
         cache: Dict[Any, Any] = {}
 
@@ -770,7 +797,7 @@ class InferenceEngine:
             # Both sampler modes compile: the first top-p/top-k request
             # must not jit inside the decode loop under live traffic.
             for advanced in (False, True):
-                seq, self.k_pages, self.v_pages = self._decode(
+                seq, _lps, self.k_pages, self.v_pages = self._decode(
                     span, advanced)(
                     self.params, self.k_pages, self.v_pages,
                     jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
@@ -840,6 +867,8 @@ class InferenceEngine:
             "v": v,
             "true_len": T,
             "first_token": int(req.output[-1]),
+            "first_logprob": (req.output_logprobs[-1]
+                              if req.output_logprobs else None),
             "layers": int(k.shape[0]),
             "kv_heads": int(k.shape[2]),
             "head_dim": int(k.shape[3]),
@@ -901,6 +930,8 @@ class InferenceEngine:
                 frame["last"] = True
                 frame["true_len"] = int(true_len)
                 frame["first_token"] = int(req.output[-1])
+                frame["first_logprob"] = (req.output_logprobs[-1]
+                                          if req.output_logprobs else None)
             req.kv_sink(frame)
             seq += 1
             off = end
@@ -1029,11 +1060,13 @@ class InferenceEngine:
         st["k"][:, s:s + t] = k
         st["v"][:, s:s + t] = v
 
-    def finish_kv_import(self, req: Request, first_token: int) -> Request:
+    def finish_kv_import(self, req: Request, first_token: int,
+                         first_logprob: Optional[float] = None) -> Request:
         """Finalize a streamed import: move the staged KV to device and
         publish the request to the decode batch, seeding the first token
         exactly as the prefill emitters do (it was sampled and
-        TTFT-observed on the prefill engine)."""
+        TTFT-observed on the prefill engine; its logprob rides the
+        export metadata — None for pre-logprob exports)."""
         st, req._kv_ingest = req._kv_ingest, None
         if req.cancelled.is_set():
             self._free_pages_and_revive(st["pages"])
@@ -1047,6 +1080,9 @@ class InferenceEngine:
         first = int(first_token)
         if not req.output:
             req.output.append(first)
+            req.output_logprobs.append(
+                float(first_logprob) if first_logprob is not None else None)
+            req.weights_version = self.weights_version
             eos = self.ecfg.eos_token_id
             if eos is not None and first == eos:
                 pass  # eos is control
@@ -1116,7 +1152,8 @@ class InferenceEngine:
         except Exception as e:  # noqa: BLE001 — fail just this request
             self.abort_kv_import(req, f"kv ingest failed: {e!r}")
             return req
-        return self.finish_kv_import(req, first)
+        return self.finish_kv_import(req, first,
+                                     first_logprob=blob.get("first_logprob"))
 
     # ------------------------------------------------------------- requests
 
@@ -1451,6 +1488,9 @@ class InferenceEngine:
                          req.top_p, req.top_k)
             for i, (req, _p, _T, _b, _cl) in enumerate(group)
         ]
+        first_lps = [_host_logprob(logits_host[i], firsts[i])
+                     for i in range(len(group))]
+        wv = self.weights_version  # generation stamp: sampled under these
         now = time.monotonic()
         streamed = [i for i, it in enumerate(group)
                     if it[0].prefill_only and it[0].kv_sink is not None]
@@ -1475,6 +1515,8 @@ class InferenceEngine:
                         now - req.submitted_at)
                 _m_tokens.inc()
                 req.output.append(int(first))
+                req.output_logprobs.append(first_lps[i])
+                req.weights_version = wv
                 if eos is not None and int(first) == eos:
                     pass  # eos is control
                 elif req.stop:
@@ -1623,7 +1665,8 @@ class InferenceEngine:
             return True
         with self._chunk_lock:
             self._chunk_queue.pop(0)
-        first = _sample_host(np.asarray(logits), req.temperature,
+        logits_host = np.asarray(logits)
+        first = _sample_host(logits_host, req.temperature,
                              req.top_p, req.top_k)
         now = time.monotonic()
         req.first_token_at = now
@@ -1632,6 +1675,8 @@ class InferenceEngine:
             self._slo_digest("serve_ttft_seconds").add(now - req.submitted_at)
         _m_tokens.inc()
         req.output.append(int(first))
+        req.output_logprobs.append(_host_logprob(logits_host, int(first)))
+        req.weights_version = self.weights_version
         eos = self.ecfg.eos_token_id
         if eos is not None and int(first) == eos:
             pass  # eos is control
@@ -1732,13 +1777,14 @@ class InferenceEngine:
         else:
             span = max(1, self.ecfg.decode_span)
         t0 = time.monotonic()
-        seq, self.k_pages, self.v_pages = self._decode(span, advanced)(
+        seq, logps, self.k_pages, self.v_pages = self._decode(span, advanced)(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks), key,
         )
         t1 = time.monotonic()
         seq = np.asarray(seq)  # [span, B] — one readback per span
+        logps = np.asarray(logps)  # [span, B]
         t2 = time.monotonic()
         n_participating = span * len(active)
         committed = 0
@@ -1750,6 +1796,7 @@ class InferenceEngine:
                 tok = int(seq[t, i])
                 if s.generated < s.request.max_tokens and not s.request.done.is_set():
                     s.request.output.append(tok)
+                    s.request.output_logprobs.append(float(logps[t, i]))
                     s.generated += 1
                     committed += 1
                     _m_tokens.inc()
@@ -1811,6 +1858,10 @@ class InferenceEngine:
                 if (s.generated < s.request.max_tokens
                         and not s.request.done.is_set()):
                     s.request.output.append(tok)
+                    # the verify program does not surface per-token
+                    # logits to the host; speculative commits carry no
+                    # logprob (callers needing them serve without spec)
+                    s.request.output_logprobs.append(None)
                     s.generated += 1
                     n_tokens += 1
                     _m_tokens.inc()
@@ -1877,10 +1928,15 @@ class InferenceEngine:
                   else "stop" if stopped else "length")
         if eos is not None and req.output and req.output[-1] == eos:
             req.output.pop()
+            if req.output_logprobs:
+                req.output_logprobs.pop()
         elif stop_len:
             # the stop sequence is control: strip it from the result AND
             # from the stream hold-back so it never reaches consumers
             del req.output[-stop_len:]
+            if req.output_logprobs:
+                del req.output_logprobs[-min(stop_len,
+                                             len(req.output_logprobs)):]
             if req._held:
                 del req._held[-min(stop_len, len(req._held)):]
         # free BEFORE signalling completion: a caller that returns from
@@ -1934,6 +1990,8 @@ class InferenceEngine:
         return {
             "request_id": req.request_id,
             "token_ids": list(req.output),
+            "logprobs": list(req.output_logprobs),
+            "weights_version": req.weights_version,
             "finish_reason": req.finish_reason,
             "ttft_s": (req.first_token_at or 0) - req.submitted_at,
             "latency_s": (req.finished_at or 0) - req.submitted_at,
@@ -1997,6 +2055,31 @@ class InferenceEngine:
         )
         return gen
 
+    def update_params(self, params, version: Optional[int] = None) -> int:
+        """Live weight swap without draining. Transfers the new tree to
+        device (re-sharded onto the engine mesh when there is one), waits
+        for the transfer, then atomically rebinds `self.params` — in-flight
+        dispatches keep the old tree (compiled programs do not donate the
+        params argument), and every step launched after the rebind serves
+        the new generation. Returns the new weights_version."""
+        if self.mesh is not None:
+            from ..models.transformer import param_axes
+            from ..parallel.sharding import tree_shardings
+
+            new = jax.device_put(
+                params, tree_shardings(param_axes(self.cfg), self.mesh))
+        else:
+            new = jax.tree_util.tree_map(jnp.asarray, params)
+        jax.block_until_ready(new)
+        with self._lock:
+            self.params = new
+            self.weights_version = (
+                int(version) if version is not None
+                else self.weights_version + 1)
+            v = self.weights_version
+        _m_weights_version.set(float(v), tags={"role": self.slo_role})
+        return v
+
     def stats(self) -> Dict[str, Any]:
         with self._ready_lock:
             ready = len(self._ready)
@@ -2016,6 +2099,7 @@ class InferenceEngine:
             "free_pages": free_pages + prefix.get("reusable_pages", 0),
             **prefix,
             "steps": self._step_count,
+            "weights_version": self.weights_version,
             "tokens_per_decode_step": (
                 self._tps_committed / self._tps_steps
                 if self._tps_steps else 0.0),
@@ -2128,6 +2212,15 @@ def _device_sample_topk_topp(logits, temps, top_ps, top_ks, key):
     choice = jax.random.categorical(key, masked, axis=-1)      # sorted index
     sampled = jnp.take_along_axis(order, choice[:, None], axis=-1)[:, 0]
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _host_logprob(logits: np.ndarray, tok: int) -> float:
+    """log P(tok) under the raw (temperature-free) softmax of `logits` —
+    the same quantity the decode program surfaces, so prefill-site and
+    decode-site logprobs are directly comparable in one trajectory."""
+    x = np.asarray(logits, np.float64)
+    m = float(x.max())
+    return float(x[tok] - m - np.log(np.exp(x - m).sum()))
 
 
 def _sample_host(logits: np.ndarray, temperature: float,

@@ -441,6 +441,41 @@ class FleetController:
                          tags={"adapter": str(adapter_id)})
         return out
 
+    def sync_weights(self, weights: Any = None, ref: Any = None,
+                     version: Optional[int] = None,
+                     roles: Tuple[str, ...] = ROLES,
+                     timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Live base-weight re-sync WITHOUT draining: seal the new tree
+        into the object plane, pre-seed every host over the api.broadcast
+        relay tree, then swap it in on each replica of the given roles
+        (engine.update_params — in-flight requests keep the old weights,
+        new dispatches serve the new generation). Per-replica failures
+        are reported, never raised: a replica that missed the swap keeps
+        serving the previous generation and its gossiped weights_version
+        shows the skew. This is the online-RL trainer→fleet edge."""
+        if ref is None:
+            ref = api.put(weights)
+        try:
+            # relay-tree pre-seed: replicas then resolve the ref from
+            # their own host's store instead of all pulling the driver
+            api.broadcast(ref, timeout=timeout_s)
+        except Exception:  # noqa: BLE001 — pre-seeding is best-effort
+            logger.debug("weights broadcast pre-seed failed", exc_info=True)
+        out: Dict[str, Any] = {"ref": ref, "version": version,
+                               "synced": [], "failed": []}
+        for role in roles:
+            for w in self.co.workers(role):
+                try:
+                    res = w.update_weights({"ref": ref, "version": version,
+                                            "timeout_s": timeout_s})
+                    out["synced"].append(
+                        {"replica": str(w.key),
+                         "weights_version": res.get("weights_version")})
+                except Exception as e:  # noqa: BLE001 — skew is visible
+                    out["failed"].append({"replica": str(w.key),
+                                          "error": repr(e)})
+        return out
+
     def _refresh_residency(self) -> None:
         counts: Dict[str, int] = {}
         try:

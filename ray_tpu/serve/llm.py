@@ -217,6 +217,28 @@ class LLMServer:
             self._adapter_hits[adapter_id] = \
                 self._adapter_hits.get(adapter_id, 0) + 1
 
+    # ---------------------------------------------- live weight re-sync
+
+    def update_weights(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Swap the engine's base weights live (no drain): {"weights"|
+        "ref", "version"?}. An ObjectRef resolves through the object
+        plane's pull-through GET — host-local when the broadcast relay
+        tree pre-seeded it (the fleet's sync_weights path)."""
+        from .. import api
+
+        weights = request.get("weights")
+        if weights is None and request.get("ref") is not None:
+            weights = api.get(request["ref"],
+                              timeout=float(request.get("timeout_s", 60.0)))
+        if weights is None:
+            raise ValueError("update_weights needs 'weights' or 'ref'")
+        v = self.engine.update_params(weights,
+                                      version=request.get("version"))
+        return {"weights_version": v, "role": self.role}
+
+    def weights_version(self, _request: Any = None) -> int:
+        return self.engine.weights_version
+
     def prefix_digest(self, _request: Any = None) -> Dict[str, Any]:
         """Compact prefix-cache fingerprint for the coordinator's
         prefix-aware role routing."""

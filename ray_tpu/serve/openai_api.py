@@ -203,10 +203,11 @@ class OpenAIServer:
         # X-Request-Id can be looked up at /api/v0/traces/<id>
         rid = (f"cmpl-{root.trace_id}" if root is not None
                else f"cmpl-{uuid.uuid4().hex[:24]}")
+        want_lp = bool(body.get("logprobs"))
         if body.get("stream"):
             return SSEStream(rid, self._stream_sse(
                 rid, "text_completion", ids, max_tokens, temperature, top_p,
-                stop, root=root,
+                stop, root=root, want_logprobs=want_lp,
             ))
         try:
             with tracing.activate(root):
@@ -216,15 +217,16 @@ class OpenAIServer:
             if root is not None:
                 root.finish()
         text = self.tokenizer.decode(out["token_ids"])
+        choice = {"index": 0, "text": text,
+                  "finish_reason": out["finish_reason"] or "length"}
+        if want_lp:
+            choice["logprobs"] = self._completion_logprobs(out)
         return {
             "id": rid,
             "object": "text_completion",
             "created": int(time.time()),
             "model": self.model_name,
-            "choices": [
-                {"index": 0, "text": text,
-                 "finish_reason": out["finish_reason"] or "length"}
-            ],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": len(ids),
                 "completion_tokens": len(out["token_ids"]),
@@ -242,10 +244,11 @@ class OpenAIServer:
         root = tracing.maybe_begin("request:chat_completions")
         rid = (f"chatcmpl-{root.trace_id}" if root is not None
                else f"chatcmpl-{uuid.uuid4().hex[:24]}")
+        want_lp = bool(body.get("logprobs"))
         if body.get("stream"):
             return SSEStream(rid, self._stream_sse(
                 rid, "chat.completion", ids, max_tokens, temperature, top_p,
-                stop, root=root))
+                stop, root=root, want_logprobs=want_lp))
         try:
             with tracing.activate(root):
                 out = self._generate(ids, max_tokens, temperature, top_p,
@@ -254,18 +257,22 @@ class OpenAIServer:
             if root is not None:
                 root.finish()
         text = self.tokenizer.decode(out["token_ids"])
+        choice = {
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": out["finish_reason"] or "length",
+        }
+        if want_lp:
+            lps = out.get("logprobs") or []
+            choice["logprobs"] = {"content": [
+                {"token": self.tokenizer.decode([t]), "logprob": lp}
+                for t, lp in zip(out["token_ids"], lps)]}
         return {
             "id": rid,
             "object": "chat.completion",
             "created": int(time.time()),
             "model": self.model_name,
-            "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": out["finish_reason"] or "length",
-                }
-            ],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": len(ids),
                 "completion_tokens": len(out["token_ids"]),
@@ -291,8 +298,26 @@ class OpenAIServer:
 
     # ------------------------------------------------------------ helpers
 
+    def _completion_logprobs(self, out: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI text-completion `logprobs` block from an engine result.
+        Sampled-token logprobs only (top_logprobs alternatives would need
+        a top-k readback the decode program doesn't do); entries are None
+        where the engine has no logprob (spec-decode commits, migration
+        seeds)."""
+        toks = [self.tokenizer.decode([t]) for t in out["token_ids"]]
+        offsets, pos = [], 0
+        for t in toks:
+            offsets.append(pos)
+            pos += len(t)
+        return {
+            "tokens": toks,
+            "token_logprobs": list(out.get("logprobs") or []),
+            "top_logprobs": None,
+            "text_offset": offsets,
+        }
+
     def _stream_sse(self, rid, obj, ids, max_tokens, temperature, top_p=1.0,
-                    stop=None, root=None):
+                    stop=None, root=None, want_logprobs=False):
         """Generator of OpenAI stream chunks; the HTTP proxy emits each as
         a server-sent event (in-process runtime: generators cross the
         handle live). `root` is the sampled request span — admission runs
@@ -314,6 +339,7 @@ class OpenAIServer:
                     )
                     stream = ds.tokens()
                     finish, cancel = (lambda: ds.finish_reason), ds.cancel
+                    lp_at = getattr(ds, "logprob_at", lambda i: None)
                 else:
                     req, stream = engine.open_stream(
                         ids, max_tokens=max_tokens, temperature=temperature,
@@ -321,8 +347,14 @@ class OpenAIServer:
                     )
                     finish = lambda: req.finish_reason  # noqa: E731
                     cancel = lambda: engine.cancel(req.request_id)  # noqa: E731
+                    # commit appends the logprob before the token is
+                    # emitted, so by the time chunk i is yielded the
+                    # engine-path logprob for it is already in place
+                    lp_at = lambda i: (  # noqa: E731
+                        req.output_logprobs[i]
+                        if i < len(req.output_logprobs) else None)
             try:
-                yield from body(stream, finish)
+                yield from body(stream, finish, lp_at)
             finally:
                 # consumer gone (GeneratorExit on client disconnect) or
                 # exhausted — cancel is a no-op on a finished request, and
@@ -332,14 +364,21 @@ class OpenAIServer:
                 if root is not None:
                     root.finish()
 
-        def body(stream, finish):
+        def body(stream, finish, lp_at):
             created = int(time.time())
-            for tok in stream:
+            for i, tok in enumerate(stream):
                 piece = tokenizer.decode([tok])
                 if obj == "chat.completion":
                     delta = {"delta": {"content": piece}, "index": 0}
+                    if want_logprobs:
+                        delta["logprobs"] = {"content": [
+                            {"token": piece, "logprob": lp_at(i)}]}
                 else:
                     delta = {"text": piece, "index": 0}
+                    if want_logprobs:
+                        delta["logprobs"] = {
+                            "tokens": [piece],
+                            "token_logprobs": [lp_at(i)]}
                 yield {
                     "id": rid,
                     "object": obj + ".chunk",

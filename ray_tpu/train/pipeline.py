@@ -826,6 +826,9 @@ class PipelineTrainer:
         data_fn: Optional[Callable[[int], Dict[str, np.ndarray]]] = None,
         seed: int = 0,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        weights_hook: Optional[Callable[[int, Callable[[], List[Dict[
+            str, np.ndarray]]]], None]] = None,
+        weights_hook_every: int = 0,
     ):
         self.module = module
         self.pipeline = pipeline or PipelineConfig(
@@ -844,6 +847,13 @@ class PipelineTrainer:
         self.data_fn = data_fn
         self.seed = seed
         self.resume_checkpoint = resume_from_checkpoint
+        # online-RL / serving edge: called every weights_hook_every
+        # optimizer steps as weights_hook(step, gather) — `gather` pulls
+        # the per-stage params (dp rank 0) from the gang ONLY when
+        # called, so the hook decides whether to pay the export before
+        # broadcasting them to a serve fleet (fleet.sync_weights)
+        self.weights_hook = weights_hook
+        self.weights_hook_every = int(weights_hook_every)
         # chaos/test observability: live worker pids + gang restart count
         self.worker_pids: Dict[Tuple[int, int], int] = {}
         self.restarts = 0
@@ -996,6 +1006,20 @@ class PipelineTrainer:
                 step=step, grad_norm=gnorm, bubble_fraction=bubble,
                 step_seconds=wall)
             history.append(metrics)
+
+            if (self.weights_hook is not None and self.weights_hook_every
+                    and (step + 1) % self.weights_hook_every == 0):
+                def _gather(_gang=gang, _S=S):
+                    states = api.get(
+                        [_gang.workers[(si, 0)].get_params.remote()
+                         for si in range(_S)],
+                        timeout=pcfg.step_timeout_s)
+                    return list(states)
+                try:
+                    self.weights_hook(step, _gather)
+                except Exception:  # noqa: BLE001 — serving-side hook
+                    logger.warning("weights_hook failed at step %d", step,
+                                   exc_info=True)
 
             every = pcfg.checkpoint_every
             if every and (step + 1) % every == 0:

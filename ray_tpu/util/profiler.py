@@ -61,6 +61,7 @@ __all__ = [
     "profile_path_for", "device_memory_snapshot", "update_resource_gauges",
     "goodput_ledger", "ledger_from_samples", "install_auto_dump",
     "start_profile", "fetch_profile", "LEDGER_COMPONENTS",
+    "rl_ledger", "rl_ledger_from_samples", "RL_COMPONENTS",
 ]
 
 declare(
@@ -581,6 +582,39 @@ def goodput_ledger(wall_s: float, data_stall_s: float = 0.0,
     return ledger
 
 
+RL_COMPONENTS = ("rollout", "reward", "train", "weight_sync")
+
+
+def rl_ledger(wall_s: float, rollout_s: float = 0.0, reward_s: float = 0.0,
+              train_s: float = 0.0,
+              weight_sync_s: float = 0.0) -> Dict[str, float]:
+    """Online-RL decomposition of one loop iteration's wall time into
+    the RL_COMPONENTS (+ 'other' — coordination the four phases don't
+    cover), an exact partition like goodput_ledger: the <5% sync-stall
+    claim reads sync_stall_fraction straight off this, measured, not
+    asserted. Phases timed on concurrent threads can over-count; they
+    are scaled down proportionally (overcommit reported) so the ledger
+    stays a partition."""
+    wall_s = max(float(wall_s), 0.0)
+    parts = {
+        "rollout": max(float(rollout_s), 0.0),
+        "reward": max(float(reward_s), 0.0),
+        "train": max(float(train_s), 0.0),
+        "weight_sync": max(float(weight_sync_s), 0.0),
+    }
+    spent = sum(parts.values())
+    overcommit = max(0.0, spent - wall_s)
+    if overcommit > 0.0 and spent > 0.0:
+        scale = wall_s / spent
+        parts = {k: v * scale for k, v in parts.items()}
+        spent = wall_s
+    return {"wall_seconds": wall_s, **parts,
+            "other": wall_s - spent,
+            "overcommit_seconds": overcommit,
+            "sync_stall_fraction": (parts["weight_sync"] / wall_s
+                                    if wall_s > 0 else 0.0)}
+
+
 def _family_sums(families: List[Dict[str, Any]]) -> Dict[str, float]:
     """Fold a metrics snapshot (registry.snapshot() families, possibly
     merged across nodes) into {family_name: summed value}; histograms
@@ -592,6 +626,32 @@ def _family_sums(families: List[Dict[str, Any]]) -> Dict[str, float]:
             if sname == name or sname == f"{name}_sum":
                 out[name] = out.get(name, 0.0) + float(value)
     return out
+
+
+def rl_ledger_from_samples(families: List[Dict[str, Any]],
+                           wall_s: Optional[float] = None
+                           ) -> Dict[str, float]:
+    """Build the rl ledger from the rl_phase_seconds{phase=...} family
+    rl/online.py exports. Wall defaults to the phases' sum (the loop is
+    sequential per iteration); pass the measured wall for a loop that
+    overlaps rollout with training."""
+    phase: Dict[str, float] = {}
+    for fam in families or []:
+        if fam.get("name") != "rl_phase_seconds":
+            continue
+        for sname, tags, value in fam.get("samples", []):
+            if sname in ("rl_phase_seconds", "rl_phase_seconds_sum"):
+                p = dict(tags or {}).get("phase", "")
+                phase[p] = phase.get(p, 0.0) + float(value)
+    if wall_s is None:
+        wall_s = sum(phase.get(p, 0.0) for p in RL_COMPONENTS)
+    return rl_ledger(
+        wall_s,
+        rollout_s=phase.get("rollout", 0.0),
+        reward_s=phase.get("reward", 0.0),
+        train_s=phase.get("train", 0.0),
+        weight_sync_s=phase.get("weight_sync", 0.0),
+    )
 
 
 def _family_max(families: List[Dict[str, Any]], name: str) -> float:

@@ -177,6 +177,7 @@ class TestGrafana:
         import ray_tpu.core.object_transfer  # noqa: F401 — registers metrics
         import ray_tpu.data.executor  # noqa: F401 — registers data metrics
         import ray_tpu.serve.disagg  # noqa: F401 — registers disagg metrics
+        import ray_tpu.rl.online  # noqa: F401 — registers RL loop metrics
         import ray_tpu.serve.engine  # noqa: F401 — registers serve metrics
         import ray_tpu.train.pipeline  # noqa: F401 — registers pipeline metrics
         import ray_tpu.util.profiler  # noqa: F401 — registers profiler gauges
@@ -195,7 +196,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 8  # core, data, serve, disagg, health, profiling, objects, fleet
+        assert len(jsons) == 9  # core, data, serve, disagg, health, profiling, objects, fleet, rl
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p

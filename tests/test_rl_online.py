@@ -1,0 +1,288 @@
+"""Online RL post-training loop (rl/online.py): the serve fleet IS the
+rollout fleet.
+
+Covers the end-to-end learning contract (reward provably improves over
+iterations on a deterministic token-preference reward), the staleness
+bound (trajectories older than rl_staleness_max_versions are dropped —
+counted — or importance-corrected), the no-drain weight re-sync (an
+unrelated in-flight stream stays token-valid across a mid-stream sync),
+rollout-replica chaos (a decode replica killed mid-iteration resumes on
+a peer and the iteration still collects every trajectory), and the
+stop()-mid-iteration hygiene contract (inflight gauge back to zero, the
+bounded channel's registry entry dropped — PR 15's cancel-matrix
+pattern applied to the RL loop).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import ray_tpu
+from ray_tpu.core import channels
+from ray_tpu.core.metrics import registry
+from ray_tpu.models import get_config, init_params
+from ray_tpu.rl.grpo import GRPOConfig
+from ray_tpu.rl.online import OnlineRLConfig, OnlineRLLoop
+from ray_tpu.serve.disagg import DisaggCoordinator, EngineWorker
+from ray_tpu.serve.engine import EngineConfig, InferenceEngine
+from ray_tpu.serve.fleet import FleetController
+
+pytestmark = pytest.mark.rl
+
+
+@pytest.fixture(autouse=True)
+def _rl_runtime():
+    """Pin a properly-sized runtime and TEAR IT DOWN after each test so
+    the auto-inited singleton can't leak a 1-CPU runtime into later
+    suites (the r3 serve flake's root cause; same fixture as test_rl)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_config("tiny-llama")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(cfg, params, **kw):
+    defaults = dict(max_batch_size=8, page_size=8, max_pages=128,
+                    max_seq_len=96, prefill_buckets=(16, 32))
+    defaults.update(kw)
+    return InferenceEngine(params, cfg, EngineConfig(**defaults))
+
+
+def _fleet(cfg, params, n_decode=1):
+    engines = [_engine(cfg, params) for _ in range(1 + n_decode)]
+    workers = [EngineWorker(e, f"w{i}") for i, e in enumerate(engines)]
+    co = DisaggCoordinator(workers[:1], workers[1:], {"small_blob_bytes": 0})
+    return FleetController(co), engines
+
+
+def _half_vocab_reward(cfg):
+    half = cfg.vocab_size // 2
+
+    def reward(prompt_ids, completion_ids):
+        return float(np.mean([t < half for t in completion_ids])) \
+            if completion_ids else 0.0
+
+    return reward
+
+
+class _MortalWorker(EngineWorker):
+    """Decode streams die (raise) once kill() fires — the in-process
+    SIGKILL stand-in the coordinator's resume loop must absorb."""
+
+    def __init__(self, engine, name="mortal"):
+        super().__init__(engine, name)
+        self.killed = threading.Event()
+        self.deaths = 0
+
+    def _mortal(self, inner):
+        for item in inner:
+            if self.killed.is_set():
+                self.deaths += 1
+                raise RuntimeError(f"{self.name} SIGKILLed mid-stream")
+            yield item
+
+    def decode_stream(self, request):
+        return self._mortal(super().decode_stream(request))
+
+    def generate_stream(self, request):
+        return self._mortal(super().generate_stream(request))
+
+
+class TestLearning:
+    def test_reward_improves_over_iterations(self, tiny):
+        """The whole point: rollouts sampled BY THE SERVE FLEET, scored,
+        trained on, weights re-synced back — reward must climb on the
+        deterministic lower-half-vocab preference."""
+        cfg, params = tiny
+        fleet, engines = _fleet(cfg, params)
+        loop = OnlineRLLoop(
+            params, cfg, _half_vocab_reward(cfg), fleet,
+            prompts=[[1, 2, 3]],
+            config_=OnlineRLConfig(
+                grpo=GRPOConfig(group_size=16, max_new_tokens=16,
+                                temperature=1.0, lr=5e-3, kl_coef=0.0)))
+        try:
+            history = loop.run(12)
+            rewards = [m["reward_mean"] for m in history
+                       if not np.isnan(m.get("reward_mean", float("nan")))]
+            assert len(rewards) >= 10, history
+            early, late = np.mean(rewards[:3]), np.mean(rewards[-3:])
+            assert late > early + 0.02, (
+                f"reward did not improve: {early:.3f} -> {late:.3f} "
+                f"({[round(r, 3) for r in rewards]})")
+            # the sync leg actually versioned the fleet
+            assert loop.version == len(history)
+            versions = [v for v in fleet.co.weights_versions().values()
+                        if v is not None]
+            assert versions and max(versions) >= 1
+        finally:
+            loop.stop()
+            for e in engines:
+                e.stop()
+
+    def test_rollouts_carry_logprobs_and_version(self, tiny):
+        """Fleet rollouts arrive stamped: per-token sampled logprobs and
+        the generating replica's weights_version (generation 0 before
+        any sync)."""
+        cfg, params = tiny
+        fleet, engines = _fleet(cfg, params)
+        try:
+            ds = fleet.co.open_stream([1, 2, 3], max_tokens=8,
+                                      temperature=1.0)
+            toks = list(ds.tokens())
+            assert len(toks) == 8
+            assert ds.weights_version == 0
+            assert len(ds.logprobs) == 8
+            assert all(lp is None or lp <= 0.0 for lp in ds.logprobs)
+            assert any(lp is not None for lp in ds.logprobs)
+        finally:
+            for e in engines:
+                e.stop()
+
+
+class TestStaleness:
+    def _run_lagged(self, tiny, policy):
+        cfg, params = tiny
+        fleet, engines = _fleet(cfg, params)
+        loop = OnlineRLLoop(
+            params, cfg, _half_vocab_reward(cfg), fleet,
+            prompts=[[1, 2, 3]],
+            config_=OnlineRLConfig(
+                grpo=GRPOConfig(group_size=4, max_new_tokens=8),
+                staleness_max_versions=1, staleness_policy=policy))
+        try:
+            # the fleet still serves generation 0; a trainer 3 versions
+            # ahead makes every rollout stale beyond the bound
+            loop.version = 3
+            return loop.run_iteration()
+        finally:
+            loop.stop()
+            for e in engines:
+                e.stop()
+
+    def test_drop_policy_drops_and_counts(self, tiny):
+        stale = registry.get("rl_stale_trajectories")
+        dropped = registry.get("rl_dropped_trajectories")
+        s0 = stale.get(tags={"policy": "dropped"})
+        d0 = dropped.get(tags={"reason": "stale"})
+        m = self._run_lagged(tiny, "drop")
+        assert m["trajectories"] == 0.0, m
+        assert m["submitted"] == 4.0
+        assert stale.get(tags={"policy": "dropped"}) - s0 == 4
+        assert dropped.get(tags={"reason": "stale"}) - d0 == 4
+
+    def test_correct_policy_keeps_and_counts(self, tiny):
+        stale = registry.get("rl_stale_trajectories")
+        s0 = stale.get(tags={"policy": "corrected"})
+        m = self._run_lagged(tiny, "correct")
+        # same lag, opposite fate: trajectories survive into training
+        # (the clipped importance ratio absorbs the off-policy gap)
+        assert m["trajectories"] == 4.0, m
+        assert stale.get(tags={"policy": "corrected"}) - s0 == 4
+
+
+class TestLiveResync:
+    def test_mid_stream_sync_keeps_stream_token_valid(self, tiny):
+        """The no-drain contract: a full weight re-sync lands while an
+        unrelated stream is mid-decode; the stream must finish with its
+        full token count, every id in-vocab, no error."""
+        cfg, params = tiny
+        fleet, engines = _fleet(cfg, params)
+        try:
+            ds = fleet.co.open_stream([5, 6, 7], max_tokens=24)
+            it = ds.tokens()
+            toks = [next(it) for _ in range(6)]
+            out = fleet.sync_weights(
+                weights=init_params(cfg, jax.random.PRNGKey(1)), version=1)
+            assert not out["failed"], out
+            assert {s["weights_version"] for s in out["synced"]} == {1}
+            toks.extend(it)
+            assert len(toks) == 24
+            assert all(isinstance(t, int) and 0 <= t < cfg.vocab_size
+                       for t in toks)
+        finally:
+            for e in engines:
+                e.stop()
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_rollout_replica_death_mid_iteration_resumes(self, tiny):
+        """Chaos: SIGKILL a rollout decode replica mid-iteration. Live
+        resume re-homes the dead streams on the surviving peer, so the
+        iteration still collects EVERY trajectory — a replica death is a
+        latency blip, not lost rollouts."""
+        cfg, params = tiny
+        engines = [_engine(cfg, params) for _ in range(3)]
+        mortal = _MortalWorker(engines[1], "mortal-decode")
+        co = DisaggCoordinator(
+            [EngineWorker(engines[0], "prefill0")],
+            [mortal, EngineWorker(engines[2], "decode1")],
+            {"small_blob_bytes": 0})
+        fleet = FleetController(co)
+        loop = OnlineRLLoop(
+            params, cfg, _half_vocab_reward(cfg), fleet,
+            prompts=[[1, 2, 3]],
+            config_=OnlineRLConfig(
+                grpo=GRPOConfig(group_size=8, max_new_tokens=16)))
+        try:
+            killer = threading.Timer(0.3, mortal.killed.set)
+            killer.daemon = True
+            killer.start()
+            m = loop.run_iteration()
+            killer.cancel()
+            assert mortal.deaths > 0, "chaos injected no death"
+            assert m["submitted"] == 8.0
+            assert m["trajectories"] == 8.0, m
+        finally:
+            loop.stop()
+            for e in engines:
+                e.stop()
+
+
+class TestStopHygiene:
+    def test_stop_mid_iteration_leaves_gauges_and_channels_flat(self, tiny):
+        """PR 15's cancel-matrix contract applied to the loop: stop()
+        fired mid-collection must zero rl_trajectories_inflight and drop
+        the bounded channel's registry queue (no orphan pins)."""
+        cfg, params = tiny
+        inflight = registry.get("rl_trajectories_inflight")
+        fleet, engines = _fleet(cfg, params)
+        loop = OnlineRLLoop(
+            params, cfg, _half_vocab_reward(cfg), fleet,
+            prompts=[[1, 2, 3]],
+            config_=OnlineRLConfig(
+                grpo=GRPOConfig(group_size=16, max_new_tokens=16)))
+        try:
+            t = threading.Thread(target=loop.run_iteration, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 30.0
+            while inflight.get() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert inflight.get() > 0, "iteration never got in flight"
+            loop.stop()
+            t.join(timeout=30.0)
+            assert not t.is_alive()
+            assert inflight.get() == 0.0
+            # the LOOP's bounded channel must be gone from the registry
+            # (the coordinator's persistent KV-pair channels are not
+            # ours to close and legitimately survive)
+            with channels._registry._lock:
+                assert loop.channel.chan_id not in channels._registry._chans
+            # stop is idempotent and a stopped loop refuses new work
+            loop.stop()
+            with pytest.raises(RuntimeError):
+                loop.run_iteration()
+        finally:
+            loop.stop()
+            for e in engines:
+                e.stop()
