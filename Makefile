@@ -40,10 +40,10 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	broadcast fleet rl tsan shm lint \
+	broadcast fleet rl tsan shm lint spec-smoke \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
 	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet \
-	bench-rl
+	bench-rl bench-spec
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -62,6 +62,13 @@ bench-object:
 # BENCH_SUMMARY.json
 bench-serve:
 	env RAY_TPU_BENCH_SUITE=serve python bench.py
+
+# speculative-decoding acceptance loop: plain vs ngram-spec engines as
+# alternating same-process rounds with per-round medians — the committed
+# spec tok/s row must BEAT the plain row or the suite raises (no summary
+# commit), merged into BENCH_SUMMARY.json
+bench-spec:
+	env RAY_TPU_BENCH_SUITE=spec python bench.py
 
 # disagg acceptance loop: ONLY the disagg rows — alternating colocated/
 # disagg rounds with per-side medians (box drift hits both sides), a
@@ -131,7 +138,15 @@ lint:
 	@echo "== lint: raylint =="
 	python -m ray_tpu.tools.raylint
 
-check: shm lint
+# fast spec-decode smoke (<30s): greedy plain-vs-spec equivalence on the
+# ngram proposer — a proposer regression fails tier-1 here instead of
+# only surfacing in the slow bench
+spec-smoke:
+	@echo "== spec-decode smoke: greedy plain-vs-spec equivalence =="
+	$(PYTEST) $(FAST) tests/test_spec_decode.py \
+		-k "greedy_on_equals_off and ngram"
+
+check: shm lint spec-smoke
 	@echo "== chunk 1/3: core runtime =="
 	$(PYTEST) $(FAST) $(CORE_TESTS)
 	@echo "== chunk 2/3: libraries (data/train/tune/rl/serve) =="

@@ -199,6 +199,26 @@ declare(
 declare("gang_barrier_timeout_ms", 60_000, "SPMD gang entry barrier timeout.")
 declare("device_prefetch_depth", 2, "Host->HBM double buffering depth.")
 
+# Serving (serve/engine.py, serve/spec_decode.py, serve/disagg.py)
+declare(
+    "spec_overlap", True,
+    "Speculative decoding: overlap the draft-model propose for round N+1 "
+    "with the host-side commit/bookkeeping of round N (the prefetched "
+    "drafts are validated per slot by request/position stamps, so "
+    "eviction or cancellation in between degrades to a plain token, "
+    "never to a wrong one). Per-engine override: "
+    "SpeculationConfig.overlap.",
+)
+declare(
+    "kv_frame_layout", "layer",
+    "Streamed KV-migration frame layout: 'layer' (wire v2 — frames carry "
+    "a slab of consecutive layers per token range, so the stream starts "
+    "during the first layers of the device->host pull and the importer "
+    "stages slabs as they land) or 'token' (wire v1 — all layers per "
+    "frame). Per-request override: Request.kv_frame_layout; disagg "
+    "coordinators forward DisaggConfig.kv_frame_layout.",
+)
+
 # Observability
 declare("log_to_driver", True, "Tail worker logs back to the driver process.")
 declare("event_log_dir", "", "Structured event-log directory; empty = session dir.")

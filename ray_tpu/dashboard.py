@@ -89,6 +89,14 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         _panel("TTFT p50/p95",
                "histogram_quantile(0.5, rate(serve_ttft_seconds_bucket[5m]))",
                3, 8, unit="s", legend="p50"),
+        # decode-step phase breakdown: the propose_wait vs propose_compute
+        # split is the speculation-overlap evidence, kv_framing the
+        # streamed-export framing cost
+        _panel("Decode step time by phase (s/s)",
+               "rate(serve_decode_step_phase_seconds_sum[5m])",
+               4, 16, unit="s", legend="{{phase}} {{mode}}"),
+        _panel("Spec acceptance rate", "serve_spec_acceptance_rate",
+               5, 16, unit="percentunit", legend="acceptance"),
     ])
     # p95 as a second target on the TTFT panel
     serve["panels"][3]["targets"].append({

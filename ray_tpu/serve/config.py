@@ -44,6 +44,11 @@ class SpeculationConfig:
     draft_model: Optional[str] = None
     draft_model_overrides: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # draft mode: dispatch round N+1's propose right after round N's
+    # commit readback so the draft forward overlaps the engine's host
+    # bookkeeping (spec_decode.DraftModelProposer.prefetch). None follows
+    # the config.spec_overlap knob; False forces serial propose->verify.
+    overlap: Optional[bool] = None
 
     MODES = ("off", "ngram", "draft")
 
@@ -132,6 +137,11 @@ class DisaggConfig:
     kv_coalesce_bytes: int = 1 << 20
     kv_stream_idle_s: float = 30.0
     kv_inbox_ttl_s: float = 120.0
+    # stream-mode frame layout forwarded to the prefill engines: "layer"
+    # (wire v2 — per-layer-group slabs, the stream starts during the
+    # first layers of the device->host pull), "token" (wire v1 — full
+    # layer stack per frame), or "" to follow config.kv_frame_layout
+    kv_frame_layout: str = ""
     # prefix-aware role routing: a request whose leading prompt pages
     # are warm on a decode replica (per its PrefixCache digest, gossiped
     # every prefix_gossip_s) runs there directly — no prefill hop, no
@@ -173,6 +183,10 @@ class DisaggConfig:
         if int(self.kv_stream_tokens) < 1:
             raise ValueError(
                 f"kv_stream_tokens must be >= 1, got {self.kv_stream_tokens}")
+        if self.kv_frame_layout not in ("", "layer", "token"):
+            raise ValueError(
+                "kv_frame_layout must be '', 'layer' or 'token', "
+                f"got {self.kv_frame_layout!r}")
         if int(self.kv_coalesce_bytes) < 0:
             raise ValueError(
                 f"kv_coalesce_bytes must be >= 0, "

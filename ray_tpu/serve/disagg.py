@@ -368,7 +368,8 @@ def _prefill_streamed(engine: InferenceEngine, request: Dict[str, Any],
                                  parent_id=cur.span_id)
         req = Request(
             prefill_only=True, kv_sink=sink,
-            kv_window=int(request.get("kv_stream_tokens", 256)), **opts)
+            kv_window=int(request.get("kv_stream_tokens", 256)),
+            kv_frame_layout=str(request.get("kv_frame_layout", "")), **opts)
         engine.add_request(req)
         done = req.done.wait(timeout)
         if xspan is not None:
@@ -1313,6 +1314,7 @@ class DisaggCoordinator:
             "kv_stream_tokens": self.cfg.kv_stream_tokens,
             "kv_coalesce_bytes": self.cfg.kv_coalesce_bytes,
             "kv_stream_idle_s": self.cfg.kv_stream_idle_s,
+            "kv_frame_layout": self.cfg.kv_frame_layout,
             # None when untraced: replicas skip all span work on that path
             "trace_ctx": tracing.current_context(),
         }

@@ -24,16 +24,32 @@ rejection sampling simple —
   speculative sampling: the output distribution equals the target's.
 
 Two proposers behind one duck-typed interface
-(on_install/propose/warmup):
+(on_install/on_evict/propose/warmup):
 
 - NGramProposer: suffix-match lookup over the request's own
   prompt+output (vLLM's ngram mode) — no extra model, wins on
-  repetitive/extractive continuations.
+  repetitive/extractive continuations. The lookup is VECTORIZED across
+  the whole continuous batch (one sliding-window pass per suffix length
+  over a persistent [B, max_seq_len] context buffer maintained
+  incrementally per slot), so propose costs microseconds instead of a
+  per-request Python loop. When NO slot has a draft, run_step signals
+  the engine to fall back to a plain decode span for that iteration —
+  the spec engine is never slower than the plain engine by more than
+  the lookup.
 - DraftModelProposer: a small transformer from models/ sharing the
   tokenizer, with its OWN paged KV pool mirroring each slot's positions
   (fixed per-slot page runs — no allocator). Prompts chunk-prefill into
   the draft pool at install; each step runs k greedy draft-decode steps
-  in one jitted scan.
+  in one jitted scan, preceded by a catch-up write for the token at
+  position-1 (on a fully-accepted round the last draft token was never
+  fed, leaving a KV hole that silently degraded acceptance). With
+  spec_overlap (the default), the NEXT round's propose scan is
+  dispatched at the end of run_step — right after the commit readback —
+  so the draft forward overlaps the engine's host-side commit loop and
+  bookkeeping instead of serializing in front of verify. Per-slot
+  (request_id, position) stamps invalidate a prefetched row whenever
+  the slot was evicted, reused, or cancelled in between: a stale row
+  simply proposes nothing (n_draft=0 commits exactly the plain token).
 
 KV bookkeeping: the verify forward writes span KV at positions
 p..p+n_draft per slot (rows past a slot's draft count are routed to the
@@ -53,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.config import config
 from ..core.logging import get_logger
 from ..core.metrics import Counter, Gauge
 from ..models import get_config, init_params
@@ -165,37 +182,112 @@ def _ngram_lookup(ctx: np.ndarray, nmin: int, nmax: int, k: int) -> np.ndarray:
     return np.empty((0,), np.int32)
 
 
+def _batch_ngram_lookup(ctx: np.ndarray, lens: np.ndarray,
+                        active: np.ndarray, nmin: int, nmax: int, k: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """`_ngram_lookup` for the whole batch: one sliding-window pass per
+    suffix length n (at most nmax-nmin+1 passes, each a single vectorized
+    comparison over [rows, windows, n]) instead of a per-request Python
+    loop. Row semantics are identical to `_ngram_lookup(ctx[i, :lens[i]])`:
+    longest suffix length wins, most recent match wins, continuation
+    truncated at the row's real length."""
+    B = ctx.shape[0]
+    drafts = np.zeros((B, k), np.int32)
+    n_out = np.zeros((B,), np.int32)
+    unresolved = active.copy()
+    for n in range(nmax, nmin - 1, -1):
+        rows = np.flatnonzero(unresolved & (lens >= n + 1))
+        if rows.size == 0:
+            continue
+        sub = ctx[rows]
+        L = lens[rows].astype(np.int64)
+        idx = (L[:, None] - n) + np.arange(n)[None, :]
+        suffix = np.take_along_axis(sub, idx, axis=1)
+        win = np.lib.stride_tricks.sliding_window_view(sub, n, axis=1)
+        hit = (win == suffix[:, None, :]).all(axis=2)
+        # window j matches real context only if a continuation exists
+        # inside the row's live tokens: j + n < L (window fully inside
+        # ctx[:L-1], exactly the scalar lookup's search range)
+        hit &= (np.arange(hit.shape[1])[None, :] + n) < L[:, None]
+        got = hit.any(axis=1)
+        if not got.any():
+            continue
+        last_j = hit.shape[1] - 1 - np.argmax(hit[:, ::-1], axis=1)
+        for ri in np.flatnonzero(got):
+            r = int(rows[ri])
+            j = int(last_j[ri])
+            m = min(k, int(L[ri]) - (j + n))
+            drafts[r, :m] = ctx[r, j + n: j + n + m]
+            n_out[r] = m
+            unresolved[r] = False
+    return drafts, n_out
+
+
 class NGramProposer:
-    """Draft tokens from the request's own prompt+output (no model)."""
+    """Draft tokens from the request's own prompt+output (no model).
+
+    Keeps a persistent [B, max_seq_len] context buffer mirroring each
+    slot's prompt+output, appended incrementally per step (only the new
+    committed tokens copy), and runs ONE vectorized suffix lookup across
+    the batch. A request_id stamp per row means a reused slot can never
+    see its predecessor's context."""
 
     name = "ngram"
+    cheap = True  # host-side: a zero-draft round should fall back to plain
 
     def __init__(self, spec: SpeculationConfig):
         self.k = spec.num_speculative_tokens
         self.nmin = spec.ngram_min
         self.nmax = spec.ngram_max
+        self._ctx: Optional[np.ndarray] = None  # [B, max_seq_len] int32
+        self._len: Optional[np.ndarray] = None  # [B] live tokens per row
+        self._rid: list = []
+
+    def _ensure(self, engine) -> None:
+        if self._ctx is None:
+            B = engine.ecfg.max_batch_size
+            self._ctx = np.zeros((B, engine.ecfg.max_seq_len), np.int32)
+            self._len = np.zeros((B,), np.int64)
+            self._rid = [None] * B
 
     def on_install(self, engine, slot_idx: int, request) -> None:
-        pass
+        self._ensure(engine)
+        seq = request.prompt + request.output
+        m = min(len(seq), self._ctx.shape[1])
+        self._ctx[slot_idx, :m] = seq[:m]
+        self._len[slot_idx] = m
+        self._rid[slot_idx] = request.request_id
+
+    def on_evict(self, engine, slot_idx: int) -> None:
+        if self._ctx is not None:
+            self._len[slot_idx] = 0
+            self._rid[slot_idx] = None
 
     def warmup(self, engine) -> None:
         pass
 
     def propose(self, engine, tokens, positions
                 ) -> Tuple[np.ndarray, np.ndarray]:
+        self._ensure(engine)
         B = engine.ecfg.max_batch_size
-        drafts = np.zeros((B, self.k), np.int32)
-        n = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        cap = self._ctx.shape[1]
         for i, s in enumerate(engine.slots):
-            if s.request is None:
+            req = s.request
+            if req is None:
                 continue
-            ctx = np.asarray(s.request.prompt + s.request.output, np.int32)
-            cont = _ngram_lookup(ctx, self.nmin, self.nmax, self.k)
-            m = int(cont.shape[0])
-            if m:
-                drafts[i, :m] = cont
-                n[i] = m
-        return drafts, n
+            if self._rid[i] != req.request_id:
+                self.on_install(engine, i, req)
+            else:
+                P = len(req.prompt)
+                total = min(P + len(req.output), cap)
+                have = int(self._len[i])
+                if total > have:
+                    self._ctx[i, have:total] = req.output[have - P: total - P]
+                    self._len[i] = total
+            active[i] = True
+        return _batch_ngram_lookup(self._ctx, self._len, active,
+                                   self.nmin, self.nmax, self.k)
 
 
 class DraftModelProposer:
@@ -210,9 +302,17 @@ class DraftModelProposer:
     """
 
     name = "draft"
+    cheap = False  # zero-draft rounds keep current behavior (verify span)
+    supports_prefetch = True
 
     def __init__(self, engine, spec: SpeculationConfig, draft_params=None):
         import dataclasses as _dc
+
+        # next-round propose dispatched at the end of run_step (overlap
+        # mode): {"drafts" device [B,K], "pos" np [B], "rids" list} —
+        # consumed (or discarded on any per-row stamp mismatch) by the
+        # next take_prefetch
+        self._pf: Optional[Dict[str, Any]] = None
 
         self.k = spec.num_speculative_tokens
         ecfg = engine.ecfg
@@ -376,7 +476,21 @@ class DraftModelProposer:
                 head.astype(jnp.float32))
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_k, new_v
 
-        def propose(params, k_pages, v_pages, tokens, positions, page_tables):
+        def propose(params, k_pages, v_pages, prev_tokens, tokens, positions,
+                    page_tables):
+            # catch-up: on a fully-accepted round the token now at
+            # position-1 (the last draft) was never FED to the draft
+            # model, so its KV is a hole that poisons every later step's
+            # attention. One extra decode step writes it; when the hole
+            # doesn't exist this rewrites identical KV (idempotent), and
+            # XLA prunes the unused logits head. Inactive rows clamp to
+            # position 0 (their writes land in the slot's own pages at
+            # positions no live request can see before on_install
+            # rebuilds them).
+            _, k_pages, v_pages = one_step(
+                params, k_pages, v_pages, prev_tokens,
+                jnp.maximum(positions - 1, 0), page_tables)
+
             def sub(carry, _):
                 toks, pos, kp, vp = carry
                 nxt, kp, vp = one_step(params, kp, vp, toks, pos, page_tables)
@@ -405,6 +519,12 @@ class DraftModelProposer:
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(padded), jnp.int32(c0), table)
 
+    def on_evict(self, engine, slot_idx: int) -> None:
+        # a prefetched row computed for the evicted request must never
+        # surface for the slot's next occupant
+        if self._pf is not None:
+            self._pf["rids"][slot_idx] = None
+
     def warmup(self, engine) -> None:
         B = engine.ecfg.max_batch_size
         C = self.chunk
@@ -414,16 +534,69 @@ class DraftModelProposer:
         drafts, self.k_pages, self.v_pages = self._propose_fn(
             self.params, self.k_pages, self.v_pages,
             jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-            self._tables)
+            jnp.zeros((B,), jnp.int32), self._tables)
         np.asarray(drafts)
+
+    def _prev_tokens(self, engine, tokens) -> np.ndarray:
+        """The token at position-1 per slot (catch-up feed)."""
+        prev = np.asarray(tokens, np.int32).copy()
+        for i, s in enumerate(engine.slots):
+            req = s.request
+            if req is None:
+                continue
+            if len(req.output) >= 2:
+                prev[i] = req.output[-2]
+            elif req.prompt:
+                prev[i] = req.prompt[-1]
+        return prev
 
     def propose(self, engine, tokens, positions
                 ) -> Tuple[jax.Array, np.ndarray]:
+        prev = self._prev_tokens(engine, tokens)
         drafts, self.k_pages, self.v_pages = self._propose_fn(
-            self.params, self.k_pages, self.v_pages,
+            self.params, self.k_pages, self.v_pages, jnp.asarray(prev),
             jnp.asarray(tokens), jnp.asarray(positions), self._tables)
         n = np.full((engine.ecfg.max_batch_size,), self.k, np.int32)
         return drafts, n  # drafts stay on device: verify concats there
+
+    def prefetch(self, engine, tokens, positions, committed, n_comm) -> None:
+        """Dispatch the NEXT round's propose right after this round's
+        commit readback: the inputs (next fed token, next position, the
+        catch-up token) are pure functions of the committed tokens, so
+        the draft forward runs on device while the engine does its
+        host-side commit loop. Stamped per row with (request_id,
+        position); take_prefetch drops any row whose stamp no longer
+        matches."""
+        B = engine.ecfg.max_batch_size
+        rows = np.arange(B)
+        nc = np.asarray(n_comm, np.int64)
+        tokens = np.asarray(tokens, np.int32)
+        last = committed[rows, np.maximum(nc - 1, 0)]
+        next_tok = np.where(nc > 0, last, tokens).astype(np.int32)
+        prev_tok = np.where(
+            nc >= 2, committed[rows, np.maximum(nc - 2, 0)],
+            tokens).astype(np.int32)
+        next_pos = (np.asarray(positions, np.int64) + nc).astype(np.int32)
+        drafts, self.k_pages, self.v_pages = self._propose_fn(
+            self.params, self.k_pages, self.v_pages, jnp.asarray(prev_tok),
+            jnp.asarray(next_tok), jnp.asarray(next_pos), self._tables)
+        rids = [s.request.request_id if s.request is not None else None
+                for s in engine.slots]
+        self._pf = {"drafts": drafts, "pos": next_pos, "rids": rids}
+
+    def take_prefetch(self, engine, positions
+                      ) -> Optional[Tuple[jax.Array, np.ndarray]]:
+        pf, self._pf = self._pf, None
+        if pf is None:
+            return None
+        B = engine.ecfg.max_batch_size
+        n = np.zeros((B,), np.int32)
+        for i, s in enumerate(engine.slots):
+            req = s.request
+            if (req is not None and pf["rids"][i] == req.request_id
+                    and int(pf["pos"][i]) == int(positions[i])):
+                n[i] = self.k
+        return pf["drafts"], n
 
 
 # ---------------------------------------------------------------------------
@@ -447,6 +620,10 @@ class SpecDecoder:
         else:
             raise ValueError(f"speculation mode {spec.mode!r} is not a "
                              "proposer mode")
+        overlap = (spec.overlap if spec.overlap is not None
+                   else bool(config.spec_overlap))
+        self.overlap = overlap and getattr(
+            self.proposer, "supports_prefetch", False)
         self._verify = self._build_verify()
         self.proposed_total = 0
         self.accepted_total = 0
@@ -459,14 +636,17 @@ class SpecDecoder:
         eng = self.engine
         cfg = eng.cfg
         ps = eng.ecfg.page_size
-        S = self.k + 1
         tp_mesh = eng.mesh if eng._tp > 1 else None
 
         def verify(params, k_pages, v_pages, tokens, positions, page_tables,
                    n_draft, temps, top_ps, top_ks, key, advanced=False):
-            """tokens [B,S]; positions/n_draft/temps/... [B]."""
+            """tokens [B,S]; positions/n_draft/temps/... [B]. S is taken
+            from the tokens shape: run_step narrows the span to the
+            round's max draft count + 1 (the jit cache re-specializes per
+            width), so a round where every slot drafted short never pays
+            the full k+1-wide forward."""
             dtype = jnp.dtype(cfg.dtype)
-            B = tokens.shape[0]
+            B, S = tokens.shape
             x = _embed_lookup(params["embed"], tokens, dtype, mesh=eng.mesh)
             pos2d = positions[:, None] + jnp.arange(S)[None, :]  # [B,S]
             if cfg.positional == "learned":
@@ -540,6 +720,11 @@ class SpecDecoder:
     def on_install(self, slot_idx: int, request) -> None:
         self.proposer.on_install(self.engine, slot_idx, request)
 
+    def on_evict(self, slot_idx: int) -> None:
+        ev = getattr(self.proposer, "on_evict", None)
+        if ev is not None:
+            ev(self.engine, slot_idx)
+
     def warmup(self) -> None:
         eng = self.engine
         self.proposer.warmup(eng)
@@ -555,22 +740,81 @@ class SpecDecoder:
                 jnp.zeros((B,), jnp.int32), jax.random.PRNGKey(0))
             np.asarray(committed)
 
+    # verify cost model: one S-wide forward ~ ALPHA + S in single-row
+    # units (ALPHA covers dispatch + the fixed host share of a round).
+    # Used by _pick_span to trade truncating the deepest rows' drafts
+    # against running a narrower program for the whole batch.
+    _SPAN_ALPHA = 1.0
+
+    def _pick_span(self, n_draft, caps) -> int:
+        """Choose how many draft rows the verify forward should carry.
+
+        One slot with k drafts would force the full k+1-wide program on
+        the whole batch even when every other slot drafted 0-1 tokens —
+        and a draft only pays off while its acceptance holds up. Using
+        the proposer's measured acceptance rate `a`, a row with d drafts
+        verified at width w expects (a - a^(min(d,w)+1)) / (1-a) + 1
+        committed tokens; pick the w maximizing expected commits per
+        unit verify cost (ALPHA + w + 1). Rows deeper than w are simply
+        truncated — their tail drafts were the least likely to commit."""
+        m = int(n_draft.max())
+        if m <= 1:
+            return m
+        a = (self.accepted_total / self.proposed_total
+             if self.proposed_total >= 256 else 0.8)
+        a = min(max(a, 0.05), 0.98)
+        nd = n_draft[np.asarray(caps) > 0].astype(np.float64)
+        best_w, best_v = m, -1.0
+        for w in range(1, m + 1):
+            run = np.minimum(nd, w)
+            exp_commits = np.sum((a - a ** (run + 1)) / (1.0 - a) + 1.0)
+            v = exp_commits / (self._SPAN_ALPHA + w + 1)
+            if v > best_v:
+                best_w, best_v = w, v
+        return best_w
+
     def run_step(self, tokens, positions, tables, caps, temps, top_ps,
                  top_ks, advanced, key):
         """One speculative round over the built batch arrays. caps [B] is
         the per-slot draft cap (min of k, remaining budget - 1, sequence
         room; 0 for inactive slots). Returns committed [B,S] np,
-        n_committed [B] np, n_draft [B] np, and per-phase wall times."""
+        n_committed [B] np, n_draft [B] np, and per-phase wall times
+        (propose split into the wait-on-prefetch and compute shares).
+
+        Fallback: a CHEAP proposer (ngram) with zero drafts everywhere
+        returns (None, None, n_draft, times) — the engine should run a
+        plain decode span instead, which commits span tokens at plain
+        cost where the S-wide verify would commit exactly one."""
         eng = self.engine
         t0 = time.monotonic()
-        drafts, n_prop = self.proposer.propose(eng, tokens, positions)
+        wait = compute = 0.0
+        pf = (self.proposer.take_prefetch(eng, positions)
+              if self.overlap else None)
+        if pf is not None:
+            drafts, n_prop = pf
+            wait = time.monotonic() - t0
+        else:
+            drafts, n_prop = self.proposer.propose(eng, tokens, positions)
+            compute = time.monotonic() - t0
         n_draft = np.minimum(n_prop, caps).astype(np.int32)
+        if getattr(self.proposer, "cheap", False) and not n_draft.any():
+            return None, None, n_draft, {
+                "propose_wait": wait, "propose_compute": compute,
+                "propose": wait + compute}
+        # adaptive span: the verify forward only needs max(n_draft)+1
+        # rows — a round of short drafts runs a narrow program (at most k
+        # compiled widths) instead of always paying the k+1-wide one.
+        # Floor of 1 draft row: K=0 would make the accept op's rejected-
+        # draft gather degenerate (an all-zero-cap round still verifies
+        # one draft row it then ignores via n_draft=0)
+        m = max(1, self._pick_span(n_draft, caps))
+        n_draft = np.minimum(n_draft, m)
         if isinstance(drafts, np.ndarray):
             toks_bs = jnp.asarray(
-                np.concatenate([tokens[:, None], drafts], axis=1))
+                np.concatenate([tokens[:, None], drafts[:, :m]], axis=1))
         else:
             toks_bs = jnp.concatenate(
-                [jnp.asarray(tokens)[:, None], drafts], axis=1)
+                [jnp.asarray(tokens)[:, None], drafts[:, :m]], axis=1)
         t1 = time.monotonic()
         committed, n_comm, eng.k_pages, eng.v_pages = self._verify(advanced)(
             eng.params, eng.k_pages, eng.v_pages, toks_bs,
@@ -581,8 +825,15 @@ class SpecDecoder:
         committed = np.asarray(committed)
         n_comm = np.asarray(n_comm)
         t3 = time.monotonic()
+        if self.overlap:
+            # dispatch next round's propose NOW: it executes on device
+            # while the engine runs its host-side commit loop
+            self.proposer.prefetch(eng, tokens, positions, committed, n_comm)
+            compute += time.monotonic() - t3
         return committed, n_comm, n_draft, {
-            "propose": t1 - t0, "verify": t2 - t1, "sample": t3 - t2}
+            "propose_wait": wait, "propose_compute": compute,
+            "propose": wait + compute,
+            "verify": t2 - t1, "sample": t3 - t2}
 
     def record(self, proposed: int, accepted: int) -> None:
         self.proposed_total += int(proposed)

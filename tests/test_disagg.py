@@ -353,6 +353,180 @@ class TestStreamedMigration:
         assert out["kv_transport"] == "stream"
 
 
+class TestLayerMajorFraming:
+    """Wire v2 (layer-major) streamed export: frames carry per-layer-group
+    slabs so the stream starts during the first layers of the device->host
+    pull; import must stay token-exact across mismatched page sizes, old
+    token-major (v1) frames must keep importing, and anything newer than
+    v2 is refused up front."""
+
+    def _collect_frames(self, src, prompt, layout, max_tokens=8):
+        frames = []
+        req = Request(request_id=uuid.uuid4().hex, prompt=list(prompt),
+                      max_tokens=max_tokens, prefill_only=True,
+                      kv_sink=frames.append, kv_window=8,
+                      kv_frame_layout=layout)
+        src.add_request(req)
+        assert req.done.wait(120.0)
+        assert req.error is None, req.error
+        return frames
+
+    def _import_frames(self, dst, prompt, frames, max_tokens=8):
+        meta = next(f for f in frames if f["seq"] == 0)
+        last = next(f for f in frames if f["last"])
+        dreq = Request(request_id=uuid.uuid4().hex, prompt=list(prompt),
+                       max_tokens=max_tokens)
+        assert dst.begin_kv_import(dreq, meta["true_len"], meta)
+        for f in frames:
+            dst.ingest_kv_chunk(dreq, f)
+        dst.finish_kv_import(dreq, last["first_token"],
+                             last.get("first_logprob"))
+        assert dreq.done.wait(120.0)
+        assert dreq.error is None, dreq.error
+        return dreq
+
+    @pytest.mark.parametrize("nlen,chunk", [(29, None), (40, 16)],
+                             ids=["bucketed", "chunked"])
+    def test_layer_major_token_exact_mismatched_pages(self, tiny, nlen,
+                                                      chunk):
+        """Layer-major streamed export -> 8->4 page repagination is
+        token-identical to an uninterrupted engine, on both the bucketed
+        and the chunked (page-committed) prefill paths."""
+        cfg, params = tiny
+        kw = {} if chunk is None else dict(prefill_chunk=chunk)
+        src = _engine(cfg, params, page_size=8, **kw)
+        dst = _engine(cfg, params, page_size=4, max_pages=96)
+        ref = _engine(cfg, params, page_size=8, **kw)
+        try:
+            prompt = _mixed_prompts(cfg, (nlen,), seed=31)[0]
+            want = ref.generate(prompt, max_tokens=8)["token_ids"]
+            frames = self._collect_frames(src, prompt, "layer")
+            # wire v2 on the frames: every frame is a layer slab, the
+            # header stamps the version, and SOME frame starts at a
+            # nonzero layer (tiny-llama's 2 layers split into 2 groups)
+            meta = next(f for f in frames if f["seq"] == 0)
+            assert meta["kv_wire"] == 2
+            assert meta["layers"] == cfg.n_layers
+            assert all("layer0" in f for f in frames)
+            assert any(f["layer0"] > 0 for f in frames)
+            assert all(f["k"].shape[0] < cfg.n_layers for f in frames)
+            dreq = self._import_frames(dst, prompt, frames)
+            assert list(dreq.output) == want
+        finally:
+            src.stop(), dst.stop(), ref.stop()
+
+    def test_token_major_legacy_frames_still_import(self, tiny):
+        """Wire v1 (token-major, kv_frame_layout='token'): frames carry
+        the full layer stack, no version marker — and the importer keeps
+        accepting them token-exactly (old senders stay compatible)."""
+        cfg, params = tiny
+        src = _engine(cfg, params, page_size=8)
+        dst = _engine(cfg, params, page_size=4, max_pages=96)
+        ref = _engine(cfg, params, page_size=8)
+        try:
+            prompt = _mixed_prompts(cfg, (29,), seed=32)[0]
+            want = ref.generate(prompt, max_tokens=8)["token_ids"]
+            frames = self._collect_frames(src, prompt, "token")
+            meta = next(f for f in frames if f["seq"] == 0)
+            assert "kv_wire" not in meta
+            assert all("layer0" not in f for f in frames)
+            assert all(f["k"].shape[0] == cfg.n_layers for f in frames)
+            dreq = self._import_frames(dst, prompt, frames)
+            assert list(dreq.output) == want
+        finally:
+            src.stop(), dst.stop(), ref.stop()
+
+    def test_wire_version_guard_rejects_future_format(self, tiny):
+        cfg, params = tiny
+        dst = _engine(cfg, params)
+        try:
+            req = Request(request_id="v3-req", prompt=[1, 2, 3],
+                          max_tokens=4)
+            meta = {"layers": cfg.n_layers, "kv_heads": cfg.kv_heads,
+                    "head_dim": cfg.hdim, "dtype": "float32",
+                    "kv_wire": 3}
+            assert not dst.begin_kv_import(req, 3, meta)
+            assert req.done.is_set()
+            assert "kv wire format v3" in req.error
+        finally:
+            dst.stop()
+
+    def test_frame_outside_staged_layers_rejected(self, tiny):
+        cfg, params = tiny
+        dst = _engine(cfg, params)
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            req = Request(request_id="oob-req", prompt=list(prompt),
+                          max_tokens=4)
+            meta = {"layers": cfg.n_layers, "kv_heads": cfg.kv_heads,
+                    "head_dim": cfg.hdim, "dtype": "float32",
+                    "kv_wire": 2}
+            assert dst.begin_kv_import(req, len(prompt), meta)
+            bad = {"request_id": req.request_id, "seq": 0, "start": 0,
+                   "layer0": cfg.n_layers,  # one past the last layer
+                   "k": np.zeros((1, 5, cfg.kv_heads, cfg.hdim),
+                                 np.float32),
+                   "v": np.zeros((1, 5, cfg.kv_heads, cfg.hdim),
+                                 np.float32),
+                   "last": False}
+            with pytest.raises(ValueError, match="layers"):
+                dst.ingest_kv_chunk(req, bad)
+            dst.abort_kv_import(req, error="bad frame")
+            assert req.done.is_set() and req.error == "bad frame"
+        finally:
+            dst.stop()
+
+    def test_abort_mid_layer_stream_frees_pages_both_sides(self, tiny):
+        """A sink dying mid-layer-stream fails the prefill request and
+        returns its pages; the decode side tearing down a half-staged
+        layer-major import frees the staged pages too."""
+        cfg, params = tiny
+        src = _engine(cfg, params, prefill_chunk=16)
+        dst = _engine(cfg, params, page_size=4, max_pages=96)
+        try:
+            prompt = _mixed_prompts(cfg, (40,), seed=33)[0]
+            # source side: collect a healthy stream first (to replay a
+            # partial prefix into the importer), then a dying sink
+            frames = self._collect_frames(src, prompt, "layer")
+            assert len(frames) >= 3
+            src_free0 = src.stats()["free_pages"]
+            calls = [0]
+
+            def dying_sink(frame):
+                calls[0] += 1
+                if calls[0] > 2:
+                    raise RuntimeError("decode replica died mid-slab")
+
+            req = Request(request_id=uuid.uuid4().hex, prompt=list(prompt),
+                          max_tokens=8, prefill_only=True,
+                          kv_sink=dying_sink, kv_window=8,
+                          kv_frame_layout="layer")
+            src.add_request(req)
+            assert req.done.wait(60.0), "prefill hung on dead sink"
+            assert req.error and "kv stream failed" in req.error
+            deadline = time.monotonic() + 10
+            while (src.stats()["free_pages"] != src_free0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert src.stats()["free_pages"] == src_free0
+
+            # decode side: stage the first two layer slabs, then abort
+            dst_free0 = dst.stats()["free_pages"]
+            meta = next(f for f in frames if f["seq"] == 0)
+            dreq = Request(request_id=uuid.uuid4().hex,
+                           prompt=list(prompt), max_tokens=8)
+            assert dst.begin_kv_import(dreq, meta["true_len"], meta)
+            assert dst.stats()["free_pages"] < dst_free0
+            for f in frames[:2]:
+                dst.ingest_kv_chunk(dreq, f)
+            dst.abort_kv_import(dreq, error="prefill replica died")
+            assert dreq.done.is_set()
+            assert "prefill replica died" in dreq.error
+            assert dst.stats()["free_pages"] == dst_free0
+        finally:
+            src.stop(), dst.stop()
+
+
 class TestStreamChaos:
     """A dying replica mid-stream must FAIL the request cleanly (no
     hang) and release every page/blob it staged."""

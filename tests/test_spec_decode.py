@@ -12,7 +12,11 @@ from ray_tpu.models import get_config, init_params
 from ray_tpu.ops import paged_attention_decode, paged_attention_verify
 from ray_tpu.ops.paged_attention import _verify_reference
 from ray_tpu.serve import EngineConfig, InferenceEngine, SpeculationConfig
-from ray_tpu.serve.spec_decode import _ngram_lookup
+from ray_tpu.serve.spec_decode import (
+    NGramProposer,
+    _batch_ngram_lookup,
+    _ngram_lookup,
+)
 
 
 @pytest.fixture(params=["xla", "pallas"])
@@ -99,6 +103,121 @@ class TestNGramLookup:
         ctx = np.array([1, 9, 9, 4, 4, 1], np.int32)
         out = _ngram_lookup(ctx, nmin=1, nmax=1, k=4)
         assert out.tolist() == [9, 9, 4, 4]
+
+
+class TestBatchNGramLookup:
+    def test_matches_scalar_lookup_randomized(self):
+        # the vectorized batch lookup must agree row-for-row with the
+        # unit-pinned scalar lookup across random small-vocab contexts
+        # (small vocab => plenty of suffix collisions to exercise the
+        # longest-n / most-recent / truncation tie-breaks)
+        rng = np.random.default_rng(0)
+        B, cap, k = 8, 48, 4
+        for trial in range(6):
+            ctx = np.zeros((B, cap), np.int32)
+            lens = np.zeros((B,), np.int64)
+            active = np.ones((B,), bool)
+            active[trial % B] = False  # one inactive row per trial
+            for i in range(B):
+                L = int(rng.integers(2, cap + 1))
+                ctx[i, :L] = rng.integers(0, 6, size=L)
+                lens[i] = L
+            drafts, n = _batch_ngram_lookup(ctx, lens, active, 1, 4, k)
+            for i in range(B):
+                if not active[i]:
+                    assert n[i] == 0
+                    continue
+                ref = _ngram_lookup(ctx[i, : lens[i]], 1, 4, k)
+                assert n[i] == ref.size, (trial, i)
+                assert drafts[i, : n[i]].tolist() == ref.tolist(), (trial, i)
+
+    def test_inactive_rows_never_draft(self):
+        ctx = np.tile(np.array([5, 6, 5, 6, 5, 6], np.int32), (2, 1))
+        lens = np.array([6, 6], np.int64)
+        drafts, n = _batch_ngram_lookup(
+            ctx, lens, np.array([True, False]), 1, 4, 4)
+        assert n[0] > 0 and n[1] == 0
+        assert not drafts[1].any()
+
+    def test_no_match_rows_zero(self):
+        ctx = np.array([[1, 2, 3, 4, 5, 0]], np.int32)
+        _, n = _batch_ngram_lookup(
+            ctx, np.array([5], np.int64), np.array([True]), 2, 4, 4)
+        assert n[0] == 0
+
+
+class _StubEngine:
+    """The minimal engine surface NGramProposer touches: ecfg dims plus
+    the slots list (objects with .request)."""
+
+    class _Ecfg:
+        max_batch_size = 4
+        max_seq_len = 64
+
+    class _Slot:
+        def __init__(self):
+            self.request = None
+
+    class _Req:
+        def __init__(self, rid, prompt):
+            self.request_id = rid
+            self.prompt = list(prompt)
+            self.output = []
+
+    def __init__(self):
+        self.ecfg = self._Ecfg()
+        self.slots = [self._Slot() for _ in range(4)]
+
+
+class TestProposerHygiene:
+    """A cancelled/evicted request's context must never influence a
+    successor's proposals (the satellite regression for proposer state
+    hygiene on eviction)."""
+
+    REPETITIVE = [7, 8, 7, 8, 7, 8, 7]   # guaranteed ngram match
+    BLAND = [1, 2, 3]                     # guaranteed no match
+
+    def _tokens(self, eng):
+        B = eng.ecfg.max_batch_size
+        return np.zeros((B,), np.int32), np.zeros((B,), np.int32)
+
+    def test_evicted_context_never_leaks_to_successor(self):
+        prop = NGramProposer(SpeculationConfig(mode="ngram"))
+        eng = _StubEngine()
+        eng.slots[0].request = _StubEngine._Req("req-A", self.REPETITIVE)
+        _, n = prop.propose(eng, *self._tokens(eng))
+        assert n[0] > 0  # predecessor really was drafting
+        prop.on_evict(eng, 0)
+        eng.slots[0].request = _StubEngine._Req("req-B", self.BLAND)
+        drafts, n = prop.propose(eng, *self._tokens(eng))
+        assert n[0] == 0, "evicted request's context leaked into successor"
+        assert not drafts[0].any()
+
+    def test_slot_reuse_without_evict_reseeds_by_request_id(self):
+        # even if the engine never called on_evict (crash path), the
+        # request_id stamp must force a reseed for the new occupant
+        prop = NGramProposer(SpeculationConfig(mode="ngram"))
+        eng = _StubEngine()
+        eng.slots[0].request = _StubEngine._Req("req-A", self.REPETITIVE)
+        _, n = prop.propose(eng, *self._tokens(eng))
+        assert n[0] > 0
+        eng.slots[0].request = _StubEngine._Req("req-B", self.BLAND)
+        _, n = prop.propose(eng, *self._tokens(eng))
+        assert n[0] == 0
+
+    def test_incremental_append_tracks_output(self):
+        prop = NGramProposer(SpeculationConfig(mode="ngram"))
+        eng = _StubEngine()
+        req = _StubEngine._Req("req-A", self.BLAND)
+        eng.slots[0].request = req
+        _, n = prop.propose(eng, *self._tokens(eng))
+        assert n[0] == 0
+        # the OUTPUT develops a repeating motif: the incremental append
+        # must pick it up without a reinstall
+        req.output.extend([4, 5, 4, 5, 4])
+        drafts, n = prop.propose(eng, *self._tokens(eng))
+        assert n[0] > 0
+        assert drafts[0, 0] == 5  # continuation after most recent [4]
 
 
 class TestVerifyOp:
@@ -274,16 +393,37 @@ class TestEngineSpeculation:
     def test_step_phase_metrics_observed(self):
         from ray_tpu.serve.engine import _m_step_phase
 
+        phases = ("propose", "propose_wait", "propose_compute", "verify",
+                  "sample", "cache_bookkeeping", "cancellation_check")
         before = {
             ph: _m_step_phase.count({"phase": ph, "mode": "spec"})
-            for ph in ("propose", "verify", "sample", "cache_bookkeeping",
-                       "cancellation_check")
+            for ph in phases
         }
+        # draft-self speculation proposes k drafts EVERY round, so every
+        # decode step is a spec round (an ngram engine may propose nothing
+        # and legitimately fall back to the plain span, observed under
+        # mode="plain" — no spec-mode verify/sample to count)
         spec_eng, _ = self._engine(
-            spec={"mode": "ngram", "num_speculative_tokens": 2})
+            spec={"mode": "draft", "num_speculative_tokens": 2})
         self._greedy(spec_eng, [self.PROMPTS[0]], max_tokens=8)
         for ph, n0 in before.items():
             assert _m_step_phase.count({"phase": ph, "mode": "spec"}) > n0, ph
+
+    def test_zero_draft_round_falls_back_to_plain_span(self):
+        from ray_tpu.serve.engine import _m_step_phase
+
+        before = _m_step_phase.count({"phase": "verify", "mode": "plain"})
+        spec_eng, _ = self._engine(
+            spec={"mode": "ngram", "num_speculative_tokens": 4})
+        plain_eng, _ = self._engine()
+        # no repeated suffix anywhere: every round proposes zero drafts,
+        # so the spec engine must decode entirely through plain spans —
+        # and still match the plain engine token-for-token
+        outs_s = self._greedy(spec_eng, [self.PROMPTS[0]], max_tokens=8)
+        outs_p = self._greedy(plain_eng, [self.PROMPTS[0]], max_tokens=8)
+        assert outs_s == outs_p
+        assert _m_step_phase.count(
+            {"phase": "verify", "mode": "plain"}) > before
 
     def test_draft_vocab_mismatch_rejected(self):
         with pytest.raises(ValueError, match="tokenizer"):
