@@ -40,7 +40,7 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	broadcast fleet rl tsan shm lint spec-smoke \
+	broadcast fleet rl tsan shm lint spec-smoke shard-smoke \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
 	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet \
 	bench-rl bench-spec
@@ -146,7 +146,15 @@ spec-smoke:
 	$(PYTEST) $(FAST) tests/test_spec_decode.py \
 		-k "greedy_on_equals_off and ngram"
 
-check: shm lint spec-smoke
+# fast 3D-parallelism smoke: one sharded-stage parity run (dp=2 submesh
+# under the 2-stage pipeline) plus the schedule-generator units — seconds,
+# not the full pipeline matrix
+shard-smoke:
+	@echo "== sharding smoke: sharded-stage parity + interleave units =="
+	$(PYTEST) $(FAST) tests/test_pipeline_trainer.py \
+		-k "TestInterleavedSchedule or (sharded_matches_replicated and dp)"
+
+check: shm lint spec-smoke shard-smoke
 	@echo "== chunk 1/3: core runtime =="
 	$(PYTEST) $(FAST) $(CORE_TESTS)
 	@echo "== chunk 2/3: libraries (data/train/tune/rl/serve) =="

@@ -138,6 +138,11 @@ def status(address: str = "", as_dict: bool = False):
             v = goodput.get(part)
             if v:
                 lines.append(f"  {part}: {v:.2f}s")
+        kinds = {k[len("bubble_"):]: goodput[k] for k in goodput
+                 if k.startswith("bubble_") and goodput[k]}
+        if kinds:
+            lines.append("  bubble by kind: " + " ".join(
+                f"{k}={kinds[k]:.2f}s" for k in sorted(kinds)))
     objects = payload.get("objects", {})
     if objects and objects.get("nodes"):
         leak_counts = objects.get("leak_counts", {})

@@ -408,6 +408,34 @@ declare(
     "(blocking work under a lock) records a hold-time violation.",
 )
 
+# Pipeline-parallel trainer (train/pipeline.py)
+declare(
+    "pipeline_virtual_stages", 1,
+    "Interleaved 1F1B: number of non-contiguous layer slices (model "
+    "chunks) each pipeline stage worker owns. v>1 shrinks the "
+    "warmup/drain bubble by ~v x at the cost of v x more cross-stage "
+    "activation traffic. LMStageModule picks this up as its default "
+    "when virtual_stages is not given explicitly; requires "
+    "n_layers %% (num_stages * v) == 0 and microbatches %% stages == 0.",
+)
+declare(
+    "stage_mesh_axes", "",
+    "In-stage SPMD mesh for each pipeline stage gang, e.g. 'dp=2,tp=2' "
+    "or 'fsdp=4'. Stage params are laid out by the regex partition "
+    "rules in parallel/sharding.py (STAGE_PARTITION_RULES) onto a "
+    "per-stage jax Mesh and forward/backward compile under it with "
+    "activation sharding constraints. Empty = no in-stage sharding. "
+    "Skipped with an info log when jax.device_count() is too small.",
+)
+declare(
+    "pipeline_overlap_grad_exchange", True,
+    "Overlap step N's dp grad exchange + optimizer update with step "
+    "N+1's warmup forwards: apply_update runs on a background thread "
+    "per worker and the next compute_grads fences on a per-leaf "
+    "version check before touching params. Off = the synchronous "
+    "update of PR 8.",
+)
+
 
 class Config:
     """Resolved configuration view. Thread-safe."""

@@ -183,6 +183,11 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "bubble {{stage}}",
         "refId": "B",
     })
+    profiling["panels"][0]["targets"].append({
+        "expr": "rate(train_pipeline_bubble_seconds[5m])",
+        "legendFormat": "bubble {{kind}}",
+        "refId": "C",
+    })
     objects = _dashboard("raytpu-objects", "ray_tpu / object plane", [
         _panel("Live bytes per node/store", "object_store_live_bytes",
                0, 0, unit="bytes", legend="{{node}} {{store}}"),

@@ -385,6 +385,32 @@ def _lm_head(x, params, cfg) -> jax.Array:
     return constrain(logits, ("batch", "seq", "vocab"))
 
 
+def run_layers(
+    layer_params: Params,
+    x: jax.Array,
+    cfg: ModelConfig,
+    rope_tables,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scan a stacked layer slice: ({leaf: [L', ...]}, x) -> (x, aux_sum).
+
+    The slice need not be the full depth — pipeline stages (and interleaved
+    virtual chunks, which own several non-contiguous slices) scan whatever
+    leading-axis window of the stacked layer leaves they were assigned; the
+    math is position-independent because rope tables / positions come in
+    from the caller. One compiled scan regardless of slice length.
+    """
+
+    def body(carry, lp):
+        y, aux = _block(carry, lp, cfg, rope_tables, positions)
+        return y, aux
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, aux = jax.lax.scan(body, x, layer_params)
+    return x, jnp.sum(aux)
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -393,15 +419,8 @@ def forward(
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens [B, T] -> (logits [B, T, V] f32, aux_loss scalar)."""
     x, rope_tables = _prologue(params, tokens, cfg, positions)
-
-    def body(carry, lp):
-        y, aux = _block(carry, lp, cfg, rope_tables, positions)
-        return y, aux
-
-    if cfg.remat:
-        body = jax.checkpoint(body)
-    x, aux = jax.lax.scan(body, x, params["layers"])
-    return _lm_head(x, params, cfg), jnp.sum(aux)
+    x, aux = run_layers(params["layers"], x, cfg, rope_tables, positions)
+    return _lm_head(x, params, cfg), aux
 
 
 def forward_pp(

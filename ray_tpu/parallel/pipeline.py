@@ -17,11 +17,129 @@ one mesh with dp/fsdp/tp inside each stage.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, List, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B schedule (Megatron-LM virtual pipeline stages), shared by
+# the SPMD formulation below and the MPMD StageWorker gangs in
+# train/pipeline.py. Pure functions — unit-testable without any runtime.
+# ---------------------------------------------------------------------------
+
+ScheduleEntry = Tuple[str, int, int]  # ("F"|"B", local_chunk, microbatch)
+
+
+def interleaved_schedule(
+    num_stages: int, virtual: int, num_microbatches: int, rank: int
+) -> List[ScheduleEntry]:
+    """One worker's 1F1B schedule, generalized to `virtual` model chunks.
+
+    Worker `rank` owns global chunks {rank + j*num_stages} (local index j);
+    depth order of the model is global chunk 0..S*v-1. v=1 reduces to the
+    classic 1F1B (warmup = S-1-rank); v>1 is Megatron's interleave: warmup
+    grows to (S-rank-1)*2 + (v-1)*S forwards but each unit is a 1/v-depth
+    chunk, so the fill/drain *bubble* shrinks ~v x. Entries are ("F"|"B",
+    local_chunk, microbatch); requires num_microbatches % num_stages == 0
+    when v > 1.
+    """
+    S, v, M = num_stages, virtual, num_microbatches
+    if v > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible by "
+            f"stages ({S})")
+    total = M * v
+    if v == 1:
+        warm = min(S - 1 - rank, M)
+    else:
+        warm = min((S - rank - 1) * 2 + (v - 1) * S, total)
+
+    def fwd_unit(i: int) -> Tuple[int, int]:
+        g, r = divmod(i, S)
+        return g % v, (g // v) * S + r
+
+    def bwd_unit(i: int) -> Tuple[int, int]:
+        g, r = divmod(i, S)
+        return v - 1 - (g % v), (g // v) * S + r
+
+    sched: List[ScheduleEntry] = []
+    for i in range(warm):
+        c, m = fwd_unit(i)
+        sched.append(("F", c, m))
+    for i in range(warm, total):
+        c, m = fwd_unit(i)
+        sched.append(("F", c, m))
+        c, m = bwd_unit(i - warm)
+        sched.append(("B", c, m))
+    for i in range(total - warm, total):
+        c, m = bwd_unit(i)
+        sched.append(("B", c, m))
+    return sched
+
+
+def validate_interleaved(
+    num_stages: int, virtual: int, num_microbatches: int, capacity: int
+) -> None:
+    """Simulate the gang's schedules against FIFO stage-to-stage channels.
+
+    The MPMD trainer moves activations/grad-cotangents over strictly-FIFO
+    SPSC channels, so the schedule is only runnable if every consumer's
+    expected (chunk, microbatch) order equals its producer's send order AND
+    no channel exceeds `capacity` frames in flight. Raises ValueError with
+    the stuck state otherwise — a config-time guard, not a runtime cost.
+    """
+    S, v, M = num_stages, virtual, num_microbatches
+    C = S * v
+    scheds = [interleaved_schedule(S, v, M, w) for w in range(S)]
+    cursors = [0] * S
+    acts: List[List[Tuple[int, int]]] = [[] for _ in range(S)]  # inbox of w
+    grads: List[List[Tuple[int, int]]] = [[] for _ in range(S)]
+
+    def try_advance(w: int) -> bool:
+        if cursors[w] >= len(scheds[w]):
+            return False
+        kind, j, mb = scheds[w][cursors[w]]
+        c = j * S + w
+        if kind == "F":
+            if c > 0:  # needs the act produced by chunk c-1
+                if not acts[w] or acts[w][0] != (c - 1, mb):
+                    return False
+            # fused loss chunk emits its grad at F time (see StageWorker)
+            emit_grad = c == C - 1 and c > 0
+            out_full = (len(acts[(w + 1) % S]) >= capacity and c < C - 1)
+            grad_full = (emit_grad and len(grads[(w - 1) % S]) >= capacity)
+            if out_full or grad_full:
+                return False
+            if c > 0:
+                acts[w].pop(0)
+            if c < C - 1:
+                acts[(w + 1) % S].append((c, mb))
+            if emit_grad:
+                grads[(w - 1) % S].append((c - 1, mb))
+        else:
+            if c == C - 1:  # fused at F — backward slot is a no-op
+                cursors[w] += 1
+                return True
+            if not grads[w] or grads[w][0] != (c, mb):
+                return False
+            if c > 0 and len(grads[(w - 1) % S]) >= capacity:
+                return False
+            grads[w].pop(0)
+            if c > 0:
+                grads[(w - 1) % S].append((c - 1, mb))
+        cursors[w] += 1
+        return True
+
+    while any(cursors[w] < len(scheds[w]) for w in range(S)):
+        if not any(try_advance(w) for w in range(S)):
+            stuck = {w: (scheds[w][cursors[w]] if cursors[w] < len(scheds[w])
+                         else "done") for w in range(S)}
+            raise ValueError(
+                f"interleaved schedule deadlocks for stages={S} v={v} "
+                f"microbatches={M} capacity={capacity}: stuck at {stuck}")
 
 
 def pipeline_apply(

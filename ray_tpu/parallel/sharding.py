@@ -154,3 +154,103 @@ def shard_tree(params: Any, axes_tree: Any, mesh: Mesh, rules: Optional[Rules] =
     """Device-put a pytree of host arrays to its sharded layout."""
     shardings = tree_shardings(axes_tree, mesh, rules)
     return jax.tree.map(lambda p, s: jax.device_put(p, s), params, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Regex partition rules (fmengine/EasyLM lineage): map *parameter paths* to
+# PartitionSpecs, first match wins. Complements the logical-axis rules above:
+# logical axes need the model to annotate every array; path rules shard an
+# existing checkpoint-shaped flat dict ("layers/wq", "embed", ...) without
+# touching model code — which is what the pipeline StageWorker has in hand.
+# ---------------------------------------------------------------------------
+
+PathRules = Tuple[Tuple[str, PartitionSpec], ...]
+
+# Stage-local mesh rules for the LM pipeline trainer: per-layer leaves carry a
+# leading stacked-layer axis (always replicated — it is scanned over), then
+# megatron-style column/row splits over tp with fsdp on the complementary dim.
+STAGE_PARTITION_RULES: PathRules = (
+    (r"(^|/)layers/(wq|wk|wv)$", PartitionSpec(None, "fsdp", "tp", None)),
+    (r"(^|/)layers/wo$", PartitionSpec(None, "tp", None, "fsdp")),
+    (r"(^|/)layers/(w_in|w_gate)$", PartitionSpec(None, "fsdp", "tp")),
+    (r"(^|/)layers/w_out$", PartitionSpec(None, "tp", "fsdp")),
+    (r"(^|/)layers/b_in$", PartitionSpec(None, "tp")),
+    (r"(^|/)layers/", PartitionSpec()),  # norms, biases: replicated
+    (r"(^|/)embed$", PartitionSpec("tp", "fsdp")),
+    (r"(^|/)lm_head$", PartitionSpec("fsdp", "tp")),
+    (r"(^|/)pos_emb$", PartitionSpec(None, "fsdp")),
+    (r"(^|/)final_norm", PartitionSpec()),
+)
+
+
+def match_partition_rules(
+    rules: PathRules, flat_params: Dict[str, Any]
+) -> Dict[str, PartitionSpec]:
+    """'/'-joined param path → PartitionSpec via regex search, first match wins.
+
+    Scalars (ndim 0) short-circuit to a replicated spec; a non-scalar leaf no
+    rule matches is an error — silent replication is how sharding plans rot.
+    """
+    import re
+
+    out: Dict[str, PartitionSpec] = {}
+    for path, leaf in flat_params.items():
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0:
+            out[path] = PartitionSpec()
+            continue
+        for pat, spec in rules:
+            if re.search(pat, path):
+                out[path] = spec
+                break
+        else:
+            raise ValueError(f"no partition rule matches param path {path!r}")
+    return out
+
+
+def parse_mesh_axes(text: str) -> Dict[str, int]:
+    """Parse a 'dp=2,tp=2'-style mesh spec into {axis: size} (ordered)."""
+    axes: Dict[str, int] = {}
+    for part in (text or "").replace(" ", "").split(","):
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad mesh axis {part!r} in {text!r} (want name=size)")
+        name, size = part.split("=", 1)
+        axes[name] = int(size)
+    return axes
+
+
+def stage_param_shardings(
+    flat_params: Dict[str, Any],
+    mesh: Mesh,
+    rules: Optional[PathRules] = None,
+) -> Dict[str, NamedSharding]:
+    """NamedSharding per stage-param path, degraded where shapes forbid it.
+
+    Specs come from regex rules filtered to the axes this mesh actually has;
+    any dim whose size is not divisible by its assigned axes falls back to
+    replicated for that dim (tiny test models have odd head counts) rather
+    than erroring inside device_put.
+    """
+    specs = match_partition_rules(rules or STAGE_PARTITION_RULES, flat_params)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out: Dict[str, NamedSharding] = {}
+    for path, leaf in flat_params.items():
+        spec = _filter_spec_for_mesh(specs[path], mesh)
+        shape = getattr(leaf, "shape", ())
+        parts = []
+        for d, entry in enumerate(spec):
+            if entry is None:
+                parts.append(None)
+                continue
+            cand = (entry,) if isinstance(entry, str) else tuple(entry)
+            n = 1
+            for a in cand:
+                n *= sizes.get(a, 1)
+            if d >= len(shape) or shape[d] % n != 0:
+                parts.append(None)
+            else:
+                parts.append(entry)
+        out[path] = NamedSharding(mesh, PartitionSpec(*parts))
+    return out

@@ -107,3 +107,126 @@ def leaf_sq_norms(flat: Dict[str, Any]) -> Dict[str, float]:
         for path, v in ((p, np.asarray(x, dtype=np.float32))
                         for p, x in flat.items())
     }
+
+
+# ---------------------------------------------------------------------------
+# In-XLA collectives (tentpole of the 3D-parallelism PR): when every rank of
+# a dp group lives in ONE process sharing a jax Mesh, the reduce-scatter /
+# all-gather above stop riding DistChannel frames and become a single
+# psum_scatter / all_gather pair inside XLA. The whole-leaf ownership
+# partition is preserved by packing each rank's owned leaves into a
+# contiguous REGION of one flat f32 vector, padding every region to the
+# largest region size Q: psum_scatter over [world, world*Q] then hands rank
+# r exactly the summed bytes of its own leaves (region boundaries == shard
+# boundaries), so the downstream per-leaf optimizer step — and therefore the
+# numerics — are IDENTICAL to the channel path. The channel path stays as
+# the cross-host fallback.
+# ---------------------------------------------------------------------------
+
+
+class RegionLayout:
+    """Owner-ordered packing plan for a flat {path: leaf} dict.
+
+    Rank r's region spans [r*Q, r*Q + region_size[r]) of a world*Q vector,
+    holding its owned leaves raveled in sorted-path order; the remainder of
+    each region is zero padding. Deterministic given (assignment, shapes).
+    """
+
+    def __init__(self, flat: Dict[str, Any], assignment: Dict[str, int],
+                 world: int) -> None:
+        self.world = world
+        self.shapes = {p: tuple(np.asarray(v).shape) for p, v in flat.items()}
+        self.paths_by_rank: List[List[str]] = [
+            sorted(p for p in flat if assignment[p] == r) for r in range(world)
+        ]
+        sizes = {p: int(np.prod(self.shapes[p], dtype=np.int64)) or 1
+                 for p in flat}
+        self.sizes = sizes
+        self.region_size = [sum(sizes[p] for p in paths)
+                            for paths in self.paths_by_rank]
+        self.q = max(1, max(self.region_size) if self.region_size else 1)
+        self.offsets: Dict[str, int] = {}
+        for r, paths in enumerate(self.paths_by_rank):
+            off = r * self.q
+            for p in paths:
+                self.offsets[p] = off
+                off += sizes[p]
+
+    @property
+    def length(self) -> int:
+        return self.world * self.q
+
+    def pack(self, flat: Dict[str, Any]) -> np.ndarray:
+        """Full flat dict -> [world*Q] f32 vector (all regions populated)."""
+        vec = np.zeros(self.length, dtype=np.float32)
+        for p, off in self.offsets.items():
+            a = np.asarray(flat[p], dtype=np.float32).ravel()
+            vec[off:off + a.size] = a
+        return vec
+
+    def unpack_rank(self, segment: np.ndarray, rank: int) -> Dict[str, Any]:
+        """Rank's [Q] segment -> its owned {path: leaf} dict."""
+        out: Dict[str, Any] = {}
+        off = 0
+        for p in self.paths_by_rank[rank]:
+            n = self.sizes[p]
+            out[p] = np.asarray(segment[off:off + n],
+                                dtype=np.float32).reshape(self.shapes[p])
+            off += n
+        return out
+
+    def pack_rank(self, owned: Dict[str, Any], rank: int) -> np.ndarray:
+        """Owned {path: leaf} -> the rank's padded [Q] segment."""
+        seg = np.zeros(self.q, dtype=np.float32)
+        off = 0
+        for p in self.paths_by_rank[rank]:
+            a = np.asarray(owned[p], dtype=np.float32).ravel()
+            seg[off:off + a.size] = a
+            off += a.size
+        return seg
+
+    def unpack_full(self, vec: np.ndarray) -> Dict[str, Any]:
+        """Gathered [world*Q] vector -> the full {path: leaf} dict."""
+        out: Dict[str, Any] = {}
+        for p, off in self.offsets.items():
+            n = self.sizes[p]
+            out[p] = np.asarray(vec[off:off + n],
+                                dtype=np.float32).reshape(self.shapes[p])
+        return out
+
+
+def make_inxla_collectives(mesh: Any, axis: str, world: int):
+    """(reduce_scatter_mean, all_gather) jitted over a `world`-way mesh axis.
+
+    reduce_scatter_mean: [world, world*Q] stacked per-rank packed grads ->
+    [world, Q] where row r is the group-MEAN of rank r's region. all_gather:
+    [world, Q] updated regions -> [world*Q] reassembled vector. Both are
+    shard_map bodies so the collective compiles to one XLA op; /world after
+    a 2-rank psum is an exact halving, matching group_mean bit-for-bit.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops.dispatch import shard_map_compat
+
+    in_shard = NamedSharding(mesh, P(axis, None))
+
+    def _rs_body(x):  # local [1, world*Q]
+        seg = jax.lax.psum_scatter(x[0], axis, scatter_dimension=0, tiled=True)
+        return (seg / np.float32(world))[None]
+
+    rs = jax.jit(shard_map_compat(_rs_body, mesh, P(axis, None),
+                                  P(axis, None)))
+
+    def _ag_body(x):  # local [1, Q]
+        return jax.lax.all_gather(x[0], axis, tiled=True)
+
+    ag = jax.jit(shard_map_compat(_ag_body, mesh, P(axis, None), P()))
+
+    def reduce_scatter_mean(stacked: np.ndarray) -> np.ndarray:
+        return np.asarray(rs(jax.device_put(jnp.asarray(stacked), in_shard)))
+
+    def all_gather(segments: np.ndarray) -> np.ndarray:
+        return np.asarray(ag(jax.device_put(jnp.asarray(segments), in_shard)))
+
+    return reduce_scatter_mean, all_gather

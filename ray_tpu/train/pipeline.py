@@ -10,25 +10,62 @@ model, and the stages exchange activation/gradient tensors at microbatch
 granularity through bounded `DistChannel`s — channel capacity IS the
 backpressure that paces a fast producer stage to its consumer.
 
+This is 3D parallelism: the pipeline (MPMD, above) composes with in-stage
+SPMD sharding and data parallelism.
+
+  * In-stage SPMD (`stage_mesh_axes`, e.g. "dp=2,tp=2"): each StageWorker
+    builds a per-stage `jax.Mesh` and lays its param slice out by the
+    regex partition rules in `parallel/sharding.py`
+    (`STAGE_PARTITION_RULES`, the match-rules grammar of fmengine/EasyLM
+    lineage). Forward/backward jit under that mesh with
+    `with_sharding_constraint` on the stage-boundary activations, so XLA
+    inserts the tp/fsdp collectives inside the stage while the MPMD
+    schedule streams between stages. Too few devices -> the mesh is
+    skipped with an info log and the stage runs unsharded (identical
+    numerics, the parity tests' baseline).
+
+  * Interleaved virtual stages (`virtual_stages` v > 1, Megatron-style):
+    worker w owns the v NON-contiguous layer chunks {w + j*S}; the 1F1B
+    schedule generalizes to `parallel.pipeline.interleaved_schedule`,
+    shrinking the warmup/drain bubble ~v x. Channels become a ring (every
+    worker has an act/grad inbox); frames carry (chunk, microbatch) tags
+    and a config-time simulator (`validate_interleaved`) proves the
+    schedule deadlock-free against the FIFO channels before any actor is
+    spawned.
+
+  * Data parallelism (`dp=R`) with optional ZeRO-1: replicas of one stage
+    exchange gradients either over pairwise channels (cross-host), or —
+    when the gang is in-process and the jax runtime has >= R devices —
+    through IN-XLA collectives: grads pack into per-owner regions
+    (`zero.RegionLayout`) and one psum_scatter/all_gather pair replaces
+    the whole frame exchange, with numerics asserted identical to the
+    channel path (region boundaries == shard boundaries, so the per-leaf
+    optimizer math is untouched). The channel path remains the cross-host
+    fallback.
+
 Topology for `num_stages=S, dp=R`: S x R `StageWorker`s. Worker (si, r)
-streams activations to (si+1, r) and gradients back to (si-1, r) on a
-1F1B schedule (`n_warmup = S-1-si` forwards in flight, then strict
-forward/backward alternation — the steady-state memory profile holds only
-`n_warmup+1` microbatch inputs, and the backward recomputes the stage
-forward under jit rather than stashing residuals). Replicas of one stage
-form a data-parallel group that exchanges gradients over pairwise
-channels: either a full all-reduce, or — with `zero1=True` — a
-reduce-scatter so each replica updates only the param leaves it owns
-(optimizer state sharded R-ways, arXiv:2004.13336) followed by an
-all-gather of the updated leaves. Both paths accumulate in ascending rank
-order, so ZeRO-1 on/off is bit-identical (tested).
+streams activations to ((si+1)%S, r) and gradients back to ((si-1)%S, r)
+on the (interleaved) 1F1B schedule. With `remat=False` the backward does
+NOT recompute the stage forward: the forward stashes the vjp residuals
+per in-flight microbatch (`jax.closure_convert` hoists them out of the
+jitted forward), which removes the 3.5/3 recompute work inflation; with
+`remat=True` the classic stash-only-the-input recompute profile is kept.
+
+Gradient exchange overlaps the next step (`overlap_grad_exchange`): the
+optimizer update (+ ZeRO all-gather) runs on a background thread per
+worker while the next step's warmup forwards proceed; `compute_grads`
+fences on the update thread and a per-leaf param-version check before
+touching params, so overlap is observationally identical to the
+synchronous path (update wall time is attributed to the NEXT step's
+report — a one-step smear).
 
 Global-norm gradient clipping needs the WHOLE model's norm, which no
 single stage holds: stages run their optimizer unclipped
-(`make_optimizer(grad_clip=None)`), report per-leaf squared norms, and
-the driver folds them — summed in one canonical path order so sharded and
-replicated runs see the identical float — into one `gnorm` that every
-worker applies as optax's clip scale in `apply_update`.
+(`make_optimizer(grad_clip=None)`), report per-leaf squared norms under
+CANONICAL keys — split leaves per GLOBAL layer row ("layer0007/layers/wq")
+so the fold is invariant to S, v, dp, and sharding — and the driver sums
+them in sorted-key order into one `gnorm` every worker applies as optax's
+clip scale.
 
 Model partitioning is declarative, mirroring `parallel/sharding.py`'s
 match-rules grammar but over PARAM PATHS -> stage placements:
@@ -39,23 +76,27 @@ match-rules grammar but over PARAM PATHS -> stage placements:
         (r"^(final_norm|final_norm_b|lm_head)$", "last"),
     )
 
-`"split"` slices the stacked-layer leading axis into contiguous blocks;
-`"first"`/`"last"`/an int pin a leaf to one stage. Unmatched params are an
-error — silent replication is how pipeline parity bugs are born.
+`"split"` slices the stacked-layer leading axis into contiguous blocks
+(per CHUNK when v > 1); `"first"`/`"last"`/an int pin a leaf to one
+chunk. Unmatched params are an error — silent replication is how
+pipeline parity bugs are born.
 
 Fault tolerance mirrors `JaxTrainer.fit`: per-stage checkpoints through
 `train/checkpoint.py` (each worker saves `stage{si}_dp{r}` under one
 checkpoint dir), and on any failure — a dead gang member surfaces as
 `RayActorError`, a severed channel as `PipelineStallError` (every blocked
-recv/put carries a deadline; nothing hangs on a dead peer) — the driver
-tears the gang down and restarts from the latest checkpoint up to
+recv/put carries a deadline; nothing hangs on a dead peer; a broken
+in-XLA rendezvous barrier raises the same) — the driver tears the gang
+down and restarts from the latest checkpoint up to
 `FailureConfig.max_failures`, else raises `TrainingFailedError`.
 
-Observability: `train_pipeline_bubble_fraction` (driver gauge),
-`train_stage_step_seconds{stage}` (worker histogram + SLO digest), and a
-traced step yields the full timeline — `pipeline.step` over per-worker
-`pipeline.stage_step` spans with the `channel_send`/`channel_recv` legs
-nested inside.
+Observability: `train_pipeline_bubble_fraction` (driver gauge, normalized
+by min(workers, cores) so an oversubscribed in-process gang is not billed
+for time it could never have used), `train_pipeline_bubble_seconds{kind}`
+(counter decomposing the bubble into warmup / drain / channel_wait /
+grad_exchange), `train_stage_step_seconds{stage}` (worker histogram + SLO
+digest), and a traced step yields the full timeline — `pipeline.step`
+over per-worker `pipeline.stage_step` spans.
 """
 
 from __future__ import annotations
@@ -65,6 +106,7 @@ import math
 import os
 import queue
 import re
+import threading
 import time
 import uuid
 from collections import deque
@@ -74,9 +116,10 @@ import numpy as np
 
 from .. import api
 from ..core.logging import get_logger
-from ..core.metrics import Gauge, Histogram
+from ..core.metrics import Counter, Gauge, Histogram
 from ..models import ModelConfig, init_params, loss_from_logits
 from ..parallel import zero
+from ..parallel.pipeline import interleaved_schedule, validate_interleaved
 from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
 from .config import RunConfig
 from .result import Result
@@ -92,6 +135,17 @@ _bubble_gauge = Gauge(
 _stage_step_hist = Histogram(
     "train_stage_step_seconds",
     "Per-stage wall time of one pipeline step (all microbatches).",
+)
+
+# Where the bubble went, per step: time blocked during the leading warmup
+# forwards, the trailing drain backwards, steady-state channel waits, and
+# the dp gradient exchange + (overlapped) optimizer update.
+BUBBLE_KINDS = ("warmup", "drain", "channel_wait", "grad_exchange")
+
+_bubble_seconds = Counter(
+    "train_pipeline_bubble_seconds",
+    "Cumulative seconds stage workers spent blocked, decomposed by kind "
+    "(warmup | drain | channel_wait | grad_exchange).",
 )
 
 
@@ -149,9 +203,9 @@ def split_stage_params(
     num_stages: int,
     rules: Sequence[Tuple[str, Any]] = DEFAULT_STAGE_RULES,
 ) -> List[Dict[str, np.ndarray]]:
-    """Full flat param dict -> one flat dict per stage. "split" leaves are
-    sliced into contiguous blocks along their stacked-layer leading axis
-    (stage s gets rows [s*L/S, (s+1)*L/S))."""
+    """Full flat param dict -> one flat dict per stage (or per chunk, when
+    called with num_stages = S*v). "split" leaves are sliced into
+    contiguous blocks along their stacked-layer leading axis."""
     placements = match_stage_rules(rules, flat_params, num_stages)
     stages: List[Dict[str, np.ndarray]] = [{} for _ in range(num_stages)]
     for path, leaf in flat_params.items():
@@ -187,6 +241,153 @@ def _nest(flat: Dict[str, Any]) -> Dict[str, Any]:
     return tree
 
 
+def _make_split_pair(f):
+    """(fwd, bwd) jitted pair around `f(params, x) -> y` that stashes the
+    vjp RESIDUALS instead of recomputing the forward in the backward.
+
+    `jax.closure_convert` hoists the residual arrays out of the vjp
+    closure at trace time; the converted (pure) callable lands in a python
+    cell the jitted backward closes over. Residuals must be float —
+    integer operands (token ids) leak as tracers, which is why the
+    chunk-0 embedding prologue is split off before this pair is built.
+    Bit-identical to the recompute path; bwd is first traced after fwd.
+    """
+    import jax
+
+    cell: Dict[str, Any] = {}
+
+    @jax.jit
+    def fwd(p, x):
+        y, vjp = jax.vjp(f, p, x)
+        pure, res = jax.closure_convert(vjp, y)
+        cell["vjp"] = pure
+        return y, list(res)
+
+    @jax.jit
+    def bwd(res, g):
+        return cell["vjp"](g, *res)
+
+    return fwd, bwd
+
+
+def _make_chunk0_pair(embed_fn, trunk_fn):
+    """The chunk-0 variant of `_make_split_pair`: the int-token embedding
+    prologue stays OUT of the residual-stashed trunk vjp (its operands
+    would leak as integer tracers through closure_convert) but runs
+    INSIDE the same jitted programs — one dispatch per direction instead
+    of the two the separate pro/pro_bwd kernels cost.
+
+    fwd(pro_params, trunk_params, tokens) -> (y, residuals)
+    bwd(pro_params, tokens, residuals, g) -> (d_trunk, d_pro)
+    """
+    import jax
+
+    cell: Dict[str, Any] = {}
+
+    @jax.jit
+    def fwd(pp, pt, tok):
+        x0 = embed_fn(pp, tok)
+        y, vjp = jax.vjp(trunk_fn, pt, x0)
+        pure, res = jax.closure_convert(vjp, y)
+        cell["vjp"] = pure
+        return y, list(res)
+
+    @jax.jit
+    def bwd(pp, tok, res, g):
+        dpt, dx0 = cell["vjp"](g, *res)
+        _, vjp = jax.vjp(lambda q: embed_fn(q, tok), pp)
+        return dpt, vjp(dx0)[0]
+
+    return fwd, bwd
+
+
+# ---------------------------------------------------------------------------
+# In-process dp rendezvous for the in-XLA collective path
+# ---------------------------------------------------------------------------
+
+
+class _ProcGroup:
+    """Rendezvous for one stage's dp gang when every rank is a thread of
+    ONE process sharing the jax runtime: rank 0 launches the single
+    psum_scatter/all_gather program over everyone's deposited vectors.
+
+    Two barrier waits per op — deposit barrier (everyone's slot written),
+    rank 0 computes, exit barrier (result readable). A rank can only
+    re-enter the deposit barrier after reading the previous result, so the
+    cyclic barrier is reuse-safe. A timed-out or interrupted wait breaks
+    the barrier for every peer, surfacing as PipelineStallError on all of
+    them — the fail-fast the chaos test asserts."""
+
+    _registry: Dict[Tuple[str, int], "_ProcGroup"] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def join(cls, key: Tuple[str, int], world: int,
+             mesh_fn: Callable[[], Any]) -> "_ProcGroup":
+        with cls._lock:
+            group = cls._registry.get(key)
+            if group is None or group.world != world or group.broken:
+                group = cls(world, mesh_fn)
+                cls._registry[key] = group
+            return group
+
+    def __init__(self, world: int, mesh_fn: Callable[[], Any]) -> None:
+        self.world = world
+        self.broken = False
+        mesh = mesh_fn()
+        self.rs, self.ag = zero.make_inxla_collectives(mesh, "dp", world)
+        self.barrier = threading.Barrier(world)
+        self.slots: List[Optional[np.ndarray]] = [None] * world
+        self.out: Optional[np.ndarray] = None
+
+    def _wait(self, timeout: float) -> float:
+        t0 = time.perf_counter()
+        try:
+            self.barrier.wait(timeout=timeout)
+        except threading.BrokenBarrierError as e:
+            self.broken = True
+            raise PipelineStallError(
+                "in-XLA dp rendezvous barrier broke — a gang peer died or "
+                "stalled mid-collective") from e
+        return time.perf_counter() - t0
+
+    def _run(self, rank: int, vec: np.ndarray, fn, timeout: float):
+        self.slots[rank] = vec
+        waited = self._wait(timeout)
+        if rank == 0:
+            self.out = fn(np.stack(self.slots))
+        waited += self._wait(timeout)
+        return self.out, waited
+
+    def reduce_scatter(self, rank: int, vec: np.ndarray,
+                       timeout: float) -> Tuple[np.ndarray, float]:
+        out, waited = self._run(rank, vec, self.rs, timeout)
+        return np.asarray(out[rank]), waited
+
+    def all_gather(self, rank: int, seg: np.ndarray,
+                   timeout: float) -> Tuple[np.ndarray, float]:
+        out, waited = self._run(rank, seg, self.ag, timeout)
+        return np.asarray(out), waited
+
+
+_PG_FALLBACK_WARNED = False
+
+
+def _pg_fallback(strategy: str, bundles: List[Dict[str, float]],
+                 why: Any) -> None:
+    """One WARNING (with the bundle shapes that did not fit) the first
+    time placement degrades; repeats stay at info so a flapping scheduler
+    does not spam the log."""
+    global _PG_FALLBACK_WARNED
+    msg = ("pipeline placement %s infeasible (%s); falling back to "
+           "best-effort placement; requested bundles: %s")
+    if not _PG_FALLBACK_WARNED:
+        _PG_FALLBACK_WARNED = True
+        logger.warning(msg, strategy, why, bundles)
+    else:
+        logger.info(msg, strategy, why, bundles)
+
+
 # ---------------------------------------------------------------------------
 # The per-stage model slice
 # ---------------------------------------------------------------------------
@@ -194,17 +395,32 @@ def _nest(flat: Dict[str, Any]) -> Dict[str, Any]:
 
 @dataclasses.dataclass(frozen=True)
 class LMStageModule:
-    """The transformer, restricted to one pipeline stage's layers: stage 0
-    owns the embedding prologue, the last stage owns the head + loss, and
-    every stage runs its contiguous block of the layer stack. Stage math
-    composes to exactly `models.transformer.forward` (microbatching only
-    reorders the schedule), which is what the parity test asserts."""
+    """The transformer, restricted to one pipeline stage's layer chunks:
+    chunk 0 owns the embedding prologue, the last chunk owns the head +
+    loss, and every chunk runs a contiguous block of the layer stack.
+    With `virtual_stages` v > 1 each worker owns v non-contiguous chunks
+    (worker w gets global chunks {w + j*num_stages}). Stage math composes
+    to exactly `models.transformer.forward` (microbatching only reorders
+    the schedule), which is what the parity test asserts."""
 
     cfg: ModelConfig
     num_stages: int
     rules: Tuple[Tuple[str, Any], ...] = DEFAULT_STAGE_RULES
+    virtual_stages: int = 0  # 0 = config.pipeline_virtual_stages
+
+    # pinned to chunk 0 and integer-indexed — kept OUT of the float-only
+    # residual-stash trunk (see _make_split_pair)
+    PROLOGUE_PARAMS = frozenset({"embed", "pos_emb"})
 
     def __post_init__(self):
+        v = self.virtual_stages
+        if not v:
+            from ..core.config import config
+
+            v = int(config.pipeline_virtual_stages)
+        if self.num_stages == 1:
+            v = 1  # nothing to interleave
+        object.__setattr__(self, "virtual_stages", max(1, int(v)))
         if self.cfg.tie_embeddings:
             raise ValueError(
                 "pipeline stages need embed (first stage) and lm_head "
@@ -213,11 +429,16 @@ class LMStageModule:
             )
         if self.cfg.is_moe:
             raise ValueError("MoE models are not pipeline-partitionable yet")
-        if self.cfg.n_layers % self.num_stages:
+        if self.cfg.n_layers % (self.num_stages * self.virtual_stages):
             raise ValueError(
                 f"{self.cfg.n_layers} layers not divisible by "
-                f"{self.num_stages} stages"
+                f"{self.num_stages} stages x {self.virtual_stages} "
+                "virtual chunks"
             )
+
+    @property
+    def num_chunks(self) -> int:
+        return self.num_stages * self.virtual_stages
 
     def init_full(self, seed: int) -> Dict[str, np.ndarray]:
         """Full model init on the driver, flattened to {path: np array} —
@@ -229,7 +450,15 @@ class LMStageModule:
 
     def partition(self, flat_params: Dict[str, np.ndarray]
                   ) -> List[Dict[str, np.ndarray]]:
+        """Per-STAGE contiguous split (v=1 view; weight export format)."""
         return split_stage_params(flat_params, self.num_stages, self.rules)
+
+    def partition_chunks(self, flat_params: Dict[str, np.ndarray]
+                         ) -> List[List[Dict[str, np.ndarray]]]:
+        """Per-WORKER chunk lists: result[w][j] is global chunk w + j*S."""
+        S, v = self.num_stages, self.virtual_stages
+        chunks = split_stage_params(flat_params, self.num_chunks, self.rules)
+        return [[chunks[j * S + w] for j in range(v)] for w in range(S)]
 
     # -- stage math (pure functions of (flat_params, inputs); jitted by
     # the worker) ----------------------------------------------------------
@@ -242,37 +471,46 @@ class LMStageModule:
         return rope_frequencies(
             self.cfg.hdim, self.cfg.max_seq_len, self.cfg.rope_theta)
 
-    def forward(self, stage: int, flat_params: Dict[str, Any], x):
-        """Stage trunk: tokens [B,T] -> h [B,T,D] for stage 0, else
-        h -> h through this stage's layer block."""
+    def _constrain(self, x, shard):
+        if shard is None:
+            return x
         import jax
 
-        from ..models.transformer import _block, _prologue
+        return jax.lax.with_sharding_constraint(x, shard)
 
-        cfg = self.cfg
-        params = _nest(flat_params)
-        if stage == 0:
-            x, rope_tables = _prologue(params, x, cfg)
-        else:
-            rope_tables = self._rope()
+    def embed(self, flat_params: Dict[str, Any], tokens, shard=None):
+        """Chunk-0 prologue: tokens [B,T] -> x0 [B,T,D]."""
+        from ..models.transformer import _prologue
 
-        def body(carry, lp):
-            y, aux = _block(carry, lp, cfg, rope_tables, None)
-            return y, aux
+        x, _rope_tables = _prologue(_nest(flat_params), tokens, self.cfg)
+        return self._constrain(x, shard)
 
-        if cfg.remat:
-            body = jax.checkpoint(body)
-        x, _aux = jax.lax.scan(body, x, params["layers"])
-        return x
+    def trunk(self, chunk: int, flat_params: Dict[str, Any], x, shard=None):
+        """One chunk's layer block: h [B,T,D] -> h [B,T,D]. Float-only in
+        and out, so the residual-stash backward applies to every chunk."""
+        from ..models.transformer import run_layers
 
-    def loss(self, stage: int, flat_params: Dict[str, Any], x, targets):
-        """Last-stage epilogue: trunk + lm head + LM loss (the shared
+        x = self._constrain(x, shard)
+        x, _aux = run_layers(
+            _nest(flat_params)["layers"], x, self.cfg, self._rope(), None)
+        return self._constrain(x, shard)
+
+    def forward(self, chunk: int, flat_params: Dict[str, Any], x,
+                shard=None):
+        """Chunk trunk: tokens -> h for chunk 0, else h -> h."""
+        if chunk == 0:
+            x = self.embed(flat_params, x, shard)
+        return self.trunk(chunk, flat_params, x, shard)
+
+    def loss(self, chunk: int, flat_params: Dict[str, Any], x, targets,
+             shard=None):
+        """Last-chunk epilogue: trunk + lm head + LM loss (the shared
         loss_from_logits, so metrics match loss_fn exactly)."""
         import jax.numpy as jnp
 
         from ..models.transformer import _lm_head
 
-        h = self.forward(stage, flat_params, x)
+        h = self.forward(chunk, flat_params, x, shard)
         logits = _lm_head(h, _nest(flat_params), self.cfg)
         return loss_from_logits(
             logits, targets, None, self.cfg, jnp.zeros((), jnp.float32))
@@ -289,11 +527,21 @@ class PipelineConfig:
 
     num_microbatches must divide each replica's batch (global batch /
     dp); channel_capacity bounds in-flight microbatches per edge (the
-    backpressure); small_blob_bytes is the PR-5-style split — tensors
-    above it ride the host object plane as ObjectRefs with only the ref
-    crossing the channel. grad_clip is the GLOBAL-norm clip applied from
-    the driver-computed cross-stage norm (None/0 disables). zero1 shards
-    optimizer state across the dp replicas of each stage."""
+    backpressure; raised to S*v+2 automatically when interleaving);
+    small_blob_bytes is the PR-5-style split — tensors above it ride the
+    host object plane as ObjectRefs with only the ref crossing the
+    channel. grad_clip is the GLOBAL-norm clip applied from the
+    driver-computed cross-stage norm (None/0 disables). zero1 shards
+    optimizer state across the dp replicas of each stage.
+
+    Three knobs default from core.config so deployments flip them without
+    touching code: virtual_stages (0 -> follow the module, which reads
+    config.pipeline_virtual_stages), stage_mesh_axes (None ->
+    config.stage_mesh_axes), overlap_grad_exchange (None ->
+    config.pipeline_overlap_grad_exchange). use_inxla_collectives: None
+    auto-detects (in-process gang + enough devices), False forces the
+    channel path, True insists (falls back with a log if ineligible).
+    """
 
     num_stages: int = 2
     num_microbatches: int = 2
@@ -309,6 +557,19 @@ class PipelineConfig:
     placement_strategy: str = "STRICT_SPREAD"
     stages_in_process: Optional[bool] = None
     worker_cpus: float = 1.0
+    virtual_stages: int = 0
+    stage_mesh_axes: Optional[str] = None
+    overlap_grad_exchange: Optional[bool] = None
+    use_inxla_collectives: Optional[bool] = None
+
+    def __post_init__(self):
+        from ..core.config import config
+
+        if self.stage_mesh_axes is None:
+            self.stage_mesh_axes = str(config.stage_mesh_axes)
+        if self.overlap_grad_exchange is None:
+            self.overlap_grad_exchange = bool(
+                config.pipeline_overlap_grad_exchange)
 
 
 # ---------------------------------------------------------------------------
@@ -318,7 +579,7 @@ class PipelineConfig:
 
 class StageWorker:
     """One gang member: pipeline stage `stage`, data-parallel rank
-    `dp_rank`. Owns its param slice, its (possibly ZeRO-sharded)
+    `dp_rank`. Owns its param chunks, its (possibly ZeRO-sharded)
     optimizer state, and the consumer end of its inbound channels.
 
     Deliberately NOT decorated with @api.remote: the decorator would
@@ -329,13 +590,19 @@ class StageWorker:
     the remote handle the gang schedules."""
 
     def __init__(self, module: LMStageModule, stage: int, dp_rank: int,
-                 pcfg: PipelineConfig, opt_kwargs: Dict[str, Any]):
+                 pcfg: PipelineConfig, opt_kwargs: Dict[str, Any],
+                 gang_uid: str = ""):
         self.module = module
         self.stage = stage
         self.dp_rank = dp_rank
         self.pcfg = pcfg
         self.opt_kwargs = dict(opt_kwargs)
+        self.gang_uid = gang_uid
         self.S = module.num_stages
+        self.v = module.virtual_stages
+        self.C = module.num_chunks
+        self._chunks = [j * self.S + stage for j in range(self.v)]
+        self._lpc = module.cfg.n_layers // self.C  # layers per chunk
         self.R = pcfg.dp
         self.zero1 = bool(pcfg.zero1 and self.R > 1)
         self.step = 0
@@ -343,18 +610,80 @@ class StageWorker:
         self.dp_in: Dict[int, Any] = {}
         self.dp_out: Dict[int, Any] = {}
         self._pending: Optional[Dict[str, np.ndarray]] = None
-        self._wait_s = 0.0
+        # blocked-time attribution: per-THREAD sink so the overlapped
+        # update thread and the compute thread never share a bucket
+        self._wait_sink = threading.local()
+        self.mesh = None
+        self._act_shard = None
+        self._param_shardings: Optional[Dict[str, Any]] = None
+        self._inxla = False
+        self._group: Optional[_ProcGroup] = None
+        self._layout: Optional[zero.RegionLayout] = None
+        self._update_thread: Optional[threading.Thread] = None
+        self._update_done: Optional[threading.Event] = None
+        self._update_err: Optional[BaseException] = None
+        self._update_stats: Optional[Dict[str, float]] = None
+        self._carry_stats: Optional[Dict[str, float]] = None
+        self._param_version: Dict[str, int] = {}
+
+    # -- param bookkeeping -------------------------------------------------
+
+    def _pfx(self, j: int, path: str) -> str:
+        """Local chunk j's leaf path in the worker's combined dict."""
+        return path if self.v == 1 else f"chunk{j}/{path}"
+
+    def _unpfx(self, key: str) -> Tuple[int, str]:
+        if self.v == 1:
+            return 0, key
+        head, rest = key.split("/", 1)
+        return int(head[len("chunk"):]), rest
+
+    def _rebuild_chunks(self) -> None:
+        self._chunk_params = [
+            {p: self.params[self._pfx(j, p)] for p in self._chunk_paths[j]}
+            for j in range(self.v)
+        ]
 
     # -- lifecycle ---------------------------------------------------------
 
-    def setup(self, stage_params: Dict[str, np.ndarray],
+    def setup(self, chunk_params: List[Dict[str, np.ndarray]],
               resume_dir: Optional[str] = None, step: int = 0) -> int:
+        import jax
         import jax.numpy as jnp
 
         from .lm import make_optimizer
 
-        self.params = {p: jnp.asarray(v, jnp.float32)
-                       for p, v in stage_params.items()}
+        self._build_stage_mesh()
+        combined = {self._pfx(j, p): leaf
+                    for j, cp in enumerate(chunk_params)
+                    for p, leaf in cp.items()}
+        if self.mesh is not None:
+            from ..parallel.sharding import stage_param_shardings
+
+            # shardings matched on UNPREFIXED paths (the rule grammar),
+            # then re-keyed into the combined dict
+            self._param_shardings = {}
+            for j, cp in enumerate(chunk_params):
+                shardings = stage_param_shardings(
+                    {p: np.asarray(leaf) for p, leaf in cp.items()},
+                    self.mesh)
+                for p, s in shardings.items():
+                    self._param_shardings[self._pfx(j, p)] = s
+            self.params = {
+                p: jax.device_put(jnp.asarray(leaf, jnp.float32),
+                                  self._param_shardings[p])
+                for p, leaf in combined.items()
+            }
+        else:
+            self.params = {p: jnp.asarray(leaf, jnp.float32)
+                           for p, leaf in combined.items()}
+        self._chunk_paths = [sorted(cp) for cp in chunk_params]
+        self._rebuild_chunks()
+        placements = match_stage_rules(
+            self.module.rules,
+            {p: None for cp in chunk_params for p in cp}, self.C)
+        self._split_paths = {p for p, pl in placements.items()
+                             if pl == "split"}
         # the stage optimizer runs UNCLIPPED — global-norm clipping is
         # applied cross-stage by the driver (see module docstring)
         self.opt = make_optimizer(grad_clip=None, **self.opt_kwargs)
@@ -368,16 +697,92 @@ class StageWorker:
             self.assignment = None
             self.owned = sorted(self.params)
             self.opt_state = self.opt.init(self.params)
+        self._setup_inxla()
         self.step = step
         if resume_dir is not None:
             self._load(resume_dir)
+            if self.mesh is not None:
+                self.params = {
+                    p: jax.device_put(leaf, self._param_shardings[p])
+                    for p, leaf in self.params.items()}
+            self._rebuild_chunks()
         self._build_fns()
+        self._param_version = {p: self.step for p in self.params}
         return os.getpid()
+
+    def _build_stage_mesh(self) -> None:
+        """Per-stage SPMD mesh from `stage_mesh_axes`, or None. Skipped
+        cleanly (info log, unsharded numerics) when the runtime lacks the
+        devices — single-device in-process gangs hit this constantly."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.sharding import parse_mesh_axes
+
+        self.mesh = None
+        self._act_shard = None
+        text = self.pcfg.stage_mesh_axes or ""
+        axes = parse_mesh_axes(text)
+        if not axes:
+            return
+        need = 1
+        for size in axes.values():
+            need *= size
+        ndev = jax.device_count()
+        if ndev == 1 or ndev < need:
+            logger.info(
+                "stage %d/dp%d: stage_mesh_axes=%r needs %d devices, have "
+                "%d; running unsharded", self.stage, self.dp_rank, text,
+                need, ndev)
+            return
+        from ..comm.mesh import build_mesh
+
+        devs = list(jax.devices())
+        if ndev >= self.S * need:  # disjoint per-stage device blocks
+            devs = devs[self.stage * need:(self.stage + 1) * need]
+        else:
+            devs = devs[:need]
+        self.mesh = build_mesh(devices=devs, **axes)
+        batch_axes = tuple(a for a in ("dp", "fsdp") if a in axes)
+        self._act_shard = NamedSharding(
+            self.mesh, PartitionSpec(batch_axes if batch_axes else None))
+
+    def _setup_inxla(self) -> None:
+        """ZeRO-1 dp exchange via in-XLA collectives when the whole dp
+        gang shares one process (and enough devices); else channels."""
+        import jax
+
+        self._inxla = False
+        if not self.zero1:
+            return
+        want = self.pcfg.use_inxla_collectives
+        if want is False:
+            return
+        eligible = (self.pcfg.stages_in_process is True
+                    and bool(self.gang_uid)
+                    and jax.device_count() >= self.R)
+        if not eligible:
+            if want:
+                logger.info(
+                    "stage %d/dp%d: use_inxla_collectives requested but "
+                    "the dp gang is not a single-process mesh group; "
+                    "using the channel path", self.stage, self.dp_rank)
+            return
+        from ..comm.mesh import build_mesh
+
+        host = {p: np.asarray(leaf) for p, leaf in self.params.items()}
+        self._layout = zero.RegionLayout(host, self.assignment, self.R)
+        devs = list(jax.devices())[:self.R]
+        self._group = _ProcGroup.join(
+            (self.gang_uid, self.stage), self.R,
+            lambda: build_mesh(devices=devs, dp=self.R))
+        self._inxla = True
 
     def _shard_path(self, base_dir: str) -> str:
         return os.path.join(base_dir, f"stage{self.stage}_dp{self.dp_rank}")
 
     def save_checkpoint(self, base_dir: str) -> str:
+        self._fence_update()
         path = self._shard_path(base_dir)
         save_pytree({"params": self.params, "opt": self.opt_state}, path)
         return path
@@ -392,58 +797,107 @@ class StageWorker:
         self.opt_state = restored["opt"]
 
     def get_params(self) -> Dict[str, np.ndarray]:
+        self._fence_update()
         return {p: np.asarray(v) for p, v in self.params.items()}
 
     def _build_fns(self) -> None:
-        """Jitted stage kernels. The backward re-runs the stage forward
-        inside jax.vjp UNDER jit (activation recomputation): only each
-        in-flight microbatch's stage INPUT is stashed, the true 1F1B
-        memory profile."""
+        """Jitted kernels per local chunk. Two backward modes:
+
+        remat=True   — stash only each in-flight microbatch's chunk INPUT
+                       and recompute the forward inside jax.vjp under jit
+                       (the classic memory-lean 1F1B profile).
+        remat=False  — stash the vjp RESIDUALS (`_make_split_pair`): the
+                       backward runs at true backward cost, removing the
+                       ~3.5/3 work inflation that capped throughput.
+        Chunk 0 splits its int-token embedding prologue off the float
+        trunk so closure_convert only sees float residuals; its backward
+        re-runs just the (trivial) embedding-lookup vjp."""
         import jax
 
-        m, si, S = self.module, self.stage, self.S
-        if si == S - 1:
-            if S == 1:
-                self._lossgrad = jax.jit(jax.value_and_grad(
-                    lambda p, tok, tgt: m.loss(0, p, tok, tgt),
-                    has_aux=True))
+        self._build_update_fn()
+        m = self.module
+        shard = self._act_shard
+        self._stash_residuals = not m.cfg.remat
+        self._pro_paths: Tuple[str, ...] = ()
+        self._trunk_paths: Tuple[str, ...] = ()
+        if self.stage == 0:
+            self._pro_paths = tuple(
+                p for p in self._chunk_paths[0] if p in m.PROLOGUE_PARAMS)
+            self._trunk_paths = tuple(
+                p for p in self._chunk_paths[0]
+                if p not in m.PROLOGUE_PARAMS)
+        self._fns: List[Dict[str, Any]] = []
+        for j, c in enumerate(self._chunks):
+            fns: Dict[str, Any] = {}
+            if c == self.C - 1:
+                if self.C == 1:
+                    fns["lossgrad"] = jax.jit(jax.value_and_grad(
+                        lambda p, tok, tgt: m.loss(0, p, tok, tgt,
+                                                   shard=shard),
+                        has_aux=True))
+                else:
+                    fns["lossgrad"] = jax.jit(jax.value_and_grad(
+                        lambda p, h, tgt, _c=c: m.loss(_c, p, h, tgt,
+                                                       shard=shard),
+                        argnums=(0, 1), has_aux=True))
+            elif c == 0:
+                if self._stash_residuals:
+                    fns["fwd_res0"], fns["bwd_res0"] = _make_chunk0_pair(
+                        lambda pp, tok: m.embed(pp, tok, shard=shard),
+                        lambda pt, x: m.trunk(0, pt, x, shard=shard))
+                else:
+                    fns["fwd"] = jax.jit(
+                        lambda p, tok: m.forward(0, p, tok, shard=shard))
+
+                    def bwd0(p, tok, g):
+                        _, vjp = jax.vjp(
+                            lambda pp: m.forward(0, pp, tok, shard=shard),
+                            p)
+                        return vjp(g)[0]
+
+                    fns["bwd"] = jax.jit(bwd0)
             else:
-                self._lossgrad = jax.jit(jax.value_and_grad(
-                    lambda p, h, tgt: m.loss(si, p, h, tgt),
-                    argnums=(0, 1), has_aux=True))
-        else:
-            self._fwd = jax.jit(lambda p, x: m.forward(si, p, x))
-            if si == 0:
-                def bwd(p, tok, g):
-                    _, vjp = jax.vjp(lambda pp: m.forward(0, pp, tok), p)
-                    return vjp(g)[0]
-            else:
-                def bwd(p, h, g):
-                    _, vjp = jax.vjp(
-                        lambda pp, hh: m.forward(si, pp, hh), p, h)
-                    return vjp(g)
-            self._bwd = jax.jit(bwd)
+                if self._stash_residuals:
+                    fns["fwd_res"], fns["bwd_res"] = _make_split_pair(
+                        lambda p, x, _c=c: m.forward(_c, p, x, shard=shard))
+                else:
+                    fns["fwd"] = jax.jit(
+                        lambda p, x, _c=c: m.forward(_c, p, x, shard=shard))
+
+                    def bwdc(p, h, g, _c=c):
+                        _, vjp = jax.vjp(
+                            lambda pp, hh: m.forward(_c, pp, hh,
+                                                     shard=shard), p, h)
+                        return vjp(g)
+
+                    fns["bwd"] = jax.jit(bwdc)
+            self._fns.append(fns)
 
     # -- channel wiring ----------------------------------------------------
 
     def make_channels(self) -> Dict[str, Any]:
         """Create the channels THIS worker consumes (consumer-homed SPSC:
         the owner is always the reader). Returns the handles for the
-        driver to hand to the producing peers."""
+        driver to hand to the producing peers. Interleaving (v > 1) turns
+        the chain into a ring — every worker gets both inboxes — and
+        raises capacity to the simulator-proven S*v+2."""
         from ..core import channels
 
         addr = channels.service_address() or channels.ensure_service()
         cap = self.pcfg.channel_capacity
+        if self.v > 1:
+            cap = max(cap, self.S * self.v + 2)
         out: Dict[str, Any] = {"pid": os.getpid()}
-        if self.stage > 0:
+        if self.stage > 0 or self.v > 1:
             self.act_in = channels.DistChannel(addr, maxsize=cap)
             out["act_in"] = self.act_in
-        if self.stage < self.S - 1:
+        if self.stage < self.S - 1 or self.v > 1:
             self.grad_in = channels.DistChannel(addr, maxsize=cap)
             out["grad_in"] = self.grad_in
         if self.R > 1:
             # one inbox per dp peer keeps every edge SPSC; capacity 2
-            # covers the at-most-one-frame-per-phase protocol with slack
+            # covers the at-most-one-frame-per-phase protocol — and the
+            # overlapped update's trailing ag-N frame ahead of rs-N+1
             self.dp_in = {
                 src: channels.DistChannel(addr, maxsize=2)
                 for src in range(self.R) if src != self.dp_rank
@@ -457,6 +911,11 @@ class StageWorker:
         self.dp_out = dp_out or {}
 
     # -- transport helpers (deadline-guarded: never hang on a dead peer) --
+
+    def _note_wait(self, seconds: float) -> None:
+        sink = getattr(self._wait_sink, "d", None)
+        if sink is not None:
+            sink[self._wait_sink.kind] += seconds
 
     def _send(self, chan, frame, what: str) -> float:
         t0 = time.perf_counter()
@@ -484,31 +943,43 @@ class StageWorker:
                 "dead") from e
         return frame, time.perf_counter() - t0
 
-    def _send_tensor(self, chan, arr, step: int, what: str) -> None:
+    def _send_tensor(self, chan, arr, step: int, chunk: int, mb: int,
+                     what: str) -> None:
+        local = getattr(chan, "_local", None)
+        if self.mesh is None and local is not None and local() is not None:
+            # same-process consumer: the channel is a plain queue (no
+            # pickling), so hand over the immutable device array as-is —
+            # the host round-trip was a forced sync per hop. Meshed
+            # stages must NOT do this: their arrays are committed to the
+            # producer's submesh and would poison the consumer's jit.
+            self._note_wait(
+                self._send(chan, ("arr", step, chunk, mb, arr), what))
+            return
         arr = np.asarray(arr)
         if arr.nbytes > self.pcfg.small_blob_bytes:
             # object-plane fallback (the PR-5 small-blob split): large
             # activations ride the transfer plane; only the ref crosses
             # the channel. Serialized refs are escape-noted, so the
             # consumer's deref never races the producer's refcount.
-            frame = ("ref", step, api.put(arr))
+            frame = ("ref", step, chunk, mb, api.put(arr))
         else:
-            frame = ("arr", step, arr)
-        self._wait_s += self._send(chan, frame, what)
+            frame = ("arr", step, chunk, mb, arr)
+        self._note_wait(self._send(chan, frame, what))
 
-    def _recv_tensor(self, chan, step: int, what: str):
+    def _recv_tensor(self, chan, step: int, chunk: int, mb: int, what: str):
         frame, waited = self._recv(chan, what)
-        self._wait_s += waited
-        tag, got_step, payload = frame
-        if got_step != step:
+        self._note_wait(waited)
+        tag, got_step, got_chunk, got_mb, payload = frame
+        if (got_step, got_chunk, got_mb) != (step, chunk, mb):
             raise PipelineStallError(
                 f"stage {self.stage}/dp{self.dp_rank}: {what} frame for "
-                f"step {got_step} while running step {step} (desynced "
-                "peer)")
+                f"(step {got_step}, chunk {got_chunk}, mb {got_mb}) while "
+                f"expecting (step {step}, chunk {chunk}, mb {mb}) "
+                "(desynced peer)")
         if tag == "ref":
             t0 = time.perf_counter()
             payload = api.get(payload, timeout=self.pcfg.recv_timeout_s)
-            self._wait_s += time.perf_counter() - t0
+            self._note_wait(time.perf_counter() - t0)
         return payload
 
     # -- data-parallel gradient exchange ----------------------------------
@@ -521,13 +992,13 @@ class StageWorker:
         ASCENDING RANK ORDER (self included) — the canonical order that
         makes sharded and replicated reductions bit-identical."""
         for peer in sorted(self.dp_out):
-            self._wait_s += self._send(
+            self._note_wait(self._send(
                 self.dp_out[peer], (phase, step, outbound(peer)),
-                f"dp {phase}")
+                f"dp {phase}"))
         parts: Dict[int, Dict[str, Any]] = {self.dp_rank: mine}
         for src in sorted(self.dp_in):
             frame, waited = self._recv(self.dp_in[src], f"dp {phase}")
-            self._wait_s += waited
+            self._note_wait(waited)
             got_phase, got_step, payload = frame
             if (got_phase, got_step) != (phase, step):
                 raise PipelineStallError(
@@ -538,8 +1009,16 @@ class StageWorker:
 
     def _reduce_scatter(self, flat: Dict[str, np.ndarray], step: int
                         ) -> Dict[str, np.ndarray]:
-        """ZeRO-1 phase 1: each peer receives my grads for ITS leaves;
-        I return the dp-mean grads for MY leaves."""
+        """ZeRO-1 phase 1: the dp-mean grads for MY leaves. In-XLA: pack
+        all leaves into the owner-region vector, one psum_scatter hands
+        back exactly my region. Channels: each peer receives my grads for
+        ITS leaves."""
+        if self._inxla:
+            vec = self._layout.pack(flat)
+            seg, waited = self._group.reduce_scatter(
+                self.dp_rank, vec, self.pcfg.step_timeout_s)
+            self._note_wait(waited)
+            return self._layout.unpack_rank(seg, self.dp_rank)
         mine = {p: flat[p] for p in self.owned}
         contributions = self._dp_collect(
             step, "rs", mine,
@@ -557,6 +1036,12 @@ class StageWorker:
                     ) -> Dict[str, np.ndarray]:
         """ZeRO-1 phase 3: broadcast my updated leaves, assemble the full
         updated param dict from everyone's shards."""
+        if self._inxla:
+            seg = self._layout.pack_rank(owned_new, self.dp_rank)
+            vec, waited = self._group.all_gather(
+                self.dp_rank, seg, self.pcfg.step_timeout_s)
+            self._note_wait(waited)
+            return self._layout.unpack_full(vec)
         contributions = self._dp_collect(
             step, "ag", owned_new, lambda peer: owned_new)
         full: Dict[str, np.ndarray] = {}
@@ -564,149 +1049,327 @@ class StageWorker:
             full.update(part)
         return full
 
+    # -- grad-norm accounting ---------------------------------------------
+
+    def _canonical_sqnorms(self, flat: Dict[str, Any]) -> Dict[str, float]:
+        """Per-leaf squared norms under keys invariant to S, v, dp, and
+        sharding: split leaves report PER GLOBAL LAYER ROW
+        ("layer0007/layers/wq"), pinned leaves by bare path. The driver
+        folds the union in sorted order — the one float-summation order
+        every configuration shares, which is what keeps clip scales
+        identical across partitionings."""
+        out: Dict[str, float] = {}
+        for key, val in flat.items():
+            j, path = self._unpfx(key)
+            arr = np.asarray(val, dtype=np.float32)
+            if path in self._split_paths and arr.ndim >= 1:
+                base = self._chunks[j] * self._lpc
+                for k in range(arr.shape[0]):
+                    row = arr[k]
+                    out[f"layer{base + k:04d}/{path}"] = float(
+                        np.vdot(row, row))
+            else:
+                out[path] = float(np.vdot(arr, arr))
+        return out
+
     # -- the step ----------------------------------------------------------
 
     def compute_grads(self, step: int, feed: Dict[str, np.ndarray]
                       ) -> Dict[str, Any]:
-        """Run this worker's half-step: 1F1B over all microbatches
-        (streaming through the stage channels), dp-reduce the mean
-        grads, and report per-leaf squared norms for the driver's global
-        clip. The update itself waits for `apply_update(gnorm)`."""
+        """Run this worker's half-step: (interleaved) 1F1B over all
+        microbatches streaming through the stage channels, dp-reduce the
+        mean grads, and report per-leaf squared norms for the driver's
+        global clip. The update itself waits for `apply_update(gnorm)` /
+        `start_update(gnorm)`."""
         from ..util import slo, tracing
 
-        si, S, M = self.stage, self.S, self.pcfg.num_microbatches
-        self._wait_s = 0.0
+        si, S, v, M = self.stage, self.S, self.v, self.pcfg.num_microbatches
+        waits = {k: 0.0 for k in BUBBLE_KINDS}
+        self._wait_sink.d = waits
+        self._wait_sink.kind = "grad_exchange"
+        carry = self._carry_stats or {}
+        self._carry_stats = None
         t_start = time.perf_counter()
-        with tracing.span_if_traced(
-                "pipeline.stage_step",
-                {"stage": si, "dp": self.dp_rank, "step": step}):
-            tok_mb = (np.split(np.asarray(feed["tokens"]), M)
-                      if si == 0 else None)
-            tgt_mb = (np.split(np.asarray(feed["targets"]), M)
-                      if si == S - 1 else None)
+        try:
+            # fence the overlapped update of step-1, then verify every
+            # leaf actually reached this step's version — the overlap
+            # correctness invariant, cheap enough to always check
+            self._fence_update()
+            stale = [p for p, ver in self._param_version.items()
+                     if ver != step]
+            if stale:
+                raise PipelineStallError(
+                    f"stage {si}/dp{self.dp_rank}: param "
+                    f"{stale[0]!r} at version "
+                    f"{self._param_version[stale[0]]} entering step "
+                    f"{step} — overlapped update fence failed")
+            with tracing.span_if_traced(
+                    "pipeline.stage_step",
+                    {"stage": si, "dp": self.dp_rank, "step": step}):
+                tok_mb = (np.split(np.asarray(feed["tokens"]), M)
+                          if si == 0 else None)
+                tgt_mb = (np.split(np.asarray(feed["targets"]), M)
+                          if si == S - 1 else None)
 
-            grad_sum: Optional[Dict[str, Any]] = None
-            loss_sum = 0.0
-            metrics_sum: Dict[str, float] = {}
-            stash: deque = deque()  # in-flight microbatch stage inputs
+                grad_sum: Dict[str, Any] = {}
+                loss_sum = 0.0
+                metrics_sum: Dict[str, float] = {}
+                stash: Dict[int, deque] = {j: deque() for j in range(v)}
 
-            def accumulate(dparams) -> None:
-                nonlocal grad_sum
-                if grad_sum is None:
-                    grad_sum = dict(dparams)
-                else:
-                    grad_sum = {p: grad_sum[p] + dparams[p]
-                                for p in grad_sum}
+                def accumulate(j: int, dparams: Dict[str, Any]) -> None:
+                    for p, g in dparams.items():
+                        key = self._pfx(j, p)
+                        cur = grad_sum.get(key)
+                        grad_sum[key] = g if cur is None else cur + g
 
-            def run_forward(k: int) -> None:
-                nonlocal loss_sum
-                x = (tok_mb[k] if si == 0
-                     else self._recv_tensor(self.act_in, step, "activation"))
-                if si == S - 1:
-                    # last stage fuses F and B: one jitted value_and_grad
-                    if S == 1:
-                        (loss, mets), dparams = self._lossgrad(
-                            self.params, x, tgt_mb[k])
+                sched = interleaved_schedule(S, v, M, si)
+                n_lead = 0
+                while n_lead < len(sched) and sched[n_lead][0] == "F":
+                    n_lead += 1
+                last_f = max(i for i, e in enumerate(sched)
+                             if e[0] == "F")
+                for idx, (kind, j, mb) in enumerate(sched):
+                    self._wait_sink.kind = (
+                        "warmup" if idx < n_lead
+                        else "drain" if idx > last_f
+                        else "channel_wait")
+                    c = self._chunks[j]
+                    fns = self._fns[j]
+                    cp = self._chunk_params[j]
+                    if kind == "F":
+                        x = (tok_mb[mb] if c == 0 else
+                             self._recv_tensor(self.act_in, step, c - 1,
+                                               mb, "activation"))
+                        if c == self.C - 1:
+                            # last chunk fuses F and B: one jitted
+                            # value_and_grad, grad emitted at F time
+                            if self.C == 1:
+                                (loss, mets), dparams = fns["lossgrad"](
+                                    cp, x, tgt_mb[mb])
+                            else:
+                                (loss, mets), (dparams, dh) = \
+                                    fns["lossgrad"](cp, x, tgt_mb[mb])
+                                self._send_tensor(
+                                    self.grad_out, dh, step, c - 1, mb,
+                                    "gradient")
+                            accumulate(j, dparams)
+                            loss_sum += float(loss)
+                            for name, val in mets.items():
+                                metrics_sum[name] = metrics_sum.get(
+                                    name, 0.0) + float(val)
+                        else:
+                            if c == 0 and self._stash_residuals:
+                                h, res = fns["fwd_res0"](
+                                    {p: cp[p] for p in self._pro_paths},
+                                    {p: cp[p] for p in self._trunk_paths},
+                                    x)
+                                stash[j].append((x, res))
+                            elif self._stash_residuals:
+                                h, res = fns["fwd_res"](cp, x)
+                                stash[j].append(res)
+                            else:
+                                h = fns["fwd"](cp, x)
+                                stash[j].append(x)
+                            self._send_tensor(self.act_out, h, step, c,
+                                              mb, "activation")
                     else:
-                        (loss, mets), (dparams, dh) = self._lossgrad(
-                            self.params, x, tgt_mb[k])
-                        self._send_tensor(self.grad_out, dh, step,
-                                          "gradient")
-                    accumulate(dparams)
-                    loss_sum += float(loss)
-                    for name, v in mets.items():
-                        metrics_sum[name] = metrics_sum.get(name, 0.0) \
-                            + float(v)
+                        if c == self.C - 1:
+                            continue  # fused into the forward slot
+                        g = self._recv_tensor(self.grad_in, step, c, mb,
+                                              "gradient")
+                        if c == 0:
+                            if self._stash_residuals:
+                                tok, res = stash[j].popleft()
+                                dpt, dpp = fns["bwd_res0"](
+                                    {p: cp[p] for p in self._pro_paths},
+                                    tok, res, g)
+                                dparams = {**dpt, **dpp}
+                            else:
+                                tok = stash[j].popleft()
+                                dparams = fns["bwd"](cp, tok, g)
+                            accumulate(j, dparams)
+                        else:
+                            if self._stash_residuals:
+                                res = stash[j].popleft()
+                                dparams, dh = fns["bwd_res"](res, g)
+                            else:
+                                x = stash[j].popleft()
+                                dparams, dh = fns["bwd"](cp, x, g)
+                            accumulate(j, dparams)
+                            self._send_tensor(self.grad_out, dh, step,
+                                              c - 1, mb, "gradient")
+
+                self._wait_sink.kind = "grad_exchange"
+                # dp>1 needs host arrays for the channel exchange; alone,
+                # keep the mean on device — it feeds the jitted update
+                # directly (IEEE division is exact-rounded, so host and
+                # device means are bit-identical)
+                if self.R > 1:
+                    mean = {p: np.asarray(g) / np.float32(M)
+                            for p, g in grad_sum.items()}
+                    if self.zero1:
+                        self._pending = self._reduce_scatter(mean, step)
+                    else:
+                        self._pending = self._all_reduce(mean, step)
                 else:
-                    h = self._fwd(self.params, x)
-                    stash.append(x)
-                    self._send_tensor(self.act_out, h, step, "activation")
-
-            def run_backward() -> None:
-                if si == S - 1:
-                    return  # fused into run_forward
-                g = self._recv_tensor(self.grad_in, step, "gradient")
-                x = stash.popleft()
-                if si == 0:
-                    dparams = self._bwd(self.params, x, g)
+                    self._pending = {p: g / np.float32(M)
+                                     for p, g in grad_sum.items()}
+                # grad-norm contributions: exactly one report per leaf
+                # across the dp group (zero1: each rank its shard; else
+                # rank 0 all)
+                if self.zero1 or self.dp_rank == 0:
+                    sqnorms = self._canonical_sqnorms(self._pending)
                 else:
-                    dparams, dh = self._bwd(self.params, x, g)
-                    self._send_tensor(self.grad_out, dh, step, "gradient")
-                accumulate(dparams)
-
-            # 1F1B: warmup fills the pipe, steady state alternates F/B,
-            # cooldown drains
-            n_warm = min(S - 1 - si, M)
-            for k in range(n_warm):
-                run_forward(k)
-            for k in range(n_warm, M):
-                run_forward(k)
-                run_backward()
-            for _ in range(n_warm):
-                run_backward()
-
-            mean = {p: np.asarray(g) / np.float32(M)
-                    for p, g in grad_sum.items()}
-            if self.R > 1:
-                if self.zero1:
-                    self._pending = self._reduce_scatter(mean, step)
-                else:
-                    self._pending = self._all_reduce(mean, step)
-            else:
-                self._pending = mean
-            # grad-norm contributions: exactly one report per leaf across
-            # the dp group (zero1: each rank its shard; else rank 0 all)
-            if self.zero1:
-                sqnorms = zero.leaf_sq_norms(self._pending)
-            elif self.dp_rank == 0:
-                sqnorms = zero.leaf_sq_norms(self._pending)
-            else:
-                sqnorms = {}
-
+                    sqnorms = {}
+        finally:
+            self._wait_sink.d = None
         wall = time.perf_counter() - t_start
-        busy = max(0.0, wall - self._wait_s)
+        busy = max(0.0, wall - sum(waits.values()))
         _stage_step_hist.observe(wall, tags={"stage": str(si)})
         slo.observe("train_stage_step_seconds", wall,
                     tags={"stage": str(si)})
         out: Dict[str, Any] = {
             "sqnorms": sqnorms, "wall_s": wall, "busy_s": busy,
+            "waits": dict(waits),
+            # the PREVIOUS overlapped update's cost lands on this step's
+            # report (one-step smear — the thread finished during our
+            # schedule, its compute belongs in this step's busy total)
+            "update_busy_s": max(0.0, carry.get("update_s", 0.0)
+                                 - carry.get("update_wait_s", 0.0)),
+            "update_wait_s": carry.get("update_wait_s", 0.0),
         }
         if si == S - 1:
             out["loss"] = loss_sum / M
-            out["metrics"] = {name: v / M for name, v in metrics_sum.items()}
+            out["metrics"] = {name: val / M
+                              for name, val in metrics_sum.items()}
         return out
 
-    def apply_update(self, step: int, gnorm: float) -> int:
-        """Apply the optimizer with the driver's global-norm clip scale
-        (mirrors optax.clip_by_global_norm's formula exactly)."""
+    # -- the update (sync or overlapped) ----------------------------------
+
+    def _build_update_fn(self):
+        """One compiled program for clip-scale + optimizer + apply —
+        eager optax is a per-leaf dispatch storm (dozens of tiny host
+        round-trips per step) that dominated step time on small stages.
+        The clip mirrors optax.clip_by_global_norm's formula exactly:
+        per-element (g / gnorm) * clip, applied only when gnorm >= clip."""
+        import jax
         import jax.numpy as jnp
         import optax
 
         clip = self.pcfg.grad_clip
 
-        def clipped(g: np.ndarray) -> np.ndarray:
-            if not clip or gnorm < clip:
-                return g
-            return (g / np.float32(gnorm)) * np.float32(clip)
+        def upd(params, opt_state, grads, gnorm):
+            if clip:
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(gnorm < np.float32(clip), g,
+                                        (g / gnorm) * np.float32(clip)),
+                    grads)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
 
+        self._update_fn = jax.jit(upd)
+
+    def _apply(self, step: int, gnorm: float) -> None:
+        """Apply the optimizer with the driver's global-norm clip scale
+        (one jitted program, see _build_update_fn)."""
+        import jax
+        import jax.numpy as jnp
+
+        gnorm32 = np.float32(gnorm)
         if self.zero1:
             owned_params = {p: self.params[p] for p in self.owned}
-            grads = {p: jnp.asarray(clipped(self._pending[p]))
-                     for p in self.owned}
-            updates, self.opt_state = self.opt.update(
-                grads, self.opt_state, owned_params)
-            new_owned = optax.apply_updates(owned_params, updates)
+            grads = {p: jnp.asarray(self._pending[p]) for p in self.owned}
+            new_owned, self.opt_state = self._update_fn(
+                owned_params, self.opt_state, grads, gnorm32)
             full = self._all_gather(
-                {p: np.asarray(v) for p, v in new_owned.items()}, step)
-            self.params = {p: jnp.asarray(full[p]) for p in sorted(full)}
+                {p: np.asarray(leaf) for p, leaf in new_owned.items()},
+                step)
+            new_params = {p: jnp.asarray(full[p]) for p in sorted(full)}
         else:
-            grads = {p: jnp.asarray(clipped(g))
-                     for p, g in self._pending.items()}
-            updates, self.opt_state = self.opt.update(
-                grads, self.opt_state, self.params)
-            self.params = optax.apply_updates(self.params, updates)
+            grads = {p: jnp.asarray(g) for p, g in self._pending.items()}
+            new_params, self.opt_state = self._update_fn(
+                self.params, self.opt_state, grads, gnorm32)
+        if self.mesh is not None:
+            new_params = {
+                p: jax.device_put(leaf, self._param_shardings[p])
+                for p, leaf in new_params.items()}
+        self.params = new_params
+        self._rebuild_chunks()
+        for p in self.params:
+            self._param_version[p] = step + 1
         self._pending = None
         self.step = step + 1
+
+    def apply_update(self, step: int, gnorm: float) -> int:
+        """Synchronous update (overlap off, or tests wanting strictness)."""
+        self._fence_update()
+        self._apply(step, gnorm)
+        return self.step
+
+    def start_update(self, step: int, gnorm: float) -> bool:
+        """Kick the update onto a background thread and return — the
+        driver immediately feeds the next step's compute_grads, which
+        overlaps its warmup forwards with this dp exchange + adamw."""
+        self._fence_update()
+        done = threading.Event()
+        self._update_err = None
+        self._update_stats = None
+
+        def run() -> None:
+            sink = self._wait_sink
+            sink.d = {"grad_exchange": 0.0}
+            sink.kind = "grad_exchange"
+            t0 = time.perf_counter()
+            try:
+                self._apply(step, gnorm)
+            except BaseException as e:  # noqa: BLE001 — re-raised at fence
+                self._update_err = e
+            finally:
+                wait_s = sink.d.get("grad_exchange", 0.0)
+                sink.d = None
+                self._update_stats = {
+                    "update_s": time.perf_counter() - t0,
+                    "update_wait_s": wait_s,
+                }
+                done.set()
+
+        t = threading.Thread(
+            target=run, daemon=True,
+            name=f"pipe-update-s{self.stage}dp{self.dp_rank}")
+        self._update_thread = t
+        self._update_done = done
+        t.start()
+        return True
+
+    def _fence_update(self) -> None:
+        """Join the in-flight overlapped update (no-op when none). Every
+        param-touching entry point goes through here, so overlap can never
+        expose a half-updated param set."""
+        t = self._update_thread
+        if t is None:
+            return
+        done = self._update_done
+        t0 = time.perf_counter()
+        ok = done.wait(timeout=self.pcfg.step_timeout_s)
+        self._note_wait(time.perf_counter() - t0)
+        if not ok:
+            raise PipelineStallError(
+                f"stage {self.stage}/dp{self.dp_rank}: overlapped update "
+                f"did not finish within {self.pcfg.step_timeout_s}s")
+        t.join(timeout=5.0)
+        self._update_thread = None
+        self._update_done = None
+        self._carry_stats = self._update_stats
+        self._update_stats = None
+        err, self._update_err = self._update_err, None
+        if err is not None:
+            raise PipelineStallError(
+                f"stage {self.stage}/dp{self.dp_rank}: overlapped update "
+                f"failed: {err!r}") from err
+
+    def finish_update(self) -> int:
+        """Drain the last overlapped update (end of the run)."""
+        self._fence_update()
         return self.step
 
 
@@ -724,17 +1387,19 @@ class _Gang:
     """S x R StageWorkers, placed STRICT_SPREAD when feasible (one bundle
     per worker, each on a distinct host — the worker_group/disagg fallback
     idiom: infeasible groups degrade to best-effort placement), channels
-    created consumer-side and cross-wired."""
+    created consumer-side and cross-wired (a ring when interleaving)."""
 
     def __init__(self, module: LMStageModule, pcfg: PipelineConfig,
                  opt_kwargs: Dict[str, Any],
-                 stage_params: List[Dict[str, np.ndarray]],
+                 worker_params: List[List[Dict[str, np.ndarray]]],
                  resume_dir: Optional[str], start_step: int):
         from ..core.task_spec import PlacementGroupSchedulingStrategy
 
         rt = api._auto_init()
         S, R = module.num_stages, pcfg.dp
+        v = module.virtual_stages
         n = S * R
+        self.uid = uuid.uuid4().hex[:8]
         # explicit in-process stages all live in the driver: reserving a
         # CPU per worker (or spread-placing them) would just deadlock the
         # gang on a small box — a 1-CPU node can't "hold" 2 driver threads
@@ -742,22 +1407,18 @@ class _Gang:
         worker_cpus = 0.0 if in_proc else pcfg.worker_cpus
         self.pg = None
         if pcfg.placement_strategy and not in_proc:
+            bundles = [{"CPU": worker_cpus} for _ in range(n)]
             try:
                 pg = rt.pg_manager.create(
-                    [{"CPU": worker_cpus} for _ in range(n)],
-                    strategy=pcfg.placement_strategy,
-                )
+                    bundles, strategy=pcfg.placement_strategy)
                 if pg.ready(timeout=30.0):
                     self.pg = pg
                 else:
-                    logger.info(
-                        "pipeline %s group never materialized; best-effort "
-                        "placement", pcfg.placement_strategy)
+                    _pg_fallback(pcfg.placement_strategy, bundles,
+                                 "group never materialized within 30s")
                     rt.pg_manager.remove(pg)
             except Exception as e:  # noqa: BLE001 — infeasible on this cluster
-                logger.info("pipeline placement %s infeasible (%s); "
-                            "best-effort placement",
-                            pcfg.placement_strategy, e)
+                _pg_fallback(pcfg.placement_strategy, bundles, e)
         self.workers: Dict[Tuple[int, int], Any] = {}
         for i, (si, r) in enumerate(
                 (si, r) for si in range(S) for r in range(R)):
@@ -768,13 +1429,13 @@ class _Gang:
                 opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                     placement_group_id=self.pg.id, bundle_index=i)
             self.workers[(si, r)] = _StageWorkerActor.options(**opts).remote(
-                module, si, r, pcfg, opt_kwargs)
+                module, si, r, pcfg, opt_kwargs, self.uid)
 
         self.pids = {
             key: pid for key, pid in zip(
                 self.workers,
                 api.get([
-                    w.setup.remote(stage_params[si], resume_dir, start_step)
+                    w.setup.remote(worker_params[si], resume_dir, start_step)
                     for (si, _r), w in self.workers.items()
                 ], timeout=pcfg.step_timeout_s))
         }
@@ -787,8 +1448,10 @@ class _Gang:
         }
         connects = []
         for (si, r), w in self.workers.items():
-            act_out = chans[(si + 1, r)]["act_in"] if si < S - 1 else None
-            grad_out = chans[(si - 1, r)]["grad_in"] if si > 0 else None
+            act_out = (chans[((si + 1) % S, r)].get("act_in")
+                       if (si < S - 1 or v > 1) else None)
+            grad_out = (chans[((si - 1) % S, r)].get("grad_in")
+                        if (si > 0 or v > 1) else None)
             dp_out = ({peer: chans[(si, peer)]["dp_in"][r]
                        for peer in range(R) if peer != r} if R > 1 else {})
             connects.append(w.connect.remote(act_out, grad_out, dp_out))
@@ -813,8 +1476,9 @@ class PipelineTrainer:
     """Drives the stage gangs: per step, fan out `compute_grads` to all
     S x R workers (1F1B streams between them through the channels), fold
     the per-leaf squared norms into ONE global grad norm, then fan out
-    `apply_update(gnorm)`. Restart-from-checkpoint on failure, mirroring
-    `JaxTrainer.fit`."""
+    the update — synchronously, or overlapped with the next step's warmup
+    (`overlap_grad_exchange`). Restart-from-checkpoint on failure,
+    mirroring `JaxTrainer.fit`."""
 
     def __init__(
         self,
@@ -837,6 +1501,13 @@ class PipelineTrainer:
             raise ValueError(
                 f"PipelineConfig.num_stages={self.pipeline.num_stages} but "
                 f"module has {module.num_stages} stages")
+        if (self.pipeline.virtual_stages
+                and self.pipeline.virtual_stages != module.virtual_stages):
+            raise ValueError(
+                f"PipelineConfig.virtual_stages="
+                f"{self.pipeline.virtual_stages} but module has "
+                f"{module.virtual_stages} (the module is the source of "
+                "truth; leave the config field 0 to inherit)")
         self.opt_kwargs = dict(optimizer_kwargs or {})
         if "grad_clip" in self.opt_kwargs:
             raise ValueError(
@@ -888,10 +1559,17 @@ class PipelineTrainer:
         api._auto_init()
         pcfg = self.pipeline
         S, R, M = self.module.num_stages, pcfg.dp, pcfg.num_microbatches
+        v = self.module.virtual_stages
         if global_batch % (R * M):
             raise ValueError(
                 f"global_batch={global_batch} must divide into dp={R} "
                 f"replicas x {M} microbatches")
+        if v > 1:
+            # config-time deadlock proof: the interleaved schedule must be
+            # runnable against FIFO channels of the capacity the workers
+            # will build (raises ValueError — NOT retried below)
+            cap = max(pcfg.channel_capacity, S * v + 2)
+            validate_interleaved(S, v, M, cap)
         data_fn = self.data_fn or self._default_data(global_batch, seq_len)
 
         storage = self._storage_dir()
@@ -910,13 +1588,13 @@ class PipelineTrainer:
         error: Optional[BaseException] = None
 
         full = self.module.init_full(self.seed)
-        stage_params = self.module.partition(full)
+        worker_params = self.module.partition_chunks(full)
 
         while True:
             gang = None
             try:
                 gang = _Gang(self.module, pcfg, self.opt_kwargs,
-                             stage_params,
+                             worker_params,
                              resume.path if resume is not None else None,
                              start_step)
                 self.worker_pids = dict(gang.pids)
@@ -964,10 +1642,18 @@ class PipelineTrainer:
         pcfg = self.pipeline
         S, R = self.module.num_stages, pcfg.dp
         n_workers = S * R
+        in_proc = pcfg.stages_in_process is True
+        overlap = bool(pcfg.overlap_grad_exchange)
+        # an in-process gang can at most use one core per... core. Billing
+        # the bubble against threads the box can't run concurrently would
+        # report phantom idle time, so normalize by min(workers, cores).
+        cap_workers = (min(n_workers, os.cpu_count() or n_workers)
+                       if in_proc else n_workers)
         for step in range(start_step, num_steps):
             batch = data_fn(step)
             tok_shards = np.split(np.asarray(batch["tokens"]), R)
             tgt_shards = np.split(np.asarray(batch["targets"]), R)
+            t_step = time.perf_counter()  # excludes data generation
             with tracing.span_if_traced("pipeline.step", {"step": step}):
                 refs = []
                 for (si, r), w in gang.workers.items():
@@ -980,22 +1666,37 @@ class PipelineTrainer:
                 outs = dict(zip(
                     gang.workers,
                     api.get(refs, timeout=pcfg.step_timeout_s)))
-                # one canonical summation order (sorted stage-prefixed
-                # paths) so sharded and replicated runs clip identically
+                # canonical keys are globally unique (per-row for split
+                # leaves) — summing the sorted union clips identically
+                # across every partitioning
                 merged: Dict[str, float] = {}
-                for (si, _r), out in outs.items():
-                    for path, sq in out["sqnorms"].items():
-                        merged[f"s{si}/{path}"] = sq
+                for out in outs.values():
+                    merged.update(out["sqnorms"])
                 gnorm = math.sqrt(
                     sum(merged[k] for k in sorted(merged)))
-                api.get([w.apply_update.remote(step, gnorm)
-                         for w in gang.workers.values()],
-                        timeout=pcfg.step_timeout_s)
+                if overlap:
+                    api.get([w.start_update.remote(step, gnorm)
+                             for w in gang.workers.values()],
+                            timeout=pcfg.step_timeout_s)
+                else:
+                    api.get([w.apply_update.remote(step, gnorm)
+                             for w in gang.workers.values()],
+                            timeout=pcfg.step_timeout_s)
 
-            wall = max(out["wall_s"] for out in outs.values())
-            busy = sum(out["busy_s"] for out in outs.values())
-            bubble = (max(0.0, min(1.0, 1.0 - busy / (n_workers * wall)))
+            wall = time.perf_counter() - t_step
+            stage_wall = max(out["wall_s"] for out in outs.values())
+            busy = sum(out["busy_s"] + out.get("update_busy_s", 0.0)
+                       for out in outs.values())
+            bubble = (max(0.0, min(1.0, 1.0 - busy / (cap_workers * wall)))
                       if wall > 0 else 0.0)
+            kind_s = {k: 0.0 for k in BUBBLE_KINDS}
+            for out in outs.values():
+                for k, val in out.get("waits", {}).items():
+                    kind_s[k] += val
+                kind_s["grad_exchange"] += out.get("update_wait_s", 0.0)
+            for k, val in kind_s.items():
+                if val > 0.0:
+                    _bubble_seconds.inc(val, tags={"kind": k})
             _bubble_gauge.set(bubble)
             last = [out for (si, _r), out in outs.items() if si == S - 1]
             metrics: Dict[str, Any] = {
@@ -1004,7 +1705,9 @@ class PipelineTrainer:
             }
             metrics.update(
                 step=step, grad_norm=gnorm, bubble_fraction=bubble,
-                step_seconds=wall)
+                step_seconds=wall, stage_wall_s=stage_wall)
+            for k, val in kind_s.items():
+                metrics[f"bubble_{k}_s"] = val
             history.append(metrics)
 
             if (self.weights_hook is not None and self.weights_hook_every
@@ -1031,6 +1734,10 @@ class PipelineTrainer:
                 ckpt.set_metadata({"step": step})
                 manager.register(ckpt, metrics)
 
+        if overlap:
+            api.get([w.finish_update.remote()
+                     for w in gang.workers.values()],
+                    timeout=pcfg.step_timeout_s)
         # expose final params for parity tests / weight export: per-stage
         # (dp rank 0) plus the full (stage, rank) map
         keys = list(gang.workers)

@@ -671,23 +671,37 @@ def ledger_from_samples(families: List[Dict[str, Any]],
     already export. Wall time defaults to the busiest stage's
     accumulated step time (stages run concurrently, so max — not sum —
     approximates the job's wall clock); bubble uses the pipeline's own
-    measured fraction."""
+    measured fraction, decomposed per kind (bubble_warmup, bubble_drain,
+    bubble_channel_wait, bubble_grad_exchange) from the
+    train_pipeline_bubble_seconds counter when the pipeline exported it."""
     sums = _family_sums(families)
     if wall_s is None:
         wall_s = _family_max(families, "train_stage_step_seconds")
     bubble = 0.0
+    bubble_kinds: Dict[str, float] = {}
     for fam in families or []:
         if fam.get("name") == "train_pipeline_bubble_fraction":
             vals = [float(v) for _s, _t, v in fam.get("samples", [])]
             if vals:
                 bubble = sum(vals) / len(vals)
-    return goodput_ledger(
+        elif fam.get("name") == "train_pipeline_bubble_seconds":
+            for _s, tags, value in fam.get("samples", []):
+                # registry.snapshot() carries tags as [[k, v], ...] pairs;
+                # remote telemetry payloads carry dicts — accept both.
+                if tags and not isinstance(tags, dict):
+                    tags = dict(tags)
+                kind = (tags or {}).get("kind", "other")
+                key = f"bubble_{kind}"
+                bubble_kinds[key] = bubble_kinds.get(key, 0.0) + float(value)
+    ledger = goodput_ledger(
         wall_s,
         data_stall_s=sums.get("data_stage_stall_seconds", 0.0),
         channel_wait_s=sums.get("channel_recv_wait_seconds", 0.0),
         bubble_fraction=bubble,
         migration_s=sums.get("serve_kv_migration_seconds", 0.0),
     )
+    ledger.update(bubble_kinds)
+    return ledger
 
 
 # ---------------------------------------------------------------------------

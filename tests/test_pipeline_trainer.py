@@ -634,3 +634,205 @@ class TestPipelineCrossHost:
         np.testing.assert_allclose(
             [m["loss"] for m in result.metrics_history], base_losses,
             rtol=2e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved virtual-stage schedule (parallel/pipeline.py generator)
+# ---------------------------------------------------------------------------
+
+
+class TestInterleavedSchedule:
+    def test_v1_reduces_to_classic_1f1b(self):
+        from ray_tpu.parallel.pipeline import interleaved_schedule
+
+        S, M = 4, 8
+        for rank in range(S):
+            sched = interleaved_schedule(S, 1, M, rank)
+            # classic warmup: S-1-rank forwards, then the steady-state
+            # F/B alternation — the first backward lands right after the
+            # first steady-state forward
+            warm = min(S - 1 - rank, M)
+            first_b = next(i for i, e in enumerate(sched) if e[0] == "B")
+            assert first_b == warm + 1
+            assert all(e[1] == 0 for e in sched)  # v=1: one local chunk
+            assert sched[:warm] == [("F", 0, m) for m in range(warm)]
+
+    def test_every_unit_scheduled_exactly_once(self):
+        from ray_tpu.parallel.pipeline import interleaved_schedule
+
+        for S, v, M in ((2, 2, 4), (2, 3, 4), (4, 2, 8), (3, 2, 6)):
+            for rank in range(S):
+                sched = interleaved_schedule(S, v, M, rank)
+                fwd = [(c, m) for k, c, m in sched if k == "F"]
+                bwd = [(c, m) for k, c, m in sched if k == "B"]
+                want = [(c, m) for c in range(v) for m in range(M)]
+                assert sorted(fwd) == want, (S, v, M, rank)
+                assert sorted(bwd) == want, (S, v, M, rank)
+
+    def test_microbatches_must_divide_when_interleaving(self):
+        from ray_tpu.parallel.pipeline import interleaved_schedule
+
+        with pytest.raises(ValueError, match="divisible"):
+            interleaved_schedule(2, 2, 3, 0)
+
+    def test_validate_grid_is_deadlock_free(self):
+        from ray_tpu.parallel.pipeline import validate_interleaved
+
+        for S in (1, 2, 3, 4):
+            for v in (1, 2, 3):
+                for M in (S, 2 * S, 4 * S):
+                    validate_interleaved(S, v, M, capacity=S * v + 2)
+
+    def test_validate_flags_starved_capacity(self):
+        from ray_tpu.parallel.pipeline import validate_interleaved
+
+        with pytest.raises(ValueError, match="deadlock"):
+            validate_interleaved(2, 1, 2, capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# In-stage SPMD sharding: sharded stage == replicated stage numerics
+# ---------------------------------------------------------------------------
+
+
+class TestShardedStageParity:
+    @pytest.mark.parametrize("axes", ["dp=2", "fsdp=2", "tp=2"])
+    def test_sharded_matches_replicated(self, tmp_path, ray_start_regular,
+                                        axes):
+        """with_sharding_constraint + param shardings must be numerically
+        invisible: an 8-step 2-stage run with each stage gang sharded over
+        the named mesh matches the single-gang replicated run to fp
+        tolerance (the 8 virtual CPU devices carve real submeshes)."""
+        cfg = _cfg()
+        steps, batch, seq = 8, 8, 16
+        data_fn = _data_fn(cfg, batch, seq, base_seed=31_000)
+        base_losses, _ = _single_gang_baseline(cfg, data_fn, steps)
+        module = LMStageModule(cfg, 2)
+        trainer = _trainer(
+            tmp_path, module,
+            _fast_pcfg(stage_mesh_axes=axes),
+            data_fn, f"shard_{axes.replace('=', '')}")
+        result = trainer.fit(steps, global_batch=batch, seq_len=seq)
+        assert result.error is None
+        np.testing.assert_allclose(
+            [m["loss"] for m in result.metrics_history], base_losses,
+            rtol=2e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved virtual stages: v=2 numerics vs v=1
+# ---------------------------------------------------------------------------
+
+
+class TestVirtualStagesParity:
+    def test_v2_matches_v1(self, tmp_path, ray_start_regular):
+        """Splitting each worker's layers into two non-contiguous chunks
+        reorders nothing mathematically: same microbatch grad mean, same
+        updates — the v=2 loss curve must match v=1 to fp tolerance (jit
+        partition boundaries move, so bitwise equality is not promised)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(_cfg(), n_layers=4)
+        steps, batch, seq = 4, 8, 16
+        data_fn = _data_fn(cfg, batch, seq, base_seed=33_000)
+
+        losses = {}
+        for v in (1, 2):
+            module = LMStageModule(cfg, 2, virtual_stages=v)
+            trainer = _trainer(
+                tmp_path, module, _fast_pcfg(virtual_stages=v),
+                data_fn, f"virt{v}")
+            result = trainer.fit(steps, global_batch=batch, seq_len=seq)
+            assert result.error is None
+            losses[v] = [m["loss"] for m in result.metrics_history]
+        np.testing.assert_allclose(losses[2], losses[1],
+                                   rtol=2e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# In-XLA ZeRO collectives vs host-channel collectives
+# ---------------------------------------------------------------------------
+
+
+class TestInXlaZero:
+    def test_inxla_matches_channel_path(self, tmp_path, ray_start_regular,
+                                        monkeypatch):
+        """The psum_scatter/all_gather ZeRO path must be numerically
+        identical to the host DistChannel group-mean path: same losses,
+        bit-equal final params on every dp rank."""
+        from ray_tpu.train import pipeline as tp
+
+        cfg = _cfg()
+        steps, batch, seq = 2, 8, 16
+        data_fn = _data_fn(cfg, batch, seq, base_seed=35_000)
+        module = LMStageModule(cfg, 2)
+
+        joins = []
+        real_join = tp._ProcGroup.join.__func__
+
+        def counting_join(cls, key, world, mesh_fn):
+            joins.append(key)
+            return real_join(cls, key, world, mesh_fn)
+
+        monkeypatch.setattr(tp._ProcGroup, "join",
+                            classmethod(counting_join))
+
+        runs = {}
+        for inxla in (False, True):
+            trainer = _trainer(
+                tmp_path, module,
+                _fast_pcfg(dp=2, zero1=True, use_inxla_collectives=inxla),
+                data_fn, f"inxla_{inxla}")
+            result = trainer.fit(steps, global_batch=batch, seq_len=seq)
+            assert result.error is None
+            runs[inxla] = (result, trainer)
+        # the True run actually exercised the in-XLA group
+        assert joins, "in-XLA path never joined a _ProcGroup"
+
+        losses_ch = [m["loss"] for m in runs[False][0].metrics_history]
+        losses_xla = [m["loss"] for m in runs[True][0].metrics_history]
+        assert losses_ch == losses_xla
+        all_ch = runs[False][1].final_state_all
+        all_xla = runs[True][1].final_state_all
+        assert set(all_ch) == set(all_xla)
+        for key in all_ch:
+            for path in all_ch[key]:
+                np.testing.assert_array_equal(
+                    all_ch[key][path], all_xla[key][path])
+
+
+# ---------------------------------------------------------------------------
+# Chaos: a SIGKILLed worker of a *sharded* gang still fail-fasts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestShardedGangChaos:
+    def test_killed_sharded_worker_fails_fast(self, tmp_path,
+                                              ray_start_regular):
+        """Same bounded fail-fast contract as the unsharded chaos test,
+        but with per-stage SPMD meshes active (stage_mesh_axes=dp=2): the
+        mesh adds no new hang paths."""
+        from ray_tpu.util import chaos
+
+        cfg = _cfg()
+        data_fn = _data_fn(cfg, 8, 16, base_seed=37_000)
+        module = LMStageModule(cfg, 2)
+        pcfg = _fast_pcfg(
+            stages_in_process=False, stage_mesh_axes="dp=2",
+            recv_timeout_s=5.0, put_timeout_s=5.0, step_timeout_s=90.0)
+        trainer = _trainer(tmp_path, module, pcfg, data_fn,
+                           "chaos_sharded", max_failures=0)
+        thread, box = _fit_in_thread(trainer, 50, 8, 16)
+        _wait_for(lambda: len(trainer.worker_pids) == 2, 60,
+                  "stage workers to spawn")
+        victim = trainer.worker_pids[(1, 0)]
+        t_kill = time.monotonic()
+        chaos.kill_worker_host(victim)
+        thread.join(timeout=120)
+        assert not thread.is_alive(), "pipeline hung on a dead sharded gang"
+        assert "raised" not in box, box.get("raised")
+        result = box["result"]
+        assert isinstance(result.error, TrainingFailedError)
+        assert "pipeline training failed" in str(result.error)
+        assert time.monotonic() - t_kill < 100
