@@ -26,7 +26,7 @@ CORE_TESTS = tests/test_core_runtime.py tests/test_core_utils.py \
 	tests/test_log_monitor.py tests/test_timeline.py tests/test_cli.py \
 	tests/test_tracing.py tests/test_health.py tests/test_profiler.py \
 	tests/test_object_ledger.py tests/test_raylint.py \
-	tests/test_sanitizer.py
+	tests/test_sanitizer.py tests/test_scale_sim.py
 
 LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_rl.py tests/test_serve.py tests/test_serve_schema.py \
@@ -40,10 +40,10 @@ MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	broadcast fleet rl tsan shm lint spec-smoke shard-smoke \
+	broadcast fleet rl tsan shm lint spec-smoke shard-smoke scale \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
 	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet \
-	bench-rl bench-spec
+	bench-rl bench-spec bench-scale
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -146,6 +146,22 @@ spec-smoke:
 	$(PYTEST) $(FAST) tests/test_spec_decode.py \
 		-k "greedy_on_equals_off and ngram"
 
+# fast federated-control-plane smoke (<30s): 32 simulated node agents
+# over 2 KV shards with a primary SIGKILL'd mid-run — zero lost requests
+# and bounded failover recovery or the harness exits nonzero; the full
+# 8->128 ladder with gates lives in bench-scale
+scale:
+	@echo "== scale smoke: 32-node federation + shard kill ride-through =="
+	python -m ray_tpu.util.scale_sim --nodes 32 --duration 4 --kill-shard
+
+# federated scale ladder: N=8/32/128 simulated nodes over sharded KV +
+# per-pod aggregators + bottom-up scheduling — head CPU (<1 core at 128),
+# heartbeat lag p95, alert->actuation growth (<=1.5x 8->128), scheduling
+# throughput, and the shard-kill chaos row (zero lost requests), merged
+# into BENCH_SUMMARY.json
+bench-scale:
+	env RAY_TPU_BENCH_SUITE=scale python bench.py
+
 # fast 3D-parallelism smoke: one sharded-stage parity run (dp=2 submesh
 # under the 2-stage pipeline) plus the schedule-generator units — seconds,
 # not the full pipeline matrix
@@ -154,7 +170,7 @@ shard-smoke:
 	$(PYTEST) $(FAST) tests/test_pipeline_trainer.py \
 		-k "TestInterleavedSchedule or (sharded_matches_replicated and dp)"
 
-check: shm lint spec-smoke shard-smoke
+check: shm lint spec-smoke shard-smoke scale
 	@echo "== chunk 1/3: core runtime =="
 	$(PYTEST) $(FAST) $(CORE_TESTS)
 	@echo "== chunk 2/3: libraries (data/train/tune/rl/serve) =="

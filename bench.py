@@ -1053,6 +1053,84 @@ def bench_data() -> None:
     _emit("data_rows_per_sec", rows / total, "rows/s", "data_rows_anchor")
 
 
+def bench_scale() -> None:
+    """Federated control-plane scale gate (ISSUE 19): run the scale_sim
+    harness at N=8/32/128 simulated node agents over sharded KV/pubsub
+    with per-pod aggregators and bottom-up scheduling, then SIGKILL a
+    shard primary under the N=128 run. Gates (raise, don't warn):
+
+    - zero failed requests across every run, chaos included
+    - head stays under ONE core at N=128 (the O(pods) ingest claim)
+    - alert->actuation latency grows <= 1.5x from N=8 to N=128
+    - heartbeat p95 lag at N=128 acked within half a beat period
+    - shard-kill recovery bounded (standby promoted, probe write lands)
+
+    Env knobs: RAY_TPU_BENCH_SCALE_DURATION (seconds per size, default 6),
+    RAY_TPU_BENCH_SCALE_MAX (largest N, default 128)."""
+    from ray_tpu.util.scale_sim import run_scale_sim
+
+    duration = float(os.environ.get("RAY_TPU_BENCH_SCALE_DURATION", "6"))
+    n_max = int(os.environ.get("RAY_TPU_BENCH_SCALE_MAX", "128"))
+    sizes = [n for n in (8, 32, n_max) if n <= n_max]
+    rows = {}
+    for n in sizes:
+        rows[n] = run_scale_sim(
+            nodes=n, nshards=2 if n <= 32 else 4,
+            duration_s=duration + (2.0 if n == n_max else 0.0),
+            kill_shard=(n == n_max))
+        r = rows[n]
+        print(
+            f"# scale n={n}: head={r['head_cpu_cores']:.3f} cores "
+            f"hb_p95={r['heartbeat_lag_ms_p95']:.1f}ms "
+            f"actuate={r['actuation_latency_s'] * 1e3:.1f}ms "
+            f"sched={r['sched_tasks_per_s']:.0f}/s "
+            f"failed={r['failed_requests']}",
+            file=sys.stderr,
+        )
+    big, small = rows[n_max], rows[sizes[0]]
+    failed = sum(r["failed_requests"] for r in rows.values())
+    if failed:
+        raise RuntimeError(f"scale: {failed} lost requests across runs")
+    if big["head_cpu_cores"] >= 1.0:
+        raise RuntimeError(
+            f"scale: head burned {big['head_cpu_cores']:.2f} cores at "
+            f"N={n_max} — ingest is not O(pods)")
+    # +1ms smoothing: both medians sit near a millisecond on this box,
+    # and the ratio gate must price growth, not scheduler jitter
+    actuation_ratio = ((big["actuation_latency_s"] + 1e-3)
+                       / (small["actuation_latency_s"] + 1e-3))
+    if actuation_ratio > 1.5:
+        raise RuntimeError(
+            f"scale: actuation latency grew {actuation_ratio:.2f}x "
+            f"from N={sizes[0]} to N={n_max}")
+    if big["heartbeat_lag_ms_p95"] > 250.0:
+        raise RuntimeError(
+            f"scale: heartbeat p95 lag {big['heartbeat_lag_ms_p95']:.0f}ms "
+            f"at N={n_max} — beats are not absorbed within a period")
+    chaos = big["chaos"]
+    if (not chaos or chaos["recovery_s"] is None
+            or chaos["recovery_s"] > 5.0
+            or not chaos["standby_respawned"]):
+        raise RuntimeError(f"scale: shard-kill ride-through failed: {chaos}")
+    if big["reconnect_spike"]:
+        raise RuntimeError(
+            "scale: reconnect_spike fired after shard failover — the "
+            "redial jitter/rate-cap is not flattening the storm")
+    _emit("scale_head_cpu_cores_n128", big["head_cpu_cores"], "cores",
+          "scale_head_cpu_anchor", lower_is_better=True)
+    _emit("scale_heartbeat_lag_ms_p95_n128", big["heartbeat_lag_ms_p95"],
+          "ms", "scale_hb_lag_anchor", lower_is_better=True)
+    _emit("scale_actuation_latency_ratio", actuation_ratio, "ratio",
+          "scale_actuation_anchor", lower_is_better=True)
+    _emit("scale_sched_tasks_per_s_n128", big["sched_tasks_per_s"],
+          "tasks/s", "scale_sched_anchor")
+    _emit("scale_shard_failover_recovery_s", chaos["recovery_s"], "s",
+          "scale_failover_anchor", lower_is_better=True)
+    _emit("scale_shard_failover_failed_requests",
+          float(chaos["failed_requests"]), "requests",
+          "scale_failover_failed_anchor", lower_is_better=True)
+
+
 def bench_objects() -> None:
     """Host object plane (BASELINE.md object-plane row): disseminate one
     large object from a single origin to M pullers through the collective
@@ -2172,6 +2250,10 @@ def main() -> None:
     if "object" in wanted:
         # host object plane: pure CPU/network, no device state to poison
         bench_objects()
+    if "scale" in wanted:
+        # federated control plane at N=128 sim nodes: pure CPU/sockets,
+        # no device state — safe anywhere in the throughput block
+        bench_scale()
     if "images" in wanted:
         bench_images()
     if "train" in wanted:

@@ -127,6 +127,12 @@ def init(
         rt._snapshot_writer = SnapshotWriter(
             rt, config.control_plane_snapshot_path
         )
+    if int(config.control_plane_shards) > 0:
+        from .core.shard import enable_federation
+
+        # shard the gossip planes (KV / pubsub) BEFORE serving the head:
+        # attaching clients must only ever see the federated routing
+        enable_federation(rt)
     if config.control_plane_rpc_port >= 0:
         from .core.cross_host import HeadService, enable_cross_host
         from .core.rpc import serve_control_plane

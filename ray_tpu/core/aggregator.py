@@ -1,0 +1,239 @@
+"""Per-pod telemetry aggregator: head ingest O(pods), not O(nodes).
+
+The shared-service decomposition argument (arXiv:2210.14826) applied to
+this runtime's federated planes: every node in a pod reports heartbeats,
+metric snapshots, SLO digests, profiler samples and object-ledger rows to
+its pod's ``PodAggregator``, which pre-merges and forwards ONE summarized
+report per flush period to the head —
+
+- heartbeats    → one ``heartbeat_bulk`` RPC carrying the whole pod
+                  (alive verdicts fan back out to the members),
+- SLO digests   → ``slo.merge_snapshots`` then back to wire form
+                  (merging is associative over the shared bucket bounds,
+                  so head-side quantile code is unchanged),
+- metrics       → counters sum by (name, sample, tags), gauges last-wins,
+- profiles      → ``profiler.merge_collapsed`` (identical stacks add),
+- ledger rows / channel cursors → concatenated / keyed by node.
+
+The aggregator is transport-agnostic: ``control_plane`` may be the head's
+in-process ControlPlane, a ``RemoteControlPlane``, or the federated
+``ShardedControlPlane`` — it only needs ``heartbeat_bulk`` and
+``report_telemetry``. It can also be served over RPC as a standalone
+per-pod service (``serve()``), with its own raylint-R3-checked registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..util import profiler, slo
+from .logging import get_logger
+from .metrics import Counter
+
+logger = get_logger("aggregator")
+
+_agg_flushes = Counter(
+    "aggregator_flushes_total",
+    "Pod-aggregator flushes forwarded to the head",
+)
+_agg_reports_absorbed = Counter(
+    "aggregator_reports_absorbed_total",
+    "Per-node reports absorbed into pod-level summaries (head RPCs saved)",
+)
+
+# the aggregator's served surface when run as a standalone pod service;
+# everything on it is absorbing (bulk-ingest with replace/merge semantics),
+# so the whole registry is idempotent
+_AGG_ALLOWED_METHODS: Set[str] = {
+    "ingest_heartbeat", "ingest_telemetry", "ingest_profile",
+    "flush", "pod_info", "subscribe",
+}
+_AGG_IDEMPOTENT_METHODS: Set[str] = {
+    "ingest_heartbeat", "ingest_telemetry", "ingest_profile",
+    "flush", "pod_info", "subscribe",
+}
+
+
+def merge_metric_snapshots(
+    per_node: List[List[Dict[str, Any]]]
+) -> List[Dict[str, Any]]:
+    """Merge registry.snapshot() lists from many nodes into one: counter
+    samples sum by (metric, sample name, tags); gauges and everything else
+    last-writer-wins (they are point-in-time readings — summing a gauge
+    across nodes would invent capacity)."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    samples: Dict[str, Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]] = {}
+    for snap in per_node:
+        for metric in snap or []:
+            name = metric["name"]
+            m = merged.get(name)
+            if m is None:
+                m = {"name": name, "kind": metric.get("kind", "gauge"),
+                     "description": metric.get("description", "")}
+                merged[name] = m
+                samples[name] = {}
+            summing = m["kind"] == "counter"
+            for sname, tags, value in metric.get("samples", []):
+                key = (sname, tuple(tuple(kv) for kv in tags))
+                if summing:
+                    samples[name][key] = samples[name].get(key, 0.0) + float(value)
+                else:
+                    samples[name][key] = float(value)
+    out = []
+    for name, m in merged.items():
+        m["samples"] = [(sname, [list(kv) for kv in tags], value)
+                        for (sname, tags), value in samples[name].items()]
+        out.append(m)
+    return out
+
+
+class PodAggregator:
+    """Pre-merges one pod's reports; ``flush()`` forwards the summary.
+
+    Thread-safe: members ingest concurrently, flush swaps the buffers out
+    under the lock and merges outside it."""
+
+    def __init__(self, pod_id: str, control_plane,
+                 flush_period_s: Optional[float] = None):
+        from .config import config
+
+        self.pod_id = str(pod_id)
+        self._cp = control_plane
+        self._period = (float(flush_period_s) if flush_period_s is not None
+                        else float(config.telemetry_report_period_s))
+        self._lock = threading.Lock()
+        self._beats: Dict[Any, Optional[Dict[str, float]]] = {}
+        self._verdicts: Dict[str, bool] = {}
+        self._telemetry: Dict[str, Dict[str, Any]] = {}
+        self._profile: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- member-facing ingest ----------------------------------------------
+    def ingest_heartbeat(self, node_id,
+                         resources_available: Optional[Dict[str, float]] = None
+                         ) -> bool:
+        """Same contract as ControlPlane.heartbeat, answered from the pod:
+        the verdict is the head's reply to the LAST bulk flush (optimistic
+        True for a node the head hasn't judged yet). A reaped node learns
+        it is dead at most one flush period late — within the head's
+        health timeout for any sane configuration."""
+        with self._lock:
+            self._beats[node_id] = (dict(resources_available)
+                                    if resources_available is not None else None)
+            _agg_reports_absorbed.inc()
+            return self._verdicts.get(node_id.hex(), True)
+
+    def ingest_telemetry(self, node_id_hex: str, role: str = "worker",
+                         metrics: Optional[List[Dict[str, Any]]] = None,
+                         digests: Optional[List[Dict[str, Any]]] = None,
+                         objects: Optional[List[Dict[str, Any]]] = None,
+                         channels: Optional[Dict[str, float]] = None) -> bool:
+        """Replace-not-append per node, mirroring report_telemetry: None
+        keeps the node's previous field (delta-encoded senders)."""
+        with self._lock:
+            prev = self._telemetry.get(node_id_hex) or {}
+            self._telemetry[node_id_hex] = {
+                "role": role,
+                "metrics": metrics if metrics is not None
+                else prev.get("metrics", []),
+                "digests": digests if digests is not None
+                else prev.get("digests", []),
+                "objects": objects if objects is not None
+                else prev.get("objects", []),
+                "channels": channels if channels is not None
+                else prev.get("channels", {}),
+            }
+            _agg_reports_absorbed.inc()
+            return True
+
+    def ingest_profile(self, collapsed: Dict[str, int]) -> bool:
+        with self._lock:
+            self._profile = profiler.merge_collapsed(self._profile, collapsed)
+            _agg_reports_absorbed.inc()
+            return True
+
+    def pod_info(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"pod_id": self.pod_id, "members": len(self._beats),
+                    "reporting": len(self._telemetry)}
+
+    # -- head-facing flush --------------------------------------------------
+    def flush(self) -> bool:
+        """One heartbeat_bulk + one report_telemetry for the whole pod."""
+        with self._lock:
+            beats = list(self._beats.items())
+            self._beats.clear()
+            # telemetry cache is kept (replace semantics per node); the
+            # merged profile stays pod-local, served via merged_profile()
+            tel_view = {k: dict(v) for k, v in self._telemetry.items()}
+        if beats:
+            try:
+                verdicts = self._cp.heartbeat_bulk(beats)
+            except Exception:
+                logger.warning("pod %s heartbeat_bulk failed", self.pod_id,
+                               exc_info=True)
+                # leave verdicts as-is: members keep their last answer
+                # rather than all flapping to dead on a head blip
+                verdicts = {}
+            with self._lock:
+                self._verdicts.update(verdicts)
+        merged_digests = slo.merged_to_snapshots(slo.merge_snapshots(
+            [d for t in tel_view.values() for d in t.get("digests", [])]))
+        merged_metrics = merge_metric_snapshots(
+            [t.get("metrics", []) for t in tel_view.values()])
+        objects = [row for t in tel_view.values()
+                   for row in t.get("objects", [])]
+        channels: Dict[str, float] = {}
+        for t in tel_view.values():
+            channels.update(t.get("channels", {}))
+        try:
+            self._cp.report_telemetry(
+                f"pod:{self.pod_id}", role="pod",
+                metrics=merged_metrics, digests=merged_digests,
+                objects=objects, channels=channels)
+            _agg_flushes.inc()
+        except Exception:
+            logger.warning("pod %s telemetry flush failed", self.pod_id,
+                           exc_info=True)
+            return False
+        return True
+
+    def merged_profile(self) -> Dict[str, int]:
+        """The pod's merged flamegraph (profiler.merge_collapsed of every
+        member ingest) — the profile plane fetches this per pod instead of
+        per node."""
+        with self._lock:
+            return dict(self._profile)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "PodAggregator":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"pod-agg-{self.pod_id}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            self.flush()
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if final_flush:
+            self.flush()
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose the aggregator as a standalone pod service (members dial
+        it instead of the head)."""
+        from .control_plane import Pubsub
+        from .rpc import ControlPlaneServer
+
+        if not hasattr(self, "pubsub"):
+            self.pubsub = Pubsub()  # handler contract for served objects
+        return ControlPlaneServer(self, host=host, port=port,
+                                  allowed_methods=_AGG_ALLOWED_METHODS)

@@ -352,6 +352,40 @@ declare(
     "ones surface the error to the caller.",
 )
 
+declare(
+    "control_plane_redial_rate", 16.0,
+    "Process-wide cap on control-plane reconnect DIAL attempts per second "
+    "(token bucket shared by every RemoteControlPlane in the process). "
+    "Bounds the thundering herd when many clients re-dial a restarted or "
+    "failed-over head/shard at once; <= 0 disables the cap.",
+)
+declare(
+    "control_plane_shards", 0,
+    "Federate the control plane: shard the KV store, object directory and "
+    "pubsub fan-out across this many ControlPlaneShard subprocesses, each "
+    "with a warm standby that is promoted on primary death "
+    "(core/shard.py). 0 = off (single in-process head, the default).",
+)
+declare(
+    "control_plane_shard_dir", "",
+    "Directory for shard journals + snapshots when control_plane_shards "
+    "> 0; empty = a per-session tmp directory.",
+)
+declare(
+    "control_plane_gossip_ttl_s", 600.0,
+    "TTL for gossip-namespace control-plane KV entries "
+    "(object_transfer*/node_service/channel_service advertisements) whose "
+    "owner is no longer ALIVE — reaps tombstones left by nodes that died "
+    "without mark_node_dead.",
+)
+declare(
+    "scheduler_local_admit", True,
+    "Bottom-up scheduling: the driver-local node agent admits a task "
+    "against its own resource view when it fits below the spread "
+    "threshold, delegating to ClusterScheduler only on overflow "
+    "(reference: Ray's two-level local-first scheduler).",
+)
+
 # Control-plane persistence (GCS-Redis analogue, file-backed)
 declare(
     "control_plane_snapshot_path", "",

@@ -21,12 +21,41 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .ids import ActorID, JobID, NodeID, PlacementGroupID, SliceID
 from .logging import get_logger
-from .metrics import Gauge
+from .metrics import Counter, Gauge
 
 logger = get_logger("control_plane")
 
 _nodes_gauge = Gauge("ray_tpu_nodes", "Cluster nodes by state")
 _actors_gauge = Gauge("ray_tpu_actors", "Actors by state")
+_gossip_swept = Counter(
+    "control_plane_gossip_swept_total",
+    "Stale gossip KV entries removed by the TTL sweep")
+_heartbeat_lag = Gauge(
+    "control_plane_heartbeat_lag_seconds",
+    "Worst heartbeat staleness across ALIVE nodes, sampled each health sweep")
+
+# Gossip namespaces: per-node advertisements other nodes rank/dial by.
+# Keys are `<prefix><node_hex>` (relay claims differ — see sweep). A node
+# that dies WITHOUT mark_node_dead (SIGKILLed host, partitioned forever,
+# crashed before deregistering) leaves these behind; at fleet scale the
+# tombstones accumulate, so the TTL sweep reaps any entry whose owner is
+# not ALIVE and whose last write is older than the TTL. The write-stamp
+# grace matters: worker hosts advertise KV BEFORE register_node, so a
+# fresh key with no ALIVE owner yet is a joiner, not a corpse.
+GOSSIP_NODE_PREFIXES: Tuple[str, ...] = (
+    "object_transfer/",       # transfer-plane address (object_transfer.KV_PREFIX)
+    "object_transfer_load/",  # pull-load ranking gossip (LOAD_PREFIX)
+    "object_transfer_host/",  # same-host shm tokens (HOST_PREFIX)
+    "node_service/",          # dispatch address (cross_host.NODE_SERVICE_PREFIX)
+    "channel_service/",       # DistChannel service (channels.KV_CHANNEL_PREFIX)
+)
+# value-suffix-owned namespaces: key does not embed the node, the value
+# records "...|<node_hex>" (broadcast relay CAS claims)
+GOSSIP_RELAY_PREFIX = "object_transfer_relay/"
+
+
+def _is_gossip_key(key: str) -> bool:
+    return key.startswith(GOSSIP_NODE_PREFIXES) or key.startswith(GOSSIP_RELAY_PREFIX)
 
 
 class NodeState(enum.Enum):
@@ -113,6 +142,11 @@ class ControlPlane:
         self._named_actors: Dict[str, ActorID] = {}
         self._jobs: Dict[JobID, Dict[str, Any]] = {}
         self._kv: Dict[str, bytes] = {}
+        # last-write stamps for gossip-namespace keys only (sweep_gossip);
+        # durable KV (function table, checkpoints, serve config) is never
+        # stamped and never swept
+        self._kv_stamp: Dict[str, float] = {}
+        self._last_sweep = 0.0
         self._placement_groups: Dict[PlacementGroupID, Any] = {}
         # node_id hex -> latest telemetry report (metrics snapshot + role
         # + flush cursors) from that worker process; spans/timeline events
@@ -153,10 +187,9 @@ class ControlPlane:
             # cross_host.NODE_SERVICE_PREFIX, channels.KV_CHANNEL_PREFIX —
             # spelled out here to avoid import cycles)
             hexid = node_id.hex()
-            for prefix in ("object_transfer/", "object_transfer_load/",
-                           "object_transfer_host/",
-                           "node_service/", "channel_service/"):
+            for prefix in GOSSIP_NODE_PREFIXES:
                 self._kv.pop(prefix + hexid, None)
+                self._kv_stamp.pop(prefix + hexid, None)
             # relay claims record "address|flow_label|node_hex"; a dead
             # relay must not stay in any broadcast tree — children time
             # out on its partial and fall back, but new pulls ranking by
@@ -166,6 +199,7 @@ class ControlPlane:
                 val = self._kv.get(key)
                 if isinstance(val, str) and val.rsplit("|", 1)[-1] == hexid:
                     self._kv.pop(key, None)
+                    self._kv_stamp.pop(key, None)
             # and its last telemetry snapshot: a dead node's metrics and
             # digests must not haunt the merged dashboard/health view
             self._telemetry.pop(hexid, None)
@@ -188,6 +222,19 @@ class ControlPlane:
             if resources_available is not None:
                 info.resources_available = dict(resources_available)
             return True
+
+    def heartbeat_bulk(
+        self,
+        beats: List[Tuple[Any, Optional[Dict[str, float]]]],
+    ) -> Dict[str, bool]:
+        """Pod-aggregator heartbeat: one RPC carries a whole pod's beats.
+        ``beats`` is [(node_id, resources_available_or_None)]; the reply
+        maps node hex -> alive verdict, same semantics as `heartbeat` per
+        entry. Keeps head ingest O(pods), not O(nodes)."""
+        out: Dict[str, bool] = {}
+        for node_id, avail in beats:
+            out[node_id.hex()] = self.heartbeat(node_id, avail)
+        return out
 
     # -- federated telemetry ------------------------------------------------
     def report_telemetry(
@@ -345,6 +392,8 @@ class ControlPlane:
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
+            if _is_gossip_key(key):
+                self._kv_stamp[key] = time.monotonic()
             return True
 
     def kv_get(self, key: str) -> Optional[bytes]:
@@ -353,21 +402,68 @@ class ControlPlane:
 
     def kv_del(self, key: str) -> bool:
         with self._lock:
+            self._kv_stamp.pop(key, None)
             return self._kv.pop(key, None) is not None
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         with self._lock:
             return [k for k in self._kv if k.startswith(prefix)]
 
+    def sweep_gossip(self, ttl_s: Optional[float] = None) -> int:
+        """Reap gossip KV entries whose owner node is not ALIVE and whose
+        last write is older than ``ttl_s`` (default
+        config.control_plane_gossip_ttl_s). mark_node_dead already purges
+        on clean deregistration; this catches nodes that died without it.
+        Returns the number of keys removed."""
+        if ttl_s is None:
+            from .config import config
+
+            ttl_s = float(config.control_plane_gossip_ttl_s)
+        horizon = time.monotonic() - ttl_s
+        swept = 0
+        with self._lock:
+            alive = {n.node_id.hex() for n in self._nodes.values()
+                     if n.state is NodeState.ALIVE}
+            doomed: List[str] = []
+            for key in self._kv:
+                if key.startswith(GOSSIP_NODE_PREFIXES):
+                    owner = key.rsplit("/", 1)[-1]
+                elif key.startswith(GOSSIP_RELAY_PREFIX):
+                    val = self._kv.get(key)
+                    owner = (val.rsplit("|", 1)[-1]
+                             if isinstance(val, str) else "")
+                else:
+                    continue
+                if owner in alive:
+                    continue
+                # stamp grace: keys written before the sweep machinery (or
+                # restored from a snapshot) have no stamp — treat as old
+                if self._kv_stamp.get(key, horizon - 1.0) <= horizon:
+                    doomed.append(key)
+            for key in doomed:
+                self._kv.pop(key, None)
+                self._kv_stamp.pop(key, None)
+                swept += 1
+        if swept:
+            _gossip_swept.inc(swept)
+            logger.info("gossip sweep reaped %d stale KV entries", swept)
+        return swept
+
     # -- health checking ----------------------------------------------------
     def check_health(self, timeout_s: float) -> List[NodeID]:
         """Mark nodes dead whose heartbeat is older than timeout. Returns them."""
         now = time.monotonic()
         stale: List[NodeID] = []
+        worst_lag = 0.0
         with self._lock:
             for node_id, info in self._nodes.items():
-                if info.state is NodeState.ALIVE and now - info.last_heartbeat > timeout_s:
+                if info.state is not NodeState.ALIVE:
+                    continue
+                lag = now - info.last_heartbeat
+                worst_lag = max(worst_lag, lag)
+                if lag > timeout_s:
                     stale.append(node_id)
+        _heartbeat_lag.set(worst_lag)
         for node_id in stale:
             self.mark_node_dead(node_id, reason=f"no heartbeat for {timeout_s}s")
         return stale

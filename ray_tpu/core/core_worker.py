@@ -34,14 +34,21 @@ from .node_agent import (
 from .object_store import ObjectLostError, SealedBytes
 from .object_transfer import _cache_hits, _cache_misses
 from .scheduler import ClusterScheduler
+from .metrics import Counter as _MetricCounter
 from .task_spec import (
     PlacementGroupSchedulingStrategy,
+    SchedulingStrategy,
     TaskKind,
     TaskOptions,
     TaskSpec,
 )
 
 logger = get_logger("core_worker")
+
+_m_local_admits = _MetricCounter(
+    "scheduler_local_admits_total",
+    "Tasks admitted by the local node agent's bottom-up fast path "
+    "(no ClusterScheduler view walk)")
 
 
 def _timeline_now_us() -> float:
@@ -346,6 +353,7 @@ class Runtime:
             target=self._scheduling_loop, daemon=True, name="cluster-scheduler"
         )
         self._sched_thread.start()
+        self._last_gossip_sweep = time.monotonic()  # TTL sweep throttle
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True, name="health-monitor"
         )
@@ -989,6 +997,14 @@ class Runtime:
                 object_ledger.sweep(self)
             except Exception:  # noqa: BLE001 — sweep never kills the monitor
                 logger.debug("object leak sweep failed", exc_info=True)
+            now = time.monotonic()
+            ttl = float(config.control_plane_gossip_ttl_s)
+            if now - self._last_gossip_sweep > max(ttl / 4.0, period):
+                self._last_gossip_sweep = now
+                try:
+                    self.control_plane.sweep_gossip()
+                except Exception:  # noqa: BLE001
+                    logger.debug("gossip TTL sweep failed", exc_info=True)
 
     def pending_resource_demand(self) -> List[Dict[str, float]]:
         """Resource shapes of queued-but-unplaced tasks — the autoscaler's
@@ -1036,6 +1052,23 @@ class Runtime:
             return None
         return agent
 
+    def _local_admit(self, spec: TaskSpec, strategy) -> Optional[NodeID]:
+        """Bottom-up fast path: defer to NodeAgent.try_admit on the head's
+        own agent for plain default-strategy tasks. Returns the node to
+        place on, or None = take the global path (which also preserves
+        fail-fast ValueError and the autoscaler's pending-demand signal)."""
+        if not config.scheduler_local_admit:
+            return None
+        if type(strategy) is not SchedulingStrategy:
+            return None  # affinity/spread/label/PG need the cluster view
+        agent = self._usable_agent(self.head_node_id)
+        if agent is None or not hasattr(agent, "try_admit"):
+            return None  # remote/proxied agent: no local view to consult
+        if agent.try_admit(spec.options.resource_demand()):
+            _m_local_admits.inc()
+            return self.head_node_id
+        return None
+
     def _try_place(self, item: _PendingTask) -> bool:
         spec = item.spec
         strategy = spec.options.scheduling_strategy
@@ -1060,15 +1093,23 @@ class Runtime:
                          stream=item.stream)
             return True
 
-        try:
-            node_id = self.scheduler.select_node(
-                spec, preferred_node=self.head_node_id, pg_table=self.pg_table
-            )
-        except ValueError as e:
-            if self.autoscaling_enabled:
-                return False  # keep pending: this demand drives scale-up
-            self._fail_task(item, e)
-            return True
+        # bottom-up fast path: the local node agent admits against its own
+        # resource view (fresher than the control plane's) when the demand
+        # fits under the spread threshold — exactly the node _hybrid's
+        # local-first rule would pick, without walking the cluster view.
+        # Overflow (and every non-default strategy) delegates to the
+        # ClusterScheduler, preserving fail-fast and autoscaler demand.
+        node_id = self._local_admit(spec, strategy)
+        if node_id is None:
+            try:
+                node_id = self.scheduler.select_node(
+                    spec, preferred_node=self.head_node_id, pg_table=self.pg_table
+                )
+            except ValueError as e:
+                if self.autoscaling_enabled:
+                    return False  # keep pending: this demand drives scale-up
+                self._fail_task(item, e)
+                return True
         if node_id is None:
             return False
         agent = self._usable_agent(node_id)
@@ -1399,6 +1440,10 @@ class Runtime:
             agents = list(self.agents.values())
         for agent in agents:
             agent.stop()
+        if getattr(self, "_federation", None) is not None:
+            from .shard import stop_federation
+
+            stop_federation(self)
 
 
 _global_runtime: Optional[Runtime] = None

@@ -67,6 +67,12 @@ _m_tele_dropped = _MetricCounter(
     "Telemetry items dropped by the heartbeat byte budget "
     "(config.telemetry_max_bytes), by kind.")
 
+_m_tele_bytes = _MetricCounter(
+    "telemetry_bytes_total",
+    "Approximate serialized telemetry bytes shipped to the head, by "
+    "field; delta-encoding shows up as these counters going flat while "
+    "the cluster is steady.")
+
 
 def _cap_telemetry(metrics: List[Any], spans: List[Any], events: List[Any],
                    budget: int) -> Tuple[List[Any], List[Any]]:
@@ -1247,6 +1253,17 @@ class WorkerRuntime:
         self.head_address = address
         self._node_host = node_host
         self.control_plane = RemoteControlPlane(address, role="worker")
+        # federated head? adopt shard routing for KV/pubsub so this host's
+        # gossip never rides the head connection (dir_* stays head-routed:
+        # the head's ObjectDirectory is the transfer plane's authority)
+        shard_map = self._probe_shard_map(self.control_plane)
+        if shard_map:
+            from .rpc import ShardedControlPlane
+
+            self.control_plane = ShardedControlPlane(
+                self.control_plane, shard_map["addresses"], role="worker")
+            logger.info("joined a federated control plane (%d shards)",
+                        len(shard_map["addresses"]))
         node_resources = default_node_resources(num_cpus, num_tpus, resources)
         self.info = NodeInfo(
             node_id=NodeID.generate(),
@@ -1294,6 +1311,9 @@ class WorkerRuntime:
         self._telemetry_span_cursor = 0
         self._telemetry_event_cursor = 0
         self._last_telemetry = 0.0
+        # per-field wire-form hashes of the last CONFIRMED report
+        # (delta-encoding: unchanged fields ship as None = keep-previous)
+        self._telemetry_sent_hash: Dict[str, int] = {}
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="worker-heartbeat"
         )
@@ -1340,6 +1360,26 @@ class WorkerRuntime:
         )
         return True
 
+    @staticmethod
+    def _probe_shard_map(cp) -> Optional[Dict[str, Any]]:
+        """Read the head's shard-map advertisement (shard.SHARD_MAP_KEY);
+        None on a single-head cluster or any decode trouble (the plain
+        head connection always works, so adoption is best-effort)."""
+        import json as _json
+
+        try:
+            raw = cp.kv_get("control_plane/shard_map")
+            if not raw:
+                return None
+            if isinstance(raw, bytes):
+                raw = raw.decode("utf-8")
+            parsed = _json.loads(raw)
+            if parsed.get("addresses"):
+                return parsed
+        except Exception:  # noqa: BLE001 — fall back to the head connection
+            logger.debug("shard-map probe failed", exc_info=True)
+        return None
+
     def _rejoin(self) -> None:
         """Re-introduce this host to a restarted head: the snapshot restores
         KV/jobs/named actors but deliberately NOT the node table or object
@@ -1353,6 +1393,9 @@ class WorkerRuntime:
             return
         from .channels import KV_CHANNEL_PREFIX, ensure_service
 
+        # a head that forgot us has no previous telemetry to keep: drop
+        # the delta-encoding hashes so the next flush ships every field
+        self._telemetry_sent_hash.clear()
         try:
             nid = self.node_id.hex()
             self.control_plane.kv_put(
@@ -1453,25 +1496,54 @@ class WorkerRuntime:
         metrics = metrics_registry.snapshot()
         spans, events = _cap_telemetry(
             metrics, spans, events, int(config.telemetry_max_bytes))
+        digests = slo.snapshot()
         postmortems = flight_recorder.drain_postmortems()
+        # delta-encoding: report_telemetry is replace-not-append with
+        # None = keep-previous per field, so an unchanged snapshot need
+        # not re-ship — hash the wire form and send None on a match
+        # (reported_at still refreshes head-side, so stale-eviction is
+        # unaffected). Steady-state heartbeats shrink to near-empty
+        # payloads BEFORE pod aggregation even starts.
+        payload: Dict[str, Any] = {"metrics": metrics, "digests": digests,
+                                   "objects": objects, "channels": channels}
+        sent_hashes: Dict[str, int] = {}
+        for field, value in payload.items():
+            # hash the metrics field with telemetry_bytes_total itself
+            # filtered out: shipping the snapshot increments that counter,
+            # which would change the NEXT snapshot and keep the field
+            # re-shipping forever
+            hashed = value
+            if field == "metrics":
+                hashed = [m for m in value
+                          if m.get("name") != "telemetry_bytes_total"]
+            blob = _dumps(hashed)
+            digest = hash(blob)
+            if self._telemetry_sent_hash.get(field) == digest:
+                payload[field] = None
+            else:
+                sent_hashes[field] = digest
+                _m_tele_bytes.inc(len(blob), {"field": field})
         try:
             self.control_plane.report_telemetry(
                 self.node_id.hex(),
                 role="worker",
-                metrics=metrics,
+                metrics=payload["metrics"],
                 spans=spans,
                 events=events,
                 event_cursor=event_cur,
-                digests=slo.snapshot(),
+                digests=payload["digests"],
                 postmortems=postmortems,
-                objects=objects,
-                channels=channels,
+                objects=payload["objects"],
+                channels=payload["channels"],
                 _deadline_s=5.0,
             )
         except (ControlPlaneUnavailable, WireError, OSError, RuntimeError) as e:
             logger.debug("telemetry flush failed (%s); retrying next beat", e)
             flight_recorder.requeue_postmortems(postmortems)
             return
+        # hashes advance only on a confirmed report (like the cursors): a
+        # failed flush re-ships the field next beat
+        self._telemetry_sent_hash.update(sent_hashes)
         self._telemetry_span_cursor = span_cur
         self._telemetry_event_cursor = event_cur
         self._last_telemetry = now

@@ -84,6 +84,22 @@ def _preboot_forkserver() -> None:
         logger.debug("forkserver preboot failed", exc_info=True)
 
 
+def admits(total: Dict[str, float], available: Dict[str, float],
+           demand: Dict[str, float], spread_threshold: float) -> bool:
+    """The bottom-up local-admission rule (shared by NodeAgent.try_admit
+    and the scale harness's simulated agents): feasible against totals,
+    available right now, and current utilization under the spread
+    threshold — exactly ClusterScheduler._hybrid's local-first gate, so a
+    local admission matches the global policy's choice."""
+    if not all(total.get(k, 0.0) >= v for k, v in demand.items()):
+        return False
+    if not all(available.get(k, 0.0) >= v - 1e-9 for k, v in demand.items()):
+        return False
+    util = max((1.0 - available.get(k, 0.0) / t
+                for k, t in total.items() if t > 0), default=0.0)
+    return util < spread_threshold
+
+
 class ResourceTracker:
     """Node-local resource ledger with blocking acquire semantics."""
 
@@ -315,6 +331,25 @@ class NodeAgent:
         )
 
     # ------------------------------------------------------------------ api
+    def try_admit(self, demand: Dict[str, float],
+                  spread_threshold: Optional[float] = None) -> bool:
+        """Bottom-up scheduling probe (reference: Ray's two-level local-
+        first scheduler, arXiv:1712.05889 §4.2): would this node admit the
+        demand right now, judged against the agent's OWN resource tracker
+        — fresher than the control plane's eventually-consistent view.
+        Mirrors ClusterScheduler._hybrid's local-first rule (feasible +
+        available + utilization under the spread threshold), so a local
+        admission is exactly the placement the global policy would have
+        picked; anything else overflows to the ClusterScheduler. View-only:
+        resources are still acquired by the executing worker, the same
+        admission-vs-execution race the global path has."""
+        if self._stopped.is_set():
+            return False
+        if spread_threshold is None:
+            spread_threshold = float(config.scheduler_spread_threshold)
+        return admits(self.resources.total, self.resources.available(),
+                      demand, spread_threshold)
+
     def submit(self, spec: TaskSpec, done: DoneCallback,
                stream: Optional[Callable[[int, ObjectID], None]] = None) -> None:
         """Dispatch once dependencies are local. Resources are acquired by the

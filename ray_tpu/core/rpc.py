@@ -23,9 +23,13 @@ though request ids restart at 1 on each connection.
 Threading model: one handler thread per connection (control-plane call
 rates are low; no need for an event loop), one push thread per subscribed
 client. The client proxy serializes request/response pairs over one
-socket with a lock and routes pushed events to its Pubsub from a
-per-connection reader thread; a short-lived reconnect thread re-dials
-after a loss and exits once a connection is installed.
+socket with a lock; pushed events are queued by the per-connection reader
+thread and delivered to the local Pubsub from a dedicated dispatcher
+thread — subscriber callbacks may therefore issue RPCs on this same
+client (a callback running ON the reader would deadlock: the reply it
+waits for can only be decoded by the reader it is blocking). A
+short-lived reconnect thread re-dials after a loss and exits once a
+connection is installed.
 
 Registry invariant (machine-enforced by `ray_tpu.tools.raylint` rule R3):
 `_IDEMPOTENT_METHODS` must be a subset of `_ALLOWED_METHODS` — a
@@ -37,10 +41,13 @@ when a blind resend after an ambiguous connection loss is safe.
 
 from __future__ import annotations
 
+import queue
+import random
 import socket
 import socketserver
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Set
 
 from .config import config
@@ -54,11 +61,15 @@ _reconnects_total = Counter(
     "control_plane_reconnects_total",
     "Control-plane client connections re-established after a loss, by role",
 )
+_redials_throttled = Counter(
+    "control_plane_redials_throttled_total",
+    "Reconnect dial attempts delayed by the process-wide dial-rate cap",
+)
 
 # the served surface (N1's public API): anything else is rejected
 _ALLOWED_METHODS: Set[str] = {
-    "register_node", "mark_node_dead", "heartbeat", "alive_nodes",
-    "get_node", "all_nodes",
+    "register_node", "mark_node_dead", "heartbeat", "heartbeat_bulk",
+    "alive_nodes", "get_node", "all_nodes",
     "report_telemetry", "telemetry_snapshots", "postmortems",
     # profiling plane (util/profiler.py via cross_host.HeadService):
     # stack dumps / sampling profiles / xplane captures on any node
@@ -87,7 +98,7 @@ _ALLOWED_METHODS: Set[str] = {
 # proxy_submit_*, ...) surfaces ControlPlaneUnavailable instead — a blind
 # resend could duplicate the mutation, so the caller decides.
 _IDEMPOTENT_METHODS: Set[str] = {
-    "heartbeat", "alive_nodes", "get_node", "all_nodes",
+    "heartbeat", "heartbeat_bulk", "alive_nodes", "get_node", "all_nodes",
     # telemetry: metrics replace the prior snapshot, spans dedupe by id,
     # timeline events are cursor-guarded — a resend is absorbed
     "report_telemetry", "telemetry_snapshots", "postmortems",
@@ -101,6 +112,57 @@ _IDEMPOTENT_METHODS: Set[str] = {
     "proxy_job_id", "proxy_ref_state", "proxy_keepalive", "proxy_free",
     "proxy_pin", "proxy_get_value",
 }
+
+
+def shard_for_key(key: str, nshards: int) -> int:
+    """Consistent key→shard routing for the federated control plane.
+
+    Stable across processes and Python runs (crc32, not hash()): every
+    client and every shard service must agree on ownership, including a
+    client that reconnects after a shard failover. Keys hash as raw
+    strings — no namespace stripping — so a key's owner never depends on
+    how callers spell prefixes."""
+    if nshards <= 1:
+        return 0
+    return zlib.crc32(key.encode("utf-8", "surrogatepass")) % nshards
+
+
+class _DialGate:
+    """Process-wide reconnect dial-rate cap (token bucket).
+
+    128 agents that all lost the same shard must not thundering-herd the
+    restarted/promoted listener with simultaneous SYNs + resubscribe
+    bursts: every reconnect dial in this process first takes a token
+    here (config ``control_plane_redial_rate`` tokens/s, burst of one
+    second's worth). First dials at construction are NOT gated — join
+    latency is user-visible; only the storm-prone redial path is."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tokens = 0.0
+        self._stamp = time.monotonic()
+
+    def acquire(self, cancel: threading.Event) -> None:
+        rate = float(config.control_plane_redial_rate)
+        if rate <= 0:
+            return  # cap disabled
+        throttled = False
+        while not cancel.is_set():
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(rate, self._tokens + (now - self._stamp) * rate)
+                self._stamp = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / rate
+            if not throttled:
+                throttled = True
+                _redials_throttled.inc()
+            cancel.wait(min(wait, 0.5))
+
+
+_dial_gate = _DialGate()
 
 
 class ControlPlaneUnavailable(ConnectionError):
@@ -155,7 +217,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     unsub_cell.append(unsub)
                     unsubscribes.append(unsub)
                     resp = {"id": req["id"], "ok": True, "value": True}
-                elif method not in _ALLOWED_METHODS:
+                elif method not in server.allowed_methods:
                     resp = {"id": req["id"], "ok": False,
                             "error": f"method {method!r} not served", "exc": None}
                 else:
@@ -199,9 +261,14 @@ class ControlPlaneServer(socketserver.ThreadingTCPServer):
     # would hang until every client disconnects — stop() severs them instead
     block_on_close = False
 
-    def __init__(self, control_plane, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, control_plane, host: str = "127.0.0.1", port: int = 0,
+                 allowed_methods: Optional[Set[str]] = None):
         super().__init__((host, port), _Handler)
         self.control_plane = control_plane
+        # per-service registry: shard / aggregator services reuse this
+        # server with their own (raylint-R3-checked) literal allowlists
+        self.allowed_methods = (allowed_methods if allowed_methods is not None
+                                else _ALLOWED_METHODS)
         self._conn_lock = threading.Lock()
         self._conns: Set[socket.socket] = set()
         self._thread = threading.Thread(
@@ -286,15 +353,27 @@ class RemoteControlPlane:
     ControlPlaneUnavailable, bounded by the per-call deadline."""
 
     def __init__(self, address: str, connect_timeout: float = 10.0,
-                 role: str = "client"):
+                 role: str = "client",
+                 allowed: Optional[Set[str]] = None,
+                 idempotent: Optional[Set[str]] = None):
         from .control_plane import Pubsub
 
         self._address = address
         self._connect_timeout = connect_timeout
         self._role = role
+        # per-service registries (default: the head surface) — shard and
+        # aggregator clients pass their own literal sets
+        self._allowed = allowed if allowed is not None else _ALLOWED_METHODS
+        self._idempotent = (idempotent if idempotent is not None
+                            else _IDEMPOTENT_METHODS)
         self.pubsub = Pubsub()
         self._subscribed: Set[str] = set()
         self._sub_lock = threading.Lock()
+        # events are delivered off-reader (see module docstring): the
+        # dispatcher thread starts lazily with the first pushed event
+        self._event_q: "queue.SimpleQueue[Optional[tuple]]" = queue.SimpleQueue()
+        self._event_thread: Optional[threading.Thread] = None
+        self._event_lock = threading.Lock()
         self._closed = threading.Event()
         self._conn_cv = threading.Condition()
         self._conn: Optional[_Conn] = None
@@ -328,7 +407,7 @@ class RemoteControlPlane:
             while True:
                 msg_type, payload = recv_msg(conn.sock)
                 if msg_type == MSG_EVENT:
-                    self.pubsub.publish(payload["channel"], payload["message"])
+                    self._enqueue_event(payload["channel"], payload["message"])
                 elif msg_type == MSG_RESPONSE:
                     with conn.cv:
                         conn.replies[payload["id"]] = payload
@@ -338,6 +417,26 @@ class RemoteControlPlane:
         finally:
             conn.close()
             self._on_conn_lost(conn)
+
+    def _enqueue_event(self, channel: str, message: Any) -> None:
+        self._event_q.put((channel, message))
+        t = self._event_thread
+        if t is not None and t.is_alive():
+            return
+        with self._event_lock:
+            t = self._event_thread
+            if (t is None or not t.is_alive()) and not self._closed.is_set():
+                t = threading.Thread(target=self._event_loop, daemon=True,
+                                     name="cp-rpc-events")
+                self._event_thread = t
+                t.start()
+
+    def _event_loop(self) -> None:
+        while True:
+            item = self._event_q.get()
+            if item is None or self._closed.is_set():
+                return
+            self.pubsub.publish(*item)
 
     def _on_conn_lost(self, conn: _Conn) -> None:
         with self._conn_cv:
@@ -354,14 +453,22 @@ class RemoteControlPlane:
         ).start()
 
     def _reconnect_loop(self) -> None:
-        backoff = 0.05
+        # Decorrelated jitter (not pure doubling): N clients that lost the
+        # same shard at the same instant must desynchronize, or every
+        # backoff round re-delivers the whole herd at once. Each sleep is
+        # drawn from [base, 3*previous], capped at the config maximum; the
+        # process-wide _DialGate then rate-limits the dials themselves.
+        cap = max(0.05, config.control_plane_reconnect_max_s)
+        backoff = random.uniform(0.05, 0.15)
         while not self._closed.is_set():
+            _dial_gate.acquire(self._closed)
+            if self._closed.is_set():
+                return
             try:
                 conn = self._dial()
             except OSError:
                 self._closed.wait(backoff)
-                backoff = min(backoff * 2,
-                              max(0.05, config.control_plane_reconnect_max_s))
+                backoff = min(cap, random.uniform(0.05, backoff * 3))
                 continue
             # re-register every subscribed channel BEFORE installing the
             # connection, so pubsub resumes atomically with the reconnect
@@ -374,8 +481,7 @@ class RemoteControlPlane:
             except Exception:  # noqa: BLE001 — died mid-resubscribe: redial
                 conn.close()
                 self._closed.wait(backoff)
-                backoff = min(backoff * 2,
-                              max(0.05, config.control_plane_reconnect_max_s))
+                backoff = min(cap, random.uniform(0.05, backoff * 3))
                 continue
             with self._conn_cv:
                 if self._closed.is_set():
@@ -464,7 +570,7 @@ class RemoteControlPlane:
         if _deadline_s is None:
             _deadline_s = config.control_plane_call_deadline_s
         deadline = time.monotonic() + _deadline_s
-        retryable = method in _IDEMPOTENT_METHODS
+        retryable = method in self._idempotent
         while True:
             conn = self._wait_conn(deadline, method)
             try:
@@ -515,11 +621,17 @@ class RemoteControlPlane:
             self._conn_cv.notify_all()
         if conn is not None:
             conn.close()
+        self._event_q.put(None)  # unblock the dispatcher so it exits
+        t = self._event_thread
+        if t is not None and t is not threading.current_thread():
+            # in-flight callbacks fail fast post-close (_wait_conn raises),
+            # so this join is a bounded courtesy for the leak guard
+            t.join(timeout=5.0)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        if name not in _ALLOWED_METHODS:
+        if name not in self._allowed:
             raise AttributeError(f"{name!r} is not part of the served surface")
 
         def call(*args, **kwargs):
@@ -527,3 +639,123 @@ class RemoteControlPlane:
 
         call.__name__ = name
         return call
+
+
+# methods whose first positional argument is the routing key (a KV key or a
+# pubsub channel): these go to the owning shard, the rest of the surface
+# rides the head connection
+_SHARD_ROUTED_METHODS: Set[str] = {
+    "kv_put", "kv_get", "kv_del",
+    "publish", "subscribe",
+}
+
+# object-location gossip routes to the shards only when the client opts in
+# (route_directory=True — the scale harness / pure-gossip fleets). Real
+# worker hosts keep dir_* on the head connection: the head's in-process
+# ObjectDirectory is the authority its scheduler, lineage reconstruction
+# and pull planner read, so splitting writes away from it would fork the
+# directory view.
+_SHARD_DIR_METHODS: Set[str] = {
+    "dir_add_location", "dir_remove_location", "dir_locations",
+}
+
+
+class ShardedControlPlane:
+    """Client for a federated control plane: one head connection for the
+    node/actor/job/telemetry tables, K shard connections for the KV store,
+    pubsub fan-out, and (opt-in) object-directory gossip (consistent
+    routing via `shard_for_key`). Duck-compatible with RemoteControlPlane —
+    a worker runtime swaps it in without caring. Every underlying
+    connection keeps its own PR 4 reconnect loop, so a shard failover is
+    ridden out per-connection while head traffic continues untouched."""
+
+    def __init__(self, head_address, shard_addresses: List[str],
+                 connect_timeout: float = 10.0, role: str = "client",
+                 route_directory: bool = False):
+        from .shard import _SHARD_ALLOWED_METHODS, _SHARD_IDEMPOTENT_METHODS
+
+        # an already-connected head client may be handed over (the worker
+        # join path probes the shard map on its head connection first)
+        self._head = (head_address
+                      if isinstance(head_address, RemoteControlPlane)
+                      else RemoteControlPlane(
+                          head_address, connect_timeout=connect_timeout,
+                          role=role))
+        self._routed = (_SHARD_ROUTED_METHODS | _SHARD_DIR_METHODS
+                        if route_directory else _SHARD_ROUTED_METHODS)
+        self._shards = [
+            RemoteControlPlane(
+                addr, connect_timeout=connect_timeout,
+                role=f"{role}-shard{i}",
+                allowed=_SHARD_ALLOWED_METHODS,
+                idempotent=_SHARD_IDEMPOTENT_METHODS)
+            for i, addr in enumerate(shard_addresses)
+        ]
+        self.pubsub = self._head.pubsub  # head-channel events land here
+
+    # -- routing -------------------------------------------------------------
+    @property
+    def head(self) -> RemoteControlPlane:
+        return self._head
+
+    @property
+    def shards(self) -> List[RemoteControlPlane]:
+        return list(self._shards)
+
+    def _shard_client(self, key: str) -> RemoteControlPlane:
+        return self._shards[shard_for_key(key, len(self._shards))]
+
+    def _call(self, method: str, *args, **kwargs) -> Any:
+        if method in self._routed and self._shards and args:
+            return self._shard_client(args[0])._call(method, *args, **kwargs)
+        if method == "kv_keys":
+            return self.kv_keys(*args, **kwargs)
+        return self._head._call(method, *args, **kwargs)
+
+    def kv_keys(self, prefix: str = "", **kwargs) -> List[str]:
+        """Prefix listing fans out: a prefix does not pin a shard (keys
+        route on their FULL string), so the union across shards is the
+        authoritative listing."""
+        out: List[str] = []
+        for client in self._shards:
+            out.extend(client._call("kv_keys", prefix, **kwargs))
+        return out
+
+    def subscribe(self, channel: str, callback) -> Any:
+        return self._shard_client(channel).subscribe(channel, callback)
+
+    def add_reconnect_listener(self, cb: Callable[[], None]) -> Callable[[], None]:
+        """Fires after ANY underlying connection re-establishes: rejoin
+        logic re-puts KV (shard-owned) and re-registers the node (head-
+        owned), and the whole sequence is idempotent, so re-running it on
+        either kind of reconnect is safe and always sufficient."""
+        removers = [self._head.add_reconnect_listener(cb)]
+        removers += [s.add_reconnect_listener(cb) for s in self._shards]
+
+        def remove() -> None:
+            for r in removers:
+                r()
+
+        return remove
+
+    @property
+    def reconnect_count(self) -> int:
+        return self._head.reconnect_count + sum(
+            s.reconnect_count for s in self._shards)
+
+    def close(self) -> None:
+        self._head.close()
+        for s in self._shards:
+            s.close()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._routed and self._shards:
+
+            def routed(*args, **kwargs):
+                return self._call(name, *args, **kwargs)
+
+            routed.__name__ = name
+            return routed
+        return getattr(self._head, name)

@@ -68,6 +68,9 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
     # likewise the fleet board's series live in serve/fleet.py (which
     # pulls in disagg's resume metrics)
     from .serve import fleet  # noqa: F401
+    # the federation board's shard/aggregator series register on import
+    # (neither module loads unless a sharded control plane is enabled)
+    from .core import aggregator, shard  # noqa: F401
     core = _dashboard("raytpu-core", "ray_tpu / core", [
         _panel("Tasks finished (rate)", "rate(ray_tpu_tasks_finished[1m])",
                0, 0, legend="{{outcome}}"),
@@ -250,9 +253,47 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "dropped {{reason}}",
         "refId": "B",
     })
+    federation = _dashboard("raytpu-federation", "ray_tpu / control plane federation", [
+        _panel("Head CPU used fraction", "host_cpu_used_fraction",
+               0, 0, unit="percentunit", legend="{{node_id}}"),
+        _panel("Heartbeat lag (worst alive node)",
+               "control_plane_heartbeat_lag_seconds", 1, 0, unit="s",
+               legend="worst lag"),
+        _panel("Shard health (1 = primary serving)",
+               "control_plane_shard_health", 2, 8, legend="shard {{shard}}"),
+        _panel("Shard failovers (rate)",
+               "rate(control_plane_shard_failovers_total[5m])", 3, 8,
+               legend="shard {{shard}}"),
+        _panel("Client reconnects / throttled redials (rate)",
+               "rate(control_plane_reconnects_total[5m])", 4, 16,
+               legend="reconnect {{role}}"),
+        _panel("Pubsub publishes dropped (rate)",
+               "rate(control_plane_pubsub_dropped_total[5m])", 5, 16,
+               legend="{{channel}}"),
+        _panel("Aggregator flushes / reports absorbed (rate)",
+               "rate(aggregator_flushes_total[5m])", 6, 24,
+               legend="flush {{pod}}"),
+        _panel("Telemetry shipped (delta-encoded B/s)",
+               "rate(telemetry_bytes_total[5m])", 7, 24, unit="Bps",
+               legend="{{field}}"),
+        _panel("Gossip entries swept (rate)",
+               "rate(control_plane_gossip_swept_total[5m])", 8, 32),
+    ])
+    # the dial-rate cap overlaid on the reconnect panel: a storm shows as
+    # throttled redials climbing while reconnects stay flat
+    federation["panels"][4]["targets"].append({
+        "expr": "rate(control_plane_redials_throttled_total[5m])",
+        "legendFormat": "throttled {{role}}",
+        "refId": "B",
+    })
+    federation["panels"][6]["targets"].append({
+        "expr": "rate(aggregator_reports_absorbed_total[5m])",
+        "legendFormat": "absorbed {{pod}}",
+        "refId": "B",
+    })
     return {"core": core, "serve": serve, "data": data, "disagg": disagg,
             "health": health, "profiling": profiling, "objects": objects,
-            "fleet": fleet, "rl": rl}
+            "fleet": fleet, "rl": rl, "federation": federation}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:

@@ -421,11 +421,24 @@ class _R2Visitor(ast.NodeVisitor):
 
 
 # ---------------------------------------------------------------------------
-# R3: rpc registry consistency (core/rpc.py)
+# R3: rpc registry consistency (core/rpc.py, core/shard.py,
+# core/aggregator.py)
 # ---------------------------------------------------------------------------
+
+# every module that serves an RPC surface; each declares one or more
+# *ALLOWED_METHODS / *IDEMPOTENT_METHODS registry pairs (e.g. the shard
+# module carries both _SHARD_* and _STANDBY_* services)
+_R3_FILES = ("core/rpc.py", "core/shard.py", "core/aggregator.py")
+_R3_SUFFIXES = ("ALLOWED_METHODS", "IDEMPOTENT_METHODS")
+
 
 def _check_rpc_registry(path: str, tree: ast.Module,
                         findings: List[Finding]) -> None:
+    """Per-service registry pairs, grouped by name prefix: for every
+    ``<prefix>ALLOWED_METHODS`` there must be a literal
+    ``<prefix>IDEMPOTENT_METHODS`` (and vice versa), entries must be
+    unique, and idempotent ⊆ allowed — a transparent retry of a method
+    the service doesn't serve would loop into rejections."""
     sets: Dict[str, Tuple[int, List[str]]] = {}
     for node in tree.body:
         if not isinstance(node, ast.AnnAssign) and not isinstance(
@@ -435,8 +448,7 @@ def _check_rpc_registry(path: str, tree: ast.Module,
                    else [node.target])
         value = node.value
         for t in targets:
-            if isinstance(t, ast.Name) and t.id in (
-                    "_ALLOWED_METHODS", "_IDEMPOTENT_METHODS"):
+            if isinstance(t, ast.Name) and t.id.endswith(_R3_SUFFIXES):
                 if isinstance(value, ast.Set) and all(
                         isinstance(e, ast.Constant) for e in value.elts):
                     sets[t.id] = (node.lineno,
@@ -446,11 +458,17 @@ def _check_rpc_registry(path: str, tree: ast.Module,
                         path, node.lineno, "R3",
                         f"{t.id} must be a literal set of strings so the "
                         f"registry stays machine-checkable"))
-    if "_ALLOWED_METHODS" not in sets or "_IDEMPOTENT_METHODS" not in sets:
+    pairs: Dict[str, Dict[str, Tuple[int, List[str]]]] = {}
+    for name, entry in sets.items():
+        for suffix in _R3_SUFFIXES:
+            if name.endswith(suffix):
+                pairs.setdefault(name[:-len(suffix)], {})[suffix] = entry
+                break
+    if not pairs:
         findings.append(Finding(
             path, 1, "R3",
-            "core/rpc.py must declare both _ALLOWED_METHODS and "
-            "_IDEMPOTENT_METHODS as literal sets"))
+            f"{path} must declare ALLOWED_METHODS and IDEMPOTENT_METHODS "
+            f"registry pairs as literal sets"))
         return
     for name, (lineno, elts) in sets.items():
         seen: Set[str] = set()
@@ -459,14 +477,24 @@ def _check_rpc_registry(path: str, tree: ast.Module,
                 findings.append(Finding(
                     path, lineno, "R3", f"duplicate entry {e!r} in {name}"))
             seen.add(e)
-    allowed = set(sets["_ALLOWED_METHODS"][1])
-    idem_line, idem = sets["_IDEMPOTENT_METHODS"]
-    for name in sorted(set(idem) - allowed):
-        findings.append(Finding(
-            path, idem_line, "R3",
-            f"{name!r} is in _IDEMPOTENT_METHODS but not in "
-            f"_ALLOWED_METHODS: a transparent retry would loop into "
-            f"'method not served' rejections — allowlist it or drop it"))
+    for prefix in sorted(pairs):
+        pair = pairs[prefix]
+        if len(pair) != len(_R3_SUFFIXES):
+            findings.append(Finding(
+                path, 1, "R3",
+                f"registry {prefix}* must declare both "
+                f"{prefix}ALLOWED_METHODS and {prefix}IDEMPOTENT_METHODS "
+                f"as literal sets"))
+            continue
+        allowed = set(pair["ALLOWED_METHODS"][1])
+        idem_line, idem = pair["IDEMPOTENT_METHODS"]
+        for name in sorted(set(idem) - allowed):
+            findings.append(Finding(
+                path, idem_line, "R3",
+                f"{name!r} is in {prefix}IDEMPOTENT_METHODS but not in "
+                f"{prefix}ALLOWED_METHODS: a transparent retry would loop "
+                f"into 'method not served' rejections — allowlist it or "
+                f"drop it"))
 
 
 # ---------------------------------------------------------------------------
@@ -852,8 +880,7 @@ def lint_sources(file_map: Dict[str, str],
             _R1Visitor(per_file, path).visit(tree)
         if "R2" in rules:
             _R2Visitor(per_file, path).visit(tree)
-        if "R3" in rules and path.replace(os.sep, "/").endswith(
-                "core/rpc.py"):
+        if "R3" in rules and path.replace(os.sep, "/").endswith(_R3_FILES):
             _check_rpc_registry(path, tree, per_file)
         if "R4" in rules:
             _R4Visitor(per_file, path, tree).visit(tree)

@@ -202,6 +202,27 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]
     return out
 
 
+def merged_to_snapshots(
+    merged: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Inverse of merge_snapshots back to wire form: a pod aggregator
+    pre-merges its members' digests, then ships the merged set onward as
+    ordinary snapshots (so head-side merge/quantile code is unchanged —
+    merging is associative over the shared bucket bounds)."""
+    out: List[Dict[str, Any]] = []
+    for (name, tags), m in merged.items():
+        out.append({
+            "name": name,
+            "tags": [list(kv) for kv in tags],
+            "counts": {i: c for i, c in enumerate(m["counts"]) if c},
+            "count": m["count"],
+            "sum": m["sum"],
+            "min": m["min"],
+            "max": m["max"],
+        })
+    return out
+
+
 # -- per-process registry ---------------------------------------------------
 
 _digests: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Digest] = {}

@@ -171,8 +171,10 @@ class TestMetricsFederation:
 
 class TestGrafana:
     def test_dashboards_reference_real_metrics(self):
+        import ray_tpu.core.aggregator  # noqa: F401 — registers pod-aggregator metrics
         import ray_tpu.core.channels  # noqa: F401 — registers channel metrics
         import ray_tpu.core.cross_host  # noqa: F401 — registers metrics
+        import ray_tpu.core.shard  # noqa: F401 — registers shard federation metrics
         import ray_tpu.core.memory_monitor  # noqa: F401 — registers metrics
         import ray_tpu.core.object_transfer  # noqa: F401 — registers metrics
         import ray_tpu.data.executor  # noqa: F401 — registers data metrics
@@ -196,7 +198,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 9  # core, data, serve, disagg, health, profiling, objects, fleet, rl
+        assert len(jsons) == 10  # core, data, serve, disagg, health, profiling, objects, fleet, rl, federation
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p
