@@ -33,17 +33,18 @@ LIB_TESTS = tests/test_data.py tests/test_train.py tests/test_tune.py \
 	tests/test_serve_cross_host.py tests/test_disagg.py \
 	tests/test_fleet.py tests/test_rl_online.py tests/test_dashboard.py \
 	tests/test_integrations.py tests/test_platform.py \
-	tests/test_microbenchmark.py tests/test_pipeline_trainer.py
+	tests/test_microbenchmark.py tests/test_pipeline_trainer.py \
+	tests/test_ingest.py
 
 MODEL_TESTS = tests/test_models.py tests/test_ops.py tests/test_parallel.py \
 	tests/test_pipeline.py tests/test_bootstrap_multiproc.py \
 	tests/test_graft_entry.py tests/test_scale_lowering.py
 
 .PHONY: check check-slow check-all chaos health pipeline profile memory \
-	broadcast fleet rl tsan shm lint spec-smoke shard-smoke scale \
+	broadcast fleet rl ingest tsan shm lint spec-smoke shard-smoke scale \
 	status bench-data bench-object bench-serve bench-disagg bench-trace \
 	bench-health bench-pipeline bench-profile bench-sanitize bench-fleet \
-	bench-rl bench-spec bench-scale
+	bench-rl bench-spec bench-scale bench-ingest
 
 # quick data-plane iteration loop: just the data + images bench suites
 # (stall %, rows/s, images/s), merged into BENCH_SUMMARY.json
@@ -119,6 +120,15 @@ bench-fleet:
 # into BENCH_SUMMARY.json
 bench-rl:
 	env RAY_TPU_BENCH_SUITE=rl python bench.py
+
+# shared ingest gate: three tenants (trainer / RL / batch) off one fixed
+# pool must split throughput within 10%% of their weights
+# (ingest_fair_share_err_pct), a repeat epoch must stream >=3x faster
+# from the object cache (ingest_repeat_epoch_speedup), and a stalling
+# hog tenant must grow the pool within two eval periods
+# (ingest_autoscale_latency_s), merged into BENCH_SUMMARY.json
+bench-ingest:
+	env RAY_TPU_BENCH_SUITE=ingest python bench.py
 
 # cluster health at a glance (alerts, SLO digests, node liveness) from
 # the in-process health plane; DASH=host:port reads a running head
@@ -238,6 +248,13 @@ fleet:
 rl:
 	@echo "== online RL tier =="
 	$(PYTEST) -m rl tests/
+
+# shared ingest-service tier (prefetch lifecycle, fair-share admission,
+# repeat-epoch cache economics, pool autoscale) for iterating on
+# data/ingest work; also runs inside check via LIB_TESTS
+ingest:
+	@echo "== shared ingest tier =="
+	$(PYTEST) -m ingest tests/
 
 check-all: check check-slow
 

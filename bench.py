@@ -1053,6 +1053,160 @@ def bench_data() -> None:
     _emit("data_rows_per_sec", rows / total, "rows/s", "data_rows_anchor")
 
 
+def bench_ingest() -> None:
+    """Shared multi-tenant ingest service gate (ISSUE 20), three phases:
+
+    A. fair share -- three tenants (trainer:3 / rl:2 / batch:1) drain
+       identical datasets through a fixed 2-worker pool; at the moment
+       the first tenant finishes, every tenant's served-bytes share must
+       sit within 10% of its weight target (ingest_fair_share_err_pct).
+    B. repeat epoch -- the PIN_INGEST block cache must make a second
+       pass over the same registration >= 3x faster than the cold one
+       (ingest_repeat_epoch_speedup).
+    C. autoscale -- a stalling hog tenant on a 1-worker pool must trigger
+       a scale-up within two controller eval periods
+       (ingest_autoscale_latency_s).
+    """
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.core import config
+    from ray_tpu.data.ingest import IngestService
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+
+    rows_per_block = 2048
+
+    def preprocess(batch):
+        time.sleep(0.004)  # stand-in tokenize/augment cost per block
+        x = batch["id"].astype(np.float32)
+        return {"x": np.sqrt(x + 1.0)}
+
+    def make_ds(n_blocks):
+        return rd.range(n_blocks * rows_per_block,
+                        parallelism=n_blocks).map_batches(preprocess)
+
+    def drain(iterator, counts, key):
+        n = 0
+        for batch in iterator.iter_batches(batch_size=4096):
+            n += len(batch["x"])
+        counts[key] = n
+
+    # --- phase A: weighted fair share on a fixed pool ------------------
+    # quantum ~= one block so DRR rounds stay fine-grained; otherwise the
+    # share snapshot aliases on whole multi-block service rounds.
+    svc = IngestService(pool_min=2, pool_max=2, autoscale=False,
+                        quantum_bytes=8 * 1024)
+    weights = {"trainer": 3.0, "rl": 2.0, "batch": 1.0}
+    n_blocks = 48
+    counts: dict = {}
+    iters = {name: svc.register(make_ds(n_blocks), tenant=name, weight=w)
+             for name, w in weights.items()}
+    threads = [threading.Thread(target=drain, args=(iters[n], counts, n),
+                                name=f"bench-ingest-{n}", daemon=True)
+               for n in weights]
+    for t in threads:
+        t.start()
+    # fairness is only defined while the pool is the bottleneck: snapshot
+    # shares the moment the heaviest tenant drains its final block.
+    snap = None
+    deadline = time.perf_counter() + 120.0
+    while time.perf_counter() < deadline:
+        shares = svc.shares()
+        if any(s.get("served_blocks", 0) >= n_blocks
+               for s in shares.values()):
+            snap = shares
+            break
+        time.sleep(0.002)
+    for t in threads:
+        t.join(timeout=120.0)
+    svc.shutdown()
+    if snap is None or any(t.is_alive() for t in threads):
+        raise RuntimeError("bench-ingest: fair-share phase never finished")
+    err_pct = max(
+        abs(s["share"] - s["target"]) / s["target"] * 100.0
+        for s in snap.values())
+    print(
+        "# ingest fair-share: "
+        + " ".join(f"{k}={s['share']:.3f}/{s['target']:.3f}"
+                   for k, s in sorted(snap.items())),
+        file=sys.stderr,
+    )
+
+    # --- phase B: repeat-epoch cache economics -------------------------
+    svc = IngestService(pool_min=2, pool_max=2, autoscale=False)
+    it = svc.register(make_ds(32), tenant="trainer")
+    epochs: dict = {}
+    t0 = time.perf_counter()
+    drain(it, epochs, "cold")
+    cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    drain(it, epochs, "warm")
+    warm_s = time.perf_counter() - t0
+    svc.shutdown()
+    if epochs["cold"] != epochs["warm"]:
+        raise RuntimeError(
+            f"bench-ingest: epoch row mismatch cold={epochs['cold']} "
+            f"warm={epochs['warm']}")
+    speedup = cold_s / warm_s if warm_s > 0 else float("inf")
+    print(f"# ingest repeat-epoch: cold={cold_s:.3f}s warm={warm_s:.3f}s",
+          file=sys.stderr)
+
+    # --- phase C: stall-driven autoscale latency -----------------------
+    eval_period = float(config.get("ingest_eval_period_s"))
+    svc = IngestService(pool_min=1, pool_max=3, autoscale=True)
+
+    def slow_preprocess(batch):
+        time.sleep(0.02)  # starve the 1-worker pool -> ingest stall
+        return {"x": batch["id"].astype(np.float32)}
+
+    ds = rd.range(60 * rows_per_block,
+                  parallelism=60).map_batches(slow_preprocess)
+    hog = svc.register(ds, tenant="hog")
+    t_start = time.monotonic()
+    hog_thread = threading.Thread(target=drain, args=(hog, counts, "hog"),
+                                  name="bench-ingest-hog", daemon=True)
+    hog_thread.start()
+    scale_t = None
+    deadline = time.perf_counter() + 60.0
+    while time.perf_counter() < deadline:
+        ups = [e for e in svc.scale_events if e["dir"] == "up"]
+        if ups:
+            scale_t = ups[0]["t"]
+            break
+        time.sleep(0.01)
+    hog_thread.join(timeout=120.0)
+    svc.shutdown()
+    ray_tpu.shutdown()  # leave no pool workers behind for later suites
+    if scale_t is None:
+        raise RuntimeError("bench-ingest: pool never scaled up under stall")
+    latency_s = scale_t - t_start
+    print(f"# ingest autoscale: latency={latency_s:.3f}s "
+          f"eval_period={eval_period:.2f}s", file=sys.stderr)
+
+    if err_pct > 10.0:
+        raise RuntimeError(
+            f"bench-ingest: fair-share error {err_pct:.1f}% > 10%")
+    if speedup < 3.0:
+        raise RuntimeError(
+            f"bench-ingest: repeat-epoch speedup {speedup:.2f}x < 3x")
+    if latency_s > 2.0 * eval_period:
+        raise RuntimeError(
+            f"bench-ingest: autoscale latency {latency_s:.2f}s > "
+            f"{2.0 * eval_period:.2f}s (2 eval periods)")
+
+    _emit("ingest_fair_share_err_pct", err_pct, "%", "ingest_fair_anchor",
+          lower_is_better=True)
+    _emit("ingest_repeat_epoch_speedup", speedup, "x",
+          "ingest_epoch_anchor")
+    _emit("ingest_autoscale_latency_s", latency_s, "s",
+          "ingest_scale_anchor", lower_is_better=True)
+
+
 def bench_scale() -> None:
     """Federated control-plane scale gate (ISSUE 19): run the scale_sim
     harness at N=8/32/128 simulated node agents over sharded KV/pubsub
@@ -2247,6 +2401,10 @@ def main() -> None:
         bench_rl()
     if "data" in wanted:
         bench_data()
+    if "ingest" in wanted:
+        # shared ingest service: CPU-host actor pool + object plane,
+        # no device state — safe in the throughput block next to data
+        bench_ingest()
     if "object" in wanted:
         # host object plane: pure CPU/network, no device state to poison
         bench_objects()

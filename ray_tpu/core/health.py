@@ -146,7 +146,12 @@ def default_rules() -> List[Rule]:
              "delta(control_plane_reconnects_total) > 2", group_by=("role",)),
         Rule("data_stall_rising",
              "delta(data_stage_stall_seconds) > 1.0 for 2",
-             group_by=("stage",)),
+             # tenant-scoped: one tenant's input stall names that tenant
+             # (stage + tenant labels on the firing alert) and advertises
+             # CPU demand, so the ingest pool controller / autoscaler see
+             # per-tenant pressure instead of a fleet-wide alarm
+             group_by=("stage", "tenant"),
+             demand={"CPU": 1.0}),
     ]
     stall_pct = float(config.get("rl_sync_stall_max_pct"))
     if stall_pct > 0:

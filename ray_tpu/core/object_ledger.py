@@ -55,7 +55,8 @@ PIN_USER_PUT = "user_put"            # driver ray_tpu.put(); freed by ref GC
 PIN_CACHE = "cache"                  # pull-through replica on a puller node
 PIN_CHANNEL = "channel"              # staged/held for a DistChannel edge
 PIN_ESCAPED = "serialized_escape"    # ref pickled out; exempt from auto-free
-PIN_REASONS = (PIN_USER_PUT, PIN_CACHE, PIN_CHANNEL, PIN_ESCAPED)
+PIN_INGEST = "ingest_cache"          # ingest-service preprocessed-block cache
+PIN_REASONS = (PIN_USER_PUT, PIN_CACHE, PIN_CHANNEL, PIN_ESCAPED, PIN_INGEST)
 
 LEAK_KINDS = ("pinned_no_refs", "dead_node_location", "cold_cache")
 
@@ -423,8 +424,8 @@ def sweep(runtime, force: bool = False) -> Dict[str, Any]:
                                f"pin_count={row.get('pin_count', 0)} "
                                f"reason={row.get('pin_reason', '') or 'pin'} "
                                f"refs=0 age={age:.0f}s"))
-        elif (row.get("pin_reason") == PIN_CACHE and age > age_thr
-                and age - idle < 1.0):
+        elif (row.get("pin_reason") in (PIN_CACHE, PIN_INGEST)
+                and age > age_thr and age - idle < 1.0):
             leaks.append(_leak("cold_cache", row,
                                f"cached {age:.0f}s ago, never re-hit"))
 

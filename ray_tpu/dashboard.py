@@ -291,9 +291,45 @@ def build_dashboards() -> Dict[str, Dict[str, Any]]:
         "legendFormat": "absorbed {{pod}}",
         "refId": "B",
     })
+    # the ingest board's series live in data/ingest.py + data/tenant.py
+    from .data import ingest as _ingest  # noqa: F401
+    ingest = _dashboard("raytpu-ingest", "ray_tpu / shared ingest service", [
+        _panel("Preprocessed rows/s per tenant",
+               "rate(ingest_rows_total[1m])", 0, 0,
+               legend="{{tenant}}"),
+        _panel("Ingest stall seconds/s per tenant",
+               'rate(data_stage_stall_seconds{stage="ingest"}[1m])', 1, 0,
+               unit="s", legend="{{tenant}}"),
+        _panel("Fair-share ratio vs weight (1.0 = fair)",
+               "ingest_fair_share_ratio", 2, 8, legend="{{tenant}}"),
+        _panel("Cache hit rate per tenant",
+               "rate(ingest_cache_hits_total[5m]) / "
+               "(rate(ingest_cache_hits_total[5m]) + "
+               "rate(ingest_cache_misses_total[5m]))",
+               3, 8, unit="percentunit", legend="{{tenant}}"),
+        _panel("Worker pool size vs pending demand",
+               "ingest_pool_size", 4, 16, legend="pool"),
+        _panel("In-flight bytes per tenant (budget gate)",
+               "ingest_inflight_bytes", 5, 16, unit="bytes",
+               legend="{{tenant}}"),
+        _panel("Served bytes/s per tenant",
+               "rate(ingest_tenant_bytes_total[1m])", 6, 24, unit="Bps",
+               legend="{{tenant}}"),
+        _panel("Cache evictions (rate)",
+               "rate(ingest_cache_evicted_total[5m])", 7, 24,
+               legend="evicted"),
+    ])
+    # pending-block backlog overlaid on the pool-size panel: the scale-up
+    # trigger and its effect on one graph
+    ingest["panels"][4]["targets"].append({
+        "expr": "ingest_pending_blocks",
+        "legendFormat": "pending {{tenant}}",
+        "refId": "B",
+    })
     return {"core": core, "serve": serve, "data": data, "disagg": disagg,
             "health": health, "profiling": profiling, "objects": objects,
-            "fleet": fleet, "rl": rl, "federation": federation}
+            "fleet": fleet, "rl": rl, "federation": federation,
+            "ingest": ingest}
 
 
 def write_grafana_dashboards(directory: str) -> List[str]:

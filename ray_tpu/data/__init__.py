@@ -8,7 +8,15 @@ steps never stall on input.
 from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum  # noqa: F401
 from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from .dataset import Dataset, GroupedData  # noqa: F401
+from .ingest import (  # noqa: F401
+    IngestClient,
+    IngestIterator,
+    IngestService,
+    get_ingest_service,
+    shutdown_ingest_service,
+)
 from .iterator import DataIterator  # noqa: F401
+from .tenant import TenantSpec  # noqa: F401
 from .read_api import (  # noqa: F401
     from_arrow,
     from_items,

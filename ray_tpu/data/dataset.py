@@ -133,17 +133,23 @@ class Dataset:
 
     # -- execution -----------------------------------------------------------
 
-    def _stream_refs(self, preserve_order: bool = True) -> Iterator[Any]:
+    def _stream_refs(self, preserve_order: bool = True,
+                     tenant: str = "") -> Iterator[Any]:
         return StreamingExecutor(
-            self._plan, preserve_order=preserve_order).execute()
+            self._plan, preserve_order=preserve_order,
+            tenant=tenant).execute()
 
-    def iterator(self, *, preserve_order: bool = True) -> DataIterator:
+    def iterator(self, *, preserve_order: bool = True,
+                 tenant: str = "") -> DataIterator:
         """preserve_order=False lets every streaming stage yield blocks in
         completion order (no head-of-line blocking on a slow block) — the
         epoch's row multiset is unchanged but the order is not
-        deterministic. Default stays strictly ordered."""
+        deterministic. Default stays strictly ordered. `tenant` tags the
+        execution's stall metrics for per-tenant demand accounting."""
         return DataIterator(
-            lambda: self._stream_refs(preserve_order=preserve_order))
+            lambda: self._stream_refs(preserve_order=preserve_order,
+                                      tenant=tenant),
+            tenant=tenant)
 
     def iter_batches(self, *, preserve_order: bool = True, **kw) -> Iterator[Any]:
         return self.iterator(preserve_order=preserve_order).iter_batches(**kw)

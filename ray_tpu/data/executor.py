@@ -316,7 +316,8 @@ def _block_meta(block: Block):
 
 
 def _windowed_gen(read_tasks: List[Callable], max_in_flight: int,
-                  preserve_order: bool = True) -> Iterator[Any]:
+                  preserve_order: bool = True,
+                  tenant: str = "") -> Iterator[Any]:
     """Submit read tasks with a bounded window; yield block REFS. Tasks
     marked ``.streaming`` (generators of blocks) run as streaming-
     generator tasks — their refs surface while the task still executes;
@@ -381,7 +382,8 @@ def _windowed_gen(read_tasks: List[Callable], max_in_flight: int,
                 api.wait(plain, num_returns=1, timeout=0.02)
             else:
                 time.sleep(0.002)
-            _m_stall.inc(time.perf_counter() - t0, tags={"stage": "read"})
+            _m_stall.inc(time.perf_counter() - t0,
+                         tags={"stage": "read", "tenant": tenant})
 
 
 class StreamingExecutor:
@@ -397,11 +399,15 @@ class StreamingExecutor:
     def __init__(self, plan: LogicalPlan, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
                  max_in_flight_bytes: int = DEFAULT_MAX_IN_FLIGHT_BYTES,
                  preserve_order: bool = True,
+                 tenant: str = "",
                  _protected: Optional[set] = None):
         self.plan = plan
         self.max_in_flight = max_in_flight
         self.max_in_flight_bytes = max_in_flight_bytes
         self.preserve_order = preserve_order
+        # tenant tag carried on every stall sample this execution emits
+        # (multi-tenant ingest: per-tenant demand must be scrapeable)
+        self.tenant = tenant
         # ObjectIDs the PLAN owns (InputData blocks, incl. Union sub-plans):
         # re-iteration resolves them again, so eager frees (shuffle rounds)
         # must never touch them. Shared with sub-executors.
@@ -416,7 +422,8 @@ class StreamingExecutor:
             # incrementally; plain tasks go through the ordinary task
             # path (worker-process pool, retries)
             stream: Iterator[Any] = _windowed_gen(
-                source.read_tasks, self.max_in_flight, self.preserve_order)
+                source.read_tasks, self.max_in_flight, self.preserve_order,
+                tenant=self.tenant)
         elif isinstance(source, InputData):
             self._protected.update(r.object_id for r in source.blocks)
             stream = iter(list(source.blocks))
@@ -427,6 +434,7 @@ class StreamingExecutor:
                         plan, self.max_in_flight,
                         self.max_in_flight_bytes,
                         preserve_order=self.preserve_order,
+                        tenant=self.tenant,
                         _protected=self._protected).execute()
             stream = gen_union()
         else:

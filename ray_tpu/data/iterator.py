@@ -17,6 +17,7 @@ import collections
 import queue as _queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
@@ -27,67 +28,153 @@ from .block import BlockAccessor
 from .executor import _m_stall
 
 
-def _iter_in_background(make_iter: Callable[[], Iterator[Any]], depth: int,
-                        stage: str = "host_prefetch") -> Iterator[Any]:
-    """Run `make_iter()` on a daemon thread, handing items through a
-    queue bounded at `depth` (the producer runs at most `depth` items
-    ahead). Producer exceptions re-raise at the consumer's next pull;
-    abandoning the returned generator (break mid-epoch, GC) stops the
-    producer instead of leaking the thread. Consumer-side blocking time
-    accumulates into data_stage_stall_seconds{stage=...}."""
-    done = object()
-    q: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
-    stop = threading.Event()
+_DONE = object()
 
-    def put(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except _queue.Full:
-                continue
-        return False
 
-    def run():
+def _bounded_put(q: _queue.Queue, stop: threading.Event, item) -> bool:
+    while not stop.is_set():
         try:
-            for item in make_iter():
-                if not put((None, item)):
-                    return
-            put((done, None))
-        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
-            put((e, None))
+            q.put(item, timeout=0.1)
+            return True
+        except _queue.Full:
+            continue
+    return False
 
-    t = threading.Thread(target=run, daemon=True, name="data-host-prefetch")
-    t.start()
 
-    def gen():
-        try:
+def _prefetch_produce(make_iter, q: _queue.Queue,
+                      stop: threading.Event) -> None:
+    try:
+        for item in make_iter():
+            if not _bounded_put(q, stop, (None, item)):
+                return
+        _bounded_put(q, stop, (_DONE, None))
+    except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+        _bounded_put(q, stop, (e, None))
+
+
+class PrefetchIterator:
+    """Iterator over a bounded background-thread producer with an
+    explicit lifecycle.
+
+    Runs `make_iter()` on a daemon thread, handing items through a queue
+    bounded at `depth` (the producer runs at most `depth` items ahead).
+    Producer exceptions re-raise at the consumer's next pull; consumer-
+    side blocking time accumulates into
+    data_stage_stall_seconds{stage=,tenant=}.
+
+    Unlike the old generator shape, the producer thread is joinable from
+    EVERY abandonment path: `close()` (idempotent), `with` blocks, and
+    GC of a never-started or half-consumed iterator all set the stop
+    flag, drain the queue so a parked `put()` unblocks, and join the
+    thread — an abandoned iterator can no longer leak a thread parked on
+    a full queue."""
+
+    def __init__(self, make_iter: Callable[[], Iterator[Any]], depth: int,
+                 stage: str = "host_prefetch", tenant: str = ""):
+        self._q: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._closed = False
+        self._stage = stage
+        self._tenant = tenant
+        self._make_iter = make_iter
+        # the thread target closes over the queue + stop event ONLY, never
+        # self: a bound-method target would keep the iterator reachable
+        # for the thread's whole lifetime and the __del__ safety net could
+        # never fire on an abandoned iterator
+        self._thread = threading.Thread(
+            target=_prefetch_produce, args=(make_iter, self._q, self._stop),
+            daemon=True, name="data-host-prefetch")
+        self._thread.start()
+
+    # ------------------------------------------------------------ consumer
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        t0 = time.perf_counter()
+        kind, item = self._q.get()
+        _m_stall.inc(time.perf_counter() - t0,
+                     tags={"stage": self._stage, "tenant": self._tenant})
+        if kind is _DONE:
+            self.close()
+            raise StopIteration
+        if kind is not None:
+            self.close()
+            raise kind
+        return item
+
+    # ----------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        """Stop the producer and join its thread. Idempotent; safe from
+        any state (unstarted, mid-stream, exhausted)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        try:  # unblock a producer parked on a full queue
             while True:
-                t0 = time.perf_counter()
-                kind, item = q.get()
-                _m_stall.inc(time.perf_counter() - t0, tags={"stage": stage})
-                if kind is done:
-                    return
-                if kind is not None:
-                    raise kind
-                yield item
-        finally:
-            stop.set()
-            try:  # unblock a producer parked on a full queue
-                while True:
-                    q.get_nowait()
-            except _queue.Empty:
-                pass
-            t.join(timeout=1.0)
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=1.0)
 
-    return gen()
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # GC safety net for abandoned iterators
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def _iter_in_background(make_iter: Callable[[], Iterator[Any]], depth: int,
+                        stage: str = "host_prefetch",
+                        tenant: str = "") -> PrefetchIterator:
+    """Back-compat shim: see PrefetchIterator."""
+    return PrefetchIterator(make_iter, depth, stage=stage, tenant=tenant)
 
 
 class DataIterator:
-    """Iterates blocks from a ref-producing factory (re-iterable)."""
+    """Iterates blocks from a ref-producing factory (re-iterable).
 
-    def __init__(self, ref_stream_factory: Callable[[], Iterator[Any]]):
+    `tenant` tags every stall sample this iterator emits (multi-tenant
+    ingest demand signals). The iterator is also a context manager:
+    `close()` tears down every live prefetch thread it spawned, so a
+    consumer that abandons an epoch mid-stream can release the
+    `data-host-prefetch` threads deterministically instead of waiting
+    for GC."""
+
+    def __init__(self, ref_stream_factory: Callable[[], Iterator[Any]],
+                 tenant: str = ""):
         self._factory = ref_stream_factory
+        self._tenant = tenant
+        self._live: "weakref.WeakSet[PrefetchIterator]" = weakref.WeakSet()
+
+    def _background(self, make_iter: Callable[[], Iterator[Any]],
+                    depth: int) -> PrefetchIterator:
+        it = PrefetchIterator(make_iter, depth, tenant=self._tenant)
+        self._live.add(it)
+        return it
+
+    def close(self) -> None:
+        """Join every prefetch thread spawned by this iterator's batch
+        streams. Idempotent; live streams raise StopIteration after."""
+        for it in list(self._live):
+            it.close()
+
+    def __enter__(self) -> "DataIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def iter_block_refs(self) -> Iterator[Any]:
         return self._factory()
@@ -117,7 +204,7 @@ class DataIterator:
         step; the batch sequence is identical either way. 0 assembles
         inline on the calling thread."""
         if prefetch_batches and prefetch_batches > 0:
-            return _iter_in_background(
+            return self._background(
                 lambda: self._iter_batches_inline(
                     batch_size=batch_size,
                     batch_format=batch_format,
@@ -269,7 +356,7 @@ class DataIterator:
                 yield transform(batch) if transform is not None else batch
 
         if host_prefetch_batches and host_prefetch_batches > 0:
-            host_batches: Iterator[Any] = _iter_in_background(
+            host_batches: Iterator[Any] = self._background(
                 host_iter, host_prefetch_batches)
         else:
             host_batches = host_iter()

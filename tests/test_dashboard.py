@@ -198,7 +198,7 @@ class TestGrafana:
         names = sorted(os.path.basename(p) for p in written)
         assert "provisioning.yaml" in names
         jsons = [p for p in written if p.endswith(".json")]
-        assert len(jsons) == 10  # core, data, serve, disagg, health, profiling, objects, fleet, rl, federation
+        assert len(jsons) == 11  # core, data, serve, disagg, health, profiling, objects, fleet, rl, federation, ingest
         for p in jsons:
             dash = json.load(open(p))
             assert dash["panels"], p

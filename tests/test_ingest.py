@@ -1,0 +1,414 @@
+"""Shared multi-tenant ingest service (data/ingest.py + data/tenant.py).
+
+Covers: prefetch-thread lifecycle (close/context-manager/GC), deficit
+round-robin fair-share under a hog tenant, repeat-epoch cache economics
+(object_cache_hits up, zero re-preprocessing), deregistration eviction
+through the PR 10 cold-cache sweep, stall-driven pool autoscaling, and
+registration validation.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.core import core_worker, object_ledger
+from ray_tpu.core.metrics import registry
+from ray_tpu.data.ingest import IngestService
+from ray_tpu.data.iterator import PrefetchIterator, _iter_in_background
+from ray_tpu.data.tenant import FairShareScheduler, TenantSpec
+
+pytestmark = pytest.mark.ingest
+
+
+def _metric(name, **tags):
+    m = registry.get(name)
+    return m.get(tags or None) if m is not None else 0.0
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "data-host-prefetch" and t.is_alive()]
+
+
+def _drain_rows(iterator, batch_size=512, col="x"):
+    total = 0
+    for batch in iterator.iter_batches(batch_size=batch_size):
+        total += len(batch[col])
+    return total
+
+
+class TestPrefetchLifecycle:
+    """Satellite: the host-prefetch daemon thread must have a close path —
+    close()/context-manager/GC all unblock and join it."""
+
+    def test_close_joins_blocked_producer(self):
+        before = len(_prefetch_threads())
+
+        def make():
+            for i in range(10_000):
+                yield i
+
+        it = _iter_in_background(make, depth=2)
+        assert isinstance(it, PrefetchIterator)
+        assert next(it) == 0
+        # producer is now blocked on the full bounded queue; close must
+        # unblock it and join the thread
+        it.close()
+        assert not it._thread.is_alive()
+        assert len(_prefetch_threads()) == before
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_close_is_idempotent(self):
+        it = _iter_in_background(lambda: iter(range(5)), depth=2)
+        it.close()
+        it.close()
+        assert not it._thread.is_alive()
+
+    def test_exhaustion_closes_thread(self):
+        it = _iter_in_background(lambda: iter(range(4)), depth=2)
+        assert list(it) == [0, 1, 2, 3]
+        it._thread.join(timeout=2.0)
+        assert not it._thread.is_alive()
+
+    def test_context_manager_closes(self):
+        with _iter_in_background(lambda: iter(range(10_000)), depth=2) as it:
+            assert next(it) == 0
+            thread = it._thread
+        assert not thread.is_alive()
+
+    def test_gc_closes_thread(self):
+        import gc
+
+        it = _iter_in_background(lambda: iter(range(10_000)), depth=2)
+        next(it)
+        thread = it._thread
+        del it
+        gc.collect()
+        thread.join(timeout=2.0)
+        assert not thread.is_alive()
+
+    def test_producer_error_propagates_and_closes(self):
+        def make():
+            yield 1
+            raise ValueError("boom")
+
+        it = _iter_in_background(make, depth=2)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="boom"):
+            for _ in it:
+                pass
+        it._thread.join(timeout=2.0)
+        assert not it._thread.is_alive()
+
+    def test_data_iterator_close_stops_prefetch(self, ray_start_regular):
+        before = len(_prefetch_threads())
+        ds = rd.range(50_000, parallelism=8)
+        it = ds.iterator()
+        batches = it.iter_batches(batch_size=64, prefetch_batches=4)
+        next(batches)
+        assert len(_prefetch_threads()) > before
+        it.close()
+        assert len(_prefetch_threads()) == before
+
+    def test_data_iterator_context_manager(self, ray_start_regular):
+        before = len(_prefetch_threads())
+        with rd.range(50_000, parallelism=8).iterator() as it:
+            next(it.iter_batches(batch_size=64, prefetch_batches=4))
+        assert len(_prefetch_threads()) == before
+
+
+class TestFairShareScheduler:
+    """DRR unit behavior, no runtime needed."""
+
+    def test_weighted_split_under_backlog(self):
+        sched = FairShareScheduler(quantum_bytes=1000)
+        sched.ensure_tenant(TenantSpec("heavy", weight=4.0))
+        sched.ensure_tenant(TenantSpec("light", weight=1.0))
+        for i in range(400):
+            sched.enqueue("heavy", ("heavy", i))
+            sched.enqueue("light", ("light", i))
+        served = {"heavy": 0, "light": 0}
+        for _ in range(100):
+            nxt = sched.next()
+            if nxt is None:
+                continue
+            tenant, _item, charged = nxt
+            served[tenant] += 1
+            sched.complete(tenant, 1000, charged)
+        assert served["light"] > 0  # starvation-free
+        ratio = served["heavy"] / max(served["light"], 1)
+        assert 2.0 <= ratio <= 8.0  # ~4x by weight, DRR granularity slack
+
+    def test_in_flight_budget_gates_dispatch(self):
+        sched = FairShareScheduler(quantum_bytes=10_000)
+        sched.ensure_tenant(TenantSpec("t", weight=1.0,
+                                       max_in_flight_bytes=2000))
+        for i in range(50):
+            sched.enqueue("t", i)
+        grabbed = []
+        while True:
+            nxt = sched.next()
+            if nxt is None:
+                break
+            grabbed.append(nxt)
+        # warmup cost is clamped to the quantum, so the 2000-byte budget
+        # admits at most a couple of dispatches before gating
+        assert 1 <= len(grabbed) <= 2
+        for tenant, _item, charged in grabbed:
+            sched.complete(tenant, 1000, charged)
+        assert sched.next() is not None  # budget released, flow resumes
+
+    def test_empty_queue_forfeits_deficit(self):
+        sched = FairShareScheduler(quantum_bytes=1000)
+        sched.ensure_tenant(TenantSpec("idle", weight=100.0))
+        sched.ensure_tenant(TenantSpec("busy", weight=1.0))
+        for _ in range(20):  # idle accrues nothing while empty
+            assert sched.next() is None or True
+        sched.enqueue("busy", "b0")
+        nxt = sched.next()
+        assert nxt is not None and nxt[0] == "busy"
+
+
+class TestIngestFairShare:
+    def test_hog_vs_light_tenant_shares(self, ray_start_regular):
+        svc = IngestService(pool_min=2, pool_max=2, autoscale=False,
+                            quantum_bytes=4096)
+        try:
+            def slow(b):
+                time.sleep(0.004)
+                return {"x": b["id"] * 1.0}
+
+            n_blocks = 36
+            rows = n_blocks * 256
+            heavy = svc.register(
+                rd.range(rows, parallelism=n_blocks).map_batches(slow),
+                tenant="heavy", weight=4.0)
+            light = svc.register(
+                rd.range(rows, parallelism=n_blocks).map_batches(slow),
+                tenant="light", weight=1.0)
+
+            counts = {}
+            threads = [
+                threading.Thread(target=lambda it=it, k=k: counts.__setitem__(
+                    k, _drain_rows(it)), name=f"drain-{k}")
+                for k, it in (("heavy", heavy), ("light", light))
+            ]
+            for t in threads:
+                t.start()
+            # snapshot shares the moment the heavy tenant's last block
+            # lands — that is the contended window fairness is defined over
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                shares = svc.shares()
+                if shares.get("heavy", {}).get("served_blocks", 0) >= n_blocks:
+                    break
+                time.sleep(0.005)
+            for t in threads:
+                t.join(timeout=60)
+            assert counts["heavy"] == rows and counts["light"] == rows
+            h, l = shares["heavy"]["served_blocks"], shares["light"]["served_blocks"]
+            assert l > 0, "light tenant starved"
+            assert h / max(l, 1) >= 2.0, f"weight-4 tenant served {h} vs {l}"
+        finally:
+            svc.shutdown()
+
+    def test_rejects_all_to_all_pipelines(self, ray_start_regular):
+        svc = IngestService(pool_min=1, pool_max=1, autoscale=False)
+        try:
+            ds = rd.range(1000, parallelism=4).random_shuffle()
+            with pytest.raises(ValueError, match="all-to-all"):
+                svc.register(ds, tenant="t")
+        finally:
+            svc.shutdown()
+
+
+class TestRepeatEpochCache:
+    """Satellite: repeat epochs stream from the PIN_INGEST object cache —
+    cache hits counted, zero re-executed preprocess tasks."""
+
+    def test_second_epoch_hits_cache(self, ray_start_regular):
+        svc = IngestService(pool_min=2, pool_max=2, autoscale=False)
+        try:
+            ds = rd.range(4096, parallelism=8).map_batches(
+                lambda b: {"x": b["id"] * 2.0})
+            it = svc.register(ds, tenant="trainer", weight=2.0)
+            rows1 = _drain_rows(it)
+            hits0 = _metric("object_cache_hits")
+            tasks0 = _metric("ingest_preprocess_tasks_total",
+                             tenant="trainer")
+            rows2 = _drain_rows(it)
+            assert rows1 == rows2 == 4096
+            assert _metric("object_cache_hits") - hits0 > 0
+            assert _metric("ingest_preprocess_tasks_total",
+                           tenant="trainer") == tasks0, \
+                "epoch 2 re-executed preprocess tasks"
+            assert _metric("ingest_cache_hits_total", tenant="trainer") >= 8
+        finally:
+            svc.shutdown()
+
+    def test_dedup_across_concurrent_epochs(self, ray_start_regular):
+        svc = IngestService(pool_min=2, pool_max=2, autoscale=False)
+        try:
+            def slowish(b):
+                time.sleep(0.002)
+                return {"x": b["id"] + 0.5}
+
+            ds = rd.range(2048, parallelism=8).map_batches(slowish)
+            it = svc.register(ds, tenant="t", weight=1.0)
+            out = {}
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: out.__setitem__(i, _drain_rows(it)))
+                for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert out[0] == out[1] == 2048
+            # two concurrent epochs of the same registration share block
+            # tasks: at most one preprocess per block
+            assert _metric("ingest_preprocess_tasks_total", tenant="t") <= 8
+        finally:
+            svc.shutdown()
+
+
+class TestDeregisterEviction:
+    """Satellite: blocks of a deregistered tenant are flagged by the PR 10
+    cold-cache sweep and the service janitor evicts them."""
+
+    def test_sweep_flags_then_evict_frees(self, ray_start_regular,
+                                          monkeypatch):
+        monkeypatch.setenv("RAY_TPU_OBJECT_LEAK_AGE_S", "0.05")
+        svc = IngestService(pool_min=1, pool_max=1, autoscale=False)
+        try:
+            ds = rd.range(1024, parallelism=4).map_batches(
+                lambda b: {"x": b["id"] * 1.0})
+            it = svc.register(ds, tenant="batch", weight=1.0)
+            assert _drain_rows(it) == 1024
+            # long grace: condemned but NOT yet evicted — exactly the
+            # window the cold-cache sweep exists to flag
+            it.deregister(grace_s=120.0)
+            time.sleep(0.2)
+            rt = core_worker.get_runtime()
+            report = object_ledger.sweep(rt, force=True)
+            flagged = [l for l in report["leaks"]
+                       if l["kind"] == "cold_cache"
+                       and l["pin_reason"] == object_ledger.PIN_INGEST]
+            assert flagged, "sweep missed condemned PIN_INGEST blocks"
+            assert svc.evict(force=True) >= 4
+            report = object_ledger.sweep(rt, force=True)
+            assert not [l for l in report["leaks"]
+                        if l["kind"] == "cold_cache"
+                        and l["pin_reason"] == object_ledger.PIN_INGEST]
+        finally:
+            svc.shutdown()
+
+    def test_epoch_errors_after_deregister(self, ray_start_regular):
+        svc = IngestService(pool_min=1, pool_max=1, autoscale=False)
+        try:
+            ds = rd.range(512, parallelism=2).map_batches(
+                lambda b: {"x": b["id"]})
+            it = svc.register(ds, tenant="t")
+            _drain_rows(it)
+            it.deregister()
+            with pytest.raises(RuntimeError, match="deregister"):
+                _drain_rows(it)
+        finally:
+            svc.shutdown()
+
+    def test_ttl_expiry_evicts(self, ray_start_regular, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_INGEST_CACHE_TTL_S", "0.05")
+        svc = IngestService(pool_min=1, pool_max=1, autoscale=False)
+        try:
+            ds = rd.range(512, parallelism=2).map_batches(
+                lambda b: {"x": b["id"]})
+            it = svc.register(ds, tenant="t")
+            _drain_rows(it)
+            time.sleep(0.15)
+            assert svc.evict() >= 2  # TTL-idle blocks collected
+        finally:
+            svc.shutdown()
+
+
+class TestAutoscale:
+    """Tentpole wiring: per-tenant ingest stall demand grows the pool
+    within [pool_min, pool_max]; sustained idleness shrinks it back."""
+
+    def test_stall_scales_up_then_idle_scales_down(self, ray_start_regular,
+                                                   monkeypatch):
+        monkeypatch.setenv("RAY_TPU_INGEST_EVAL_PERIOD_S", "0.2")
+        monkeypatch.setenv("RAY_TPU_INGEST_STALL_SCALE_THRESHOLD", "0.05")
+        svc = IngestService(pool_min=1, pool_max=3, autoscale=True)
+        try:
+            def slow(b):
+                time.sleep(0.02)
+                return {"x": b["id"] * 1.0}
+
+            it = svc.register(
+                rd.range(40 * 256, parallelism=40).map_batches(slow),
+                tenant="hog", weight=1.0)
+            rows = {}
+            th = threading.Thread(
+                target=lambda: rows.__setitem__("n", _drain_rows(it)))
+            t0 = time.monotonic()
+            th.start()
+            while time.monotonic() - t0 < 10 and svc.pool_size() <= 1:
+                time.sleep(0.02)
+            scaled_after = time.monotonic() - t0
+            assert svc.pool_size() > 1, "pool never scaled up under stall"
+            assert scaled_after < 5.0
+            up = [e for e in svc.scale_events if e["dir"] == "up"]
+            assert up and "hog" in up[0]["tenants"]
+            th.join(timeout=60)
+            assert rows["n"] == 40 * 256
+            # drained + idle: the controller retires back to pool_min
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and svc.pool_size() > 1:
+                time.sleep(0.05)
+            assert svc.pool_size() == 1, "pool never scaled back down"
+            assert any(e["dir"] == "down" for e in svc.scale_events)
+        finally:
+            svc.shutdown()
+
+
+class TestServiceLifecycle:
+    def test_singleton_recreated_after_shutdown(self, ray_start_regular):
+        svc = rd.get_ingest_service(pool_min=1, pool_max=1, autoscale=False)
+        assert rd.get_ingest_service() is svc
+        rd.shutdown_ingest_service()
+        assert rd.get_ingest_service(create=False) is None
+        svc2 = rd.get_ingest_service(pool_min=1, pool_max=1, autoscale=False)
+        try:
+            assert svc2 is not svc and svc2.is_running
+        finally:
+            rd.shutdown_ingest_service()
+
+    def test_client_round_trip(self, ray_start_regular):
+        svc = IngestService(pool_min=1, pool_max=1, autoscale=False)
+        try:
+            client = rd.IngestClient(svc)
+            it = client.register(
+                rd.range(512, parallelism=2).map_batches(
+                    lambda b: {"x": b["id"]}),
+                tenant="rl", weight=2.0)
+            assert _drain_rows(it) == 512
+            assert client.shares()["rl"]["served_blocks"] == 2
+            client.deregister(it)
+        finally:
+            svc.shutdown()
+
+    def test_shutdown_frees_cache_and_threads(self, ray_start_regular):
+        svc = IngestService(pool_min=2, pool_max=2, autoscale=True)
+        ds = rd.range(1024, parallelism=4).map_batches(
+            lambda b: {"x": b["id"]})
+        it = svc.register(ds, tenant="t")
+        _drain_rows(it)
+        svc.shutdown()
+        assert not svc._admission.is_alive()
+        assert svc._controller is None or not svc._controller.is_alive()
+        assert not svc._regs and not svc._condemned
